@@ -1,0 +1,15 @@
+"""plenum_tpu — a TPU-native Byzantine-fault-tolerant distributed-ledger
+framework with the capabilities of indy-plenum (RBFT consensus, multi-ledger
+Merkle transaction logs, Merkle-Patricia-Trie state, BLS-multi-signed state
+proofs, catchup, view change, audit ledger, pluggable request handling).
+
+Design (see SURVEY.md §7): the consensus control plane is a deterministic,
+single-threaded, message-passing event loop on the host (reference:
+stp_core/loop/looper.py, plenum/server/node.py:1037). All bulk math —
+ed25519 signature verification, BLS12-381 aggregation, SHA-256 Merkle
+hashing — lives in `plenum_tpu.ops` as pure batched JAX functions that are
+dispatched per prod tick and shard across a `jax.sharding.Mesh`
+(`plenum_tpu.parallel`). Scalar CPU fallbacks keep the latency floor low.
+"""
+
+__version__ = "0.1.0"

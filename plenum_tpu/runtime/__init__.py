@@ -1,0 +1,5 @@
+from plenum_tpu.runtime.timer import TimerService, QueueTimer, RepeatingTimer  # noqa: F401
+from plenum_tpu.runtime.bus import InternalBus, ExternalBus, Router  # noqa: F401
+from plenum_tpu.runtime.stashing_router import (  # noqa: F401
+    StashingRouter, PROCESS, DISCARD, STASH,
+)

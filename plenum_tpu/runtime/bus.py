@@ -1,0 +1,72 @@
+"""Typed pub-sub buses.
+
+Reference: plenum/common/event_bus.py:6 (InternalBus), :11 (ExternalBus);
+base Router plenum/common/router.py:5. All intra-replica coordination is
+messages on an InternalBus; all network sends go through an ExternalBus whose
+send handler is the transport (or the SimNetwork in tests).
+"""
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Type
+
+
+class Router:
+    """Maps message type → list of handlers; dispatch is synchronous."""
+
+    def __init__(self):
+        self._handlers: Dict[Type, List[Callable]] = {}
+
+    def subscribe(self, message_type: Type, handler: Callable) -> Callable:
+        self._handlers.setdefault(message_type, []).append(handler)
+        def unsubscribe():
+            self._handlers[message_type].remove(handler)
+        return unsubscribe
+
+    def handlers(self, message_type: Type) -> List[Callable]:
+        return self._handlers.get(message_type, [])
+
+
+class InternalBus(Router):
+    def send(self, message: Any, *args):
+        result = None
+        for handler in self.handlers(type(message)):
+            result = handler(message, *args)
+        return result
+
+
+class ExternalBus(Router):
+    """Network-facing bus: `send` goes out via the transport handler;
+    `process_incoming` dispatches received messages with their sender name.
+    Tracks connected peers (reference event_bus.py:11)."""
+
+    class Connected(NamedTuple):
+        pass
+
+    class Disconnected(NamedTuple):
+        pass
+
+    def __init__(self, send_handler: Callable[[Any, Optional[Any]], None]):
+        super().__init__()
+        self._send_handler = send_handler
+        self._connecteds = set()
+
+    @property
+    def connecteds(self) -> set:
+        return self._connecteds
+
+    def send(self, message: Any, dst=None) -> None:
+        """dst None = broadcast; str = single peer; list = multiple peers."""
+        self._send_handler(message, dst)
+
+    def process_incoming(self, message: Any, frm: str):
+        result = None
+        for handler in self.handlers(type(message)):
+            result = handler(message, frm)
+        return result
+
+    def update_connecteds(self, connecteds: set) -> None:
+        new = connecteds - self._connecteds
+        gone = self._connecteds - connecteds
+        self._connecteds = set(connecteds)
+        for name in new:
+            self.process_incoming(self.Connected(), name)
+        for name in gone:
+            self.process_incoming(self.Disconnected(), name)

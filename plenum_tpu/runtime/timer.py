@@ -1,0 +1,109 @@
+"""Timer service: abstract clock + ordered callback queue.
+
+Reference: plenum/common/timer.py:13 (TimerService), :27 (QueueTimer),
+:60 (RepeatingTimer). This is the *only* clock consensus services see, so a
+MockTimer (plenum_tpu/testing/mock_timer.py) makes the whole consensus layer
+deterministically testable with no real time or sockets (SURVEY.md §4 rung 2).
+"""
+import time
+from abc import ABC, abstractmethod
+from typing import Callable, NamedTuple
+
+from sortedcontainers import SortedList
+
+
+class TimerService(ABC):
+    @abstractmethod
+    def get_current_time(self) -> float:
+        ...
+
+    @abstractmethod
+    def schedule(self, delay: float, callback: Callable) -> None:
+        ...
+
+    @abstractmethod
+    def cancel(self, callback: Callable) -> None:
+        """Cancel all scheduled occurrences of callback."""
+
+
+class TimerEvent(NamedTuple):
+    # ordering is always via SortedList's explicit timestamp key — never
+    # compare TimerEvents directly (callbacks aren't orderable)
+    timestamp: float
+    callback: Callable
+
+
+class QueueTimer(TimerService):
+    """Production timer: events fire from `service()` which the owning loop
+    calls every prod tick (reference plenum/common/timer.py:27)."""
+
+    def __init__(self, get_current_time: Callable[[], float] = time.perf_counter):
+        self._get_current_time = get_current_time
+        self._events = SortedList(key=lambda ev: ev.timestamp)
+
+    def queue_size(self) -> int:
+        return len(self._events)
+
+    def get_current_time(self) -> float:
+        return self._get_current_time()
+
+    def schedule(self, delay: float, callback: Callable) -> None:
+        self._events.add(TimerEvent(timestamp=self.get_current_time() + delay,
+                                    callback=callback))
+
+    def cancel(self, callback: Callable) -> None:
+        for ev in [ev for ev in self._events if ev.callback == callback]:
+            self._events.remove(ev)
+
+    def service(self) -> int:
+        """Fire all due events; returns count fired."""
+        count = 0
+        now = self.get_current_time()
+        while self._events and self._events[0].timestamp <= now:
+            ev = self._events.pop(0)
+            ev.callback()
+            count += 1
+        return count
+
+    def next_wakeup_in(self):
+        if not self._events:
+            return None
+        return max(0.0, self._events[0].timestamp - self.get_current_time())
+
+
+class RepeatingTimer:
+    """Re-schedules callback every `interval` until stopped (reference
+    plenum/common/timer.py:60)."""
+
+    def __init__(self, timer: TimerService, interval: float,
+                 callback: Callable, active: bool = True):
+        assert interval > 0
+        self._timer = timer
+        self._interval = interval
+        self._callback = callback
+        self._active = False
+        # Distinct bound wrapper so cancel() of one RepeatingTimer never
+        # cancels another timer using the same raw callback.
+        def _wrapped():
+            if self._active:
+                self._callback()
+                # the callback may have called stop(); don't reschedule then
+                if self._active:
+                    self._timer.schedule(self._interval, _wrapped)
+        self._wrapped = _wrapped
+        if active:
+            self.start()
+
+    def start(self) -> None:
+        if not self._active:
+            self._active = True
+            self._timer.schedule(self._interval, self._wrapped)
+
+    def stop(self) -> None:
+        if self._active:
+            self._active = False
+            self._timer.cancel(self._wrapped)
+
+    def update_interval(self, interval: float) -> None:
+        assert interval > 0
+        self._interval = interval

@@ -1,0 +1,2 @@
+from plenum_tpu.testing.mock_timer import MockTimer  # noqa: F401
+from plenum_tpu.testing.sim_network import SimNetwork  # noqa: F401

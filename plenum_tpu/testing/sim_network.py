@@ -1,0 +1,139 @@
+"""Deterministic simulated network: delivers ExternalBus sends through a
+chain of processors (drop / delay / stash) on a MockTimer.
+
+Reference: plenum/test/simulation/sim_network.py:98 (SimNetwork),
+:14-40 (Discard/Deliver/Stash processors). Seeded by DefaultSimRandom so
+partition/latency fuzzing of view change + ordering is replayable.
+"""
+import logging
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+from plenum_tpu.runtime.bus import ExternalBus
+from plenum_tpu.runtime.sim_random import SimRandom, DefaultSimRandom
+from plenum_tpu.testing.mock_timer import MockTimer
+
+logger = logging.getLogger(__name__)
+
+
+class PendingMessage(NamedTuple):
+    message: Any
+    frm: str
+    dst: str
+
+
+class Processor:
+    """Returns True if it consumed the message (stops the chain)."""
+
+    def process(self, msg: PendingMessage) -> bool:
+        raise NotImplementedError
+
+    def _matches(self, msg: PendingMessage, frm=None, dst=None,
+                 message_types=None) -> bool:
+        if frm is not None and msg.frm not in frm:
+            return False
+        if dst is not None and msg.dst not in dst:
+            return False
+        if message_types is not None and not isinstance(msg.message,
+                                                        tuple(message_types)):
+            return False
+        return True
+
+
+class Discard(Processor):
+    def __init__(self, random: SimRandom, probability: float = 1.0,
+                 frm=None, dst=None, message_types=None):
+        self._random = random
+        self._probability = probability
+        self._filters = dict(frm=frm, dst=dst, message_types=message_types)
+
+    def process(self, msg: PendingMessage) -> bool:
+        if not self._matches(msg, **self._filters):
+            return False
+        return self._random.float(0.0, 1.0) < self._probability
+
+
+class Stash(Processor):
+    def __init__(self, frm=None, dst=None, message_types=None):
+        self._filters = dict(frm=frm, dst=dst, message_types=message_types)
+        self.stashed: List[PendingMessage] = []
+
+    def process(self, msg: PendingMessage) -> bool:
+        if self._matches(msg, **self._filters):
+            self.stashed.append(msg)
+            return True
+        return False
+
+    def pop_all(self) -> List[PendingMessage]:
+        msgs, self.stashed = self.stashed, []
+        return msgs
+
+
+class SimNetwork:
+    def __init__(self, timer: MockTimer, random: Optional[SimRandom] = None,
+                 serialize_deserialize: Callable[[Any], Any] = None,
+                 min_latency: float = 0.01, max_latency: float = 0.5):
+        self._timer = timer
+        self._random = random or DefaultSimRandom()
+        self._min_latency = min_latency
+        self._max_latency = max_latency
+        self._serde = serialize_deserialize
+        self._buses: Dict[str, ExternalBus] = {}
+        self.processors: List[Processor] = []
+        self.sent_count = 0
+
+    def create_peer(self, name: str, send_handler=None) -> ExternalBus:
+        """send_handler overrides the simulated transport for this peer
+        (reference sim_network.py:116) — used by tests to spy on sends."""
+        if name in self._buses:
+            raise ValueError("Peer {} already exists".format(name))
+        bus = ExternalBus(send_handler=send_handler or
+                          self._make_send_handler(name))
+        self._buses[name] = bus
+        for peer, other in self._buses.items():
+            if peer != name:
+                other.update_connecteds(other.connecteds | {name})
+        bus.update_connecteds(set(p for p in self._buses if p != name))
+        return bus
+
+    def add_processor(self, processor: Processor):
+        self.processors.append(processor)
+
+    def remove_processor(self, processor: Processor):
+        self.processors.remove(processor)
+
+    def reset_filters(self):
+        self.processors = []
+
+    def deliver_stashed(self, stash: Stash):
+        for msg in stash.pop_all():
+            self._schedule_delivery(msg)
+
+    def _make_send_handler(self, frm: str):
+        def handle(message, dst=None):
+            if dst is None:
+                dsts = [p for p in self._buses if p != frm]
+            elif isinstance(dst, str):
+                dsts = [dst]
+            else:
+                dsts = list(dst)
+            for d in dsts:
+                if d == frm:
+                    continue
+                self.sent_count += 1
+                msg = PendingMessage(message, frm, d)
+                if any(p.process(msg) for p in self.processors):
+                    continue
+                self._schedule_delivery(msg)
+        return handle
+
+    def _schedule_delivery(self, msg: PendingMessage):
+        delay = self._random.float(self._min_latency, self._max_latency)
+        def deliver():
+            bus = self._buses.get(msg.dst)
+            if bus is None:
+                return
+            payload = msg.message
+            if self._serde is not None:
+                payload = self._serde(payload)
+            bus.process_incoming(payload, msg.frm)
+        self._timer.schedule(delay, deliver)

@@ -1,0 +1,34 @@
+"""Test conftest — forces JAX onto a virtual 8-device CPU mesh so all
+mesh-sharded paths are exercised without TPU hardware (multi-chip design is
+validated by __graft_entry__.dryrun_multichip on the driver side)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mock_timer():
+    from plenum_tpu.testing.mock_timer import MockTimer
+    return MockTimer()
+
+
+@pytest.fixture
+def sim_random():
+    from plenum_tpu.runtime.sim_random import DefaultSimRandom
+    return DefaultSimRandom(0)
+
+
+@pytest.fixture
+def sim_network(mock_timer, sim_random):
+    from plenum_tpu.testing.sim_network import SimNetwork
+    return SimNetwork(mock_timer, sim_random)
+
+
+@pytest.fixture
+def tdir(tmp_path):
+    return str(tmp_path)

@@ -1,0 +1,208 @@
+"""Rung-1/2 tests for the runtime substrate (timer, buses, stashing router,
+channels, sim network). Modeled on reference plenum/test/timer & event_bus
+tests."""
+from typing import NamedTuple
+
+from plenum_tpu.runtime.timer import QueueTimer, RepeatingTimer
+from plenum_tpu.runtime.bus import InternalBus, ExternalBus
+from plenum_tpu.runtime.stashing_router import (
+    StashingRouter, PROCESS, DISCARD, STASH)
+from plenum_tpu.runtime.channel import create_direct_channel, QueuedChannelService
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.testing.mock_timer import MockTimer
+from plenum_tpu.testing.sim_network import SimNetwork, Discard, Stash
+
+
+class Ping(NamedTuple):
+    seq: int = 0
+
+
+class Pong(NamedTuple):
+    seq: int = 0
+
+
+def test_mock_timer_fires_in_order():
+    timer = MockTimer()
+    fired = []
+    timer.schedule(5, lambda: fired.append('b'))
+    timer.schedule(1, lambda: fired.append('a'))
+    timer.schedule(9, lambda: fired.append('c'))
+    timer.set_time(6)
+    assert fired == ['a', 'b']
+    timer.set_time(10)
+    assert fired == ['a', 'b', 'c']
+
+
+def test_mock_timer_nested_schedule():
+    timer = MockTimer()
+    fired = []
+    def first():
+        fired.append('first')
+        timer.schedule(1, lambda: fired.append('second'))
+    timer.schedule(1, first)
+    timer.set_time(3)
+    assert fired == ['first', 'second']
+
+
+def test_timer_cancel():
+    timer = MockTimer()
+    fired = []
+    cb = lambda: fired.append(1)
+    timer.schedule(1, cb)
+    timer.schedule(2, cb)
+    timer.cancel(cb)
+    timer.set_time(5)
+    assert fired == []
+
+
+def test_repeating_timer():
+    timer = MockTimer()
+    fired = []
+    rt = RepeatingTimer(timer, 5, lambda: fired.append(timer.get_current_time()))
+    timer.set_time(16)
+    assert fired == [5, 10, 15]
+    rt.stop()
+    timer.set_time(30)
+    assert fired == [5, 10, 15]
+    rt.start()
+    timer.set_time(36)
+    assert fired == [5, 10, 15, 35]
+
+
+def test_queue_timer_service():
+    now = [0.0]
+    timer = QueueTimer(get_current_time=lambda: now[0])
+    fired = []
+    timer.schedule(1, lambda: fired.append(1))
+    assert timer.service() == 0
+    now[0] = 2.0
+    assert timer.service() == 1
+    assert fired == [1]
+
+
+def test_internal_bus_dispatch():
+    bus = InternalBus()
+    got = []
+    bus.subscribe(Ping, lambda m: got.append(m))
+    bus.send(Ping(3))
+    bus.send(Pong(1))
+    assert got == [Ping(3)]
+
+
+def test_external_bus_send_and_connecteds():
+    sent = []
+    bus = ExternalBus(send_handler=lambda m, dst: sent.append((m, dst)))
+    bus.send(Ping(1))
+    bus.send(Ping(2), 'Beta')
+    assert sent == [(Ping(1), None), (Ping(2), 'Beta')]
+    events = []
+    bus.subscribe(ExternalBus.Connected, lambda m, frm: events.append(('+', frm)))
+    bus.subscribe(ExternalBus.Disconnected, lambda m, frm: events.append(('-', frm)))
+    bus.update_connecteds({'A', 'B'})
+    bus.update_connecteds({'B'})
+    assert ('-', 'A') in events and ('+', 'B') in events
+
+
+def test_stashing_router_stash_and_replay():
+    bus = InternalBus()
+    router = StashingRouter(limit=10, buses=[bus])
+    ready = [False]
+    processed = []
+
+    def handler(msg):
+        if not ready[0]:
+            return (STASH, "not ready")
+        processed.append(msg)
+        return (PROCESS, None)
+
+    router.subscribe(Ping, handler)
+    bus.send(Ping(1))
+    bus.send(Ping(2))
+    assert processed == [] and router.stash_size() == 2
+    ready[0] = True
+    router.process_all_stashed()
+    assert processed == [Ping(1), Ping(2)] and router.stash_size() == 0
+
+
+def test_stashing_router_discard():
+    bus = InternalBus()
+    router = StashingRouter(limit=10, buses=[bus])
+    router.subscribe(Ping, lambda m: (DISCARD, "old"))
+    bus.send(Ping(1))
+    assert router.stash_size() == 0
+
+
+def test_direct_channel():
+    tx, rx = create_direct_channel()
+    got = []
+    rx.set_handler(got.append)
+    tx.put_nowait('x')
+    assert got == ['x']
+
+
+def test_queued_channel_service():
+    svc = QueuedChannelService()
+    got = []
+    svc.rx.set_handler(got.append)
+    svc.tx.put_nowait(1)
+    svc.tx.put_nowait(2)
+    assert got == []
+    assert svc.service() == 2
+    assert got == [1, 2]
+
+
+def test_sim_random_deterministic():
+    r1, r2 = DefaultSimRandom(42), DefaultSimRandom(42)
+    assert [r1.integer(0, 100) for _ in range(10)] == \
+           [r2.integer(0, 100) for _ in range(10)]
+    assert r1.string(5, 10) == r2.string(5, 10)
+
+
+def test_sim_network_delivery(mock_timer, sim_random):
+    net = SimNetwork(mock_timer, sim_random)
+    got_a, got_b = [], []
+    bus_a = net.create_peer('A')
+    bus_b = net.create_peer('B')
+    net.create_peer('C')
+    bus_a.subscribe(Ping, lambda m, frm: got_a.append((m, frm)))
+    bus_b.subscribe(Ping, lambda m, frm: got_b.append((m, frm)))
+    bus_a.send(Ping(1))          # broadcast
+    mock_timer.run_for(1)
+    assert got_b == [(Ping(1), 'A')]
+    bus_b.send(Ping(2), 'A')     # direct
+    mock_timer.run_for(1)
+    assert got_a == [(Ping(2), 'B')]
+
+
+def test_sim_network_discard_and_stash(mock_timer, sim_random):
+    net = SimNetwork(mock_timer, sim_random)
+    got_b = []
+    bus_a = net.create_peer('A')
+    bus_b = net.create_peer('B')
+    bus_b.subscribe(Ping, lambda m, frm: got_b.append(m))
+    drop = Discard(sim_random, probability=1.0, message_types=[Ping])
+    net.add_processor(drop)
+    bus_a.send(Ping(1), 'B')
+    mock_timer.run_for(1)
+    assert got_b == []
+    net.remove_processor(drop)
+    stash = Stash(dst=['B'])
+    net.add_processor(stash)
+    bus_a.send(Ping(2), 'B')
+    mock_timer.run_for(1)
+    assert got_b == []
+    net.remove_processor(stash)
+    net.deliver_stashed(stash)
+    mock_timer.run_for(1)
+    assert got_b == [Ping(2)]
+
+
+def test_utils():
+    from plenum_tpu.utils import max_faulty, check_if_more_than_f_same_items
+    assert max_faulty(4) == 1
+    assert max_faulty(7) == 2
+    assert max_faulty(1) == 0
+    assert check_if_more_than_f_same_items(['a', 'a', 'b'], 1) == 'a'
+    assert check_if_more_than_f_same_items(['a', 'b'], 1) is None
+    assert check_if_more_than_f_same_items(
+        [{'x': 1}, {'x': 1}, {'x': 2}], 1) == {'x': 1}
