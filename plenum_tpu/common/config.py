@@ -1,0 +1,102 @@
+"""Framework configuration defaults.
+
+Reference: plenum/config.py (~189 knobs) + stp_core/config.py. Kept as a
+simple attribute namespace; override via Config(**overrides) or attribute
+assignment (tests use the `tconf` fixture pattern).
+"""
+
+
+class Config:
+    # ---- 3PC batching (reference plenum/config.py:253-276)
+    Max3PCBatchSize = 1000
+    Max3PCBatchWait = 3          # seconds before sending a partial batch
+    Max3PCBatchesInFlight = 4
+    MAX_BATCHES_IN_QUEUE = 100
+
+    CHK_FREQ = 100               # checkpoint every N batches
+    LOG_SIZE = 3 * CHK_FREQ      # watermark window [h, h+LOG_SIZE]
+
+    # ---- propagation
+    PROPAGATE_REQUEST_DELAY = 0
+
+    # ---- monitor thresholds (reference plenum/config.py:140-142)
+    DELTA = 0.1                  # min master throughput ratio (Δ)
+    LAMBDA = 240                 # max master request latency sec (Λ)
+    OMEGA = 20                   # max master-vs-backup avg latency gap (Ω)
+    SendMonitorStats = False
+    ThroughputWindowSize = 15
+    ThroughputFirstWindowSize = 450
+    ThroughputMinActivityThreshold = 0
+    ThroughputInnerWindowSize = 15
+    LatencyWindowSize = 30
+    MIN_LATENCY_COUNT = 10
+
+    # ---- view change (reference plenum/config.py:197-201, 295)
+    ToleratePrimaryDisconnection = 60
+    NEW_VIEW_TIMEOUT = 30
+    VIEW_CHANGE_RESEND_TIMEOUT = 10
+    INSTANCE_CHANGE_RESEND_TIMEOUT = 300
+    OUTDATED_INSTANCE_CHANGES_CHECK_INTERVAL = 300
+
+    # ---- freshness (reference plenum/config.py STATE_FRESHNESS_UPDATE_INTERVAL)
+    UPDATE_STATE_FRESHNESS = True
+    STATE_FRESHNESS_UPDATE_INTERVAL = 300
+    ACCEPTABLE_DEVIATION_PREPREPARE_SECS = 300
+
+    # ---- catchup
+    CATCHUP_BATCH_SIZE = 5
+    CATCHUP_TXN_TIMEOUT = 6
+    CatchupTransactionsTimeout = 6
+    MAX_CATCHUP_RETRY = 3
+
+    # ---- transport (reference stp_core/config.py)
+    MSG_LEN_LIMIT = 128 * 1024
+    MAX_CONNECTED_CLIENTS_NUM = 15360
+    ENABLE_HEARTBEATS = True
+    HEARTBEAT_FREQ = 5
+    RETRY_TIMEOUT_NOT_RESTRICTED = 6
+    RETRY_TIMEOUT_RESTRICTED = 15
+    MAX_RECONNECT_RETRY_ON_SAME_SOCKET = 1
+
+    # ---- quotas per prod tick (reference stp_core/config.py:29+,
+    # plenum/server/quota_control.py)
+    NODE_TO_NODE_STACK_QUOTA = 1024
+    NODE_TO_NODE_STACK_SIZE = 1024 * 1024
+    CLIENT_TO_NODE_STACK_QUOTA = 100
+    CLIENT_TO_NODE_STACK_SIZE = 1024 * 1024
+    EnsureListenerQuota = True
+    MAX_REQUEST_QUEUE_SIZE = 10000
+
+    # ---- replicas
+    REPLICAS_REMOVING_WITH_DEGRADATION = "local"
+    REPLICAS_REMOVING_WITH_PRIMARY_DISCONNECTED = "local"
+
+    # ---- storage
+    domainStateStorage = "memory"
+    poolStateStorage = "memory"
+    configStateStorage = "memory"
+    reqIdToTxnStorage = "memory"
+    nodeStatusStorage = "memory"
+
+    # ---- TPU crypto dispatch (new — the north-star gated boundary)
+    # provider: 'cpu' (scalar C path via `cryptography`) or 'tpu_batch'
+    # (JAX batched kernels). 'auto' picks by queue depth.
+    ED25519_PROVIDER = "auto"
+    ED25519_TPU_MIN_BATCH = 64   # below this the CPU scalar path wins
+    SHA256_PROVIDER = "auto"
+    SHA256_TPU_MIN_BATCH = 256
+    BLS_PROVIDER = "cpu"
+
+    # ---- metrics
+    METRICS_COLLECTOR_TYPE = None
+
+    # ---- TAA
+    TXN_AUTHOR_AGREEMENT_EXPIRATION = None
+
+    def __init__(self, **overrides):
+        for k, v in overrides.items():
+            setattr(self, k, v)
+
+
+def getConfig(**overrides) -> Config:
+    return Config(**overrides)

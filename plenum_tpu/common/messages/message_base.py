@@ -1,0 +1,116 @@
+"""MessageBase: declarative typed message schemas validated on construction.
+
+Reference: plenum/common/messages/message_base.py — `schema` is a tuple of
+(field_name, FieldValidator); messages construct from positional or keyword
+args, validate immediately, serialize to a plain dict with `op` = typename.
+"""
+from typing import Any, Dict, Optional, Tuple
+
+from plenum_tpu.common.constants import OP_FIELD_NAME
+from plenum_tpu.common.exceptions import InvalidNodeMessageException
+from plenum_tpu.common.messages.fields import FieldValidator
+
+
+class MessageValidationError(InvalidNodeMessageException):
+    pass
+
+
+class MessageBase:
+    typename: str = None
+    schema: Tuple[Tuple[str, FieldValidator], ...] = ()
+    # fields not included in the digest/signature
+    _frozen = False
+
+    def __init__(self, *args, **kwargs):
+        field_names = [name for name, _ in self.schema]
+        if len(args) > len(field_names):
+            raise MessageValidationError(
+                "too many positional arguments for {}".format(self.typename))
+        values: Dict[str, Any] = dict(zip(field_names, args))
+        for k, v in kwargs.items():
+            if k in values:
+                raise MessageValidationError(
+                    "duplicate argument {} for {}".format(k, self.typename))
+            if k not in field_names:
+                raise MessageValidationError(
+                    "unknown argument {} for {}".format(k, self.typename))
+            values[k] = v
+        self._validate_and_set(values)
+        self._frozen = True
+
+    def _validate_and_set(self, values: Dict[str, Any]):
+        for name, validator in self.schema:
+            if name not in values or values[name] is None:
+                if validator.optional or validator.nullable:
+                    values.setdefault(name, None)
+                    continue
+                raise MessageValidationError(
+                    "validation error [{}]: missed fields - {}"
+                    .format(type(self).__name__, name))
+            err = validator.validate(values[name])
+            if err:
+                raise MessageValidationError(
+                    "validation error [{}]: {} ({}={})"
+                    .format(type(self).__name__, err, name,
+                            repr(values[name])[:128]))
+        for name, _ in self.schema:
+            object.__setattr__(self, name, values.get(name))
+
+    def __setattr__(self, key, value):
+        if self._frozen and key in [n for n, _ in self.schema]:
+            raise AttributeError("message fields are immutable")
+        object.__setattr__(self, key, value)
+
+    @property
+    def _field_names(self):
+        return tuple(name for name, _ in self.schema)
+
+    def as_dict(self) -> Dict[str, Any]:
+        """Plain-dict form of the payload, tuples normalized to lists so
+        dict equality and canonical serialization are stable."""
+        return {name: _plain(getattr(self, name)) for name in self._field_names}
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Wire form: payload + op field."""
+        d = self.as_dict()
+        d[OP_FIELD_NAME] = self.typename
+        return d
+
+    def items(self):
+        return self.as_dict().items()
+
+    def __getitem__(self, item):
+        if isinstance(item, int):
+            return getattr(self, self._field_names[item])
+        return getattr(self, item)
+
+    def __iter__(self):
+        return iter(getattr(self, name) for name in self._field_names)
+
+    def __len__(self):
+        return len(self.schema)
+
+    def __eq__(self, other):
+        if not isinstance(other, MessageBase):
+            return NotImplemented
+        return self.typename == other.typename and self.as_dict() == other.as_dict()
+
+    def __hash__(self):
+        return hash((self.typename, repr(sorted(self.as_dict().items(),
+                                                key=lambda kv: kv[0]))))
+
+    def __repr__(self):
+        return "{}({})".format(
+            type(self).__name__,
+            ", ".join("{}={!r}".format(n, getattr(self, n))
+                      for n in self._field_names))
+
+
+def _plain(v):
+    if isinstance(v, MessageBase):
+        return v.as_dict()
+    if isinstance(v, (list, tuple)):
+        return [_plain(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _plain(x) for k, x in v.items()}
+    return v

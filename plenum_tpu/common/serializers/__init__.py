@@ -1,0 +1,14 @@
+from plenum_tpu.common.serializers.serialization import (  # noqa: F401
+    ledger_txn_serializer,
+    ledger_hash_serializer,
+    domain_state_serializer,
+    pool_state_serializer,
+    config_state_serializer,
+    client_req_rep_serializer,
+    node_status_db_serializer,
+    state_roots_serializer,
+    proof_nodes_serializer,
+    multi_sig_store_serializer,
+    instance_change_db_serializer,
+    serialize_msg_for_signing,
+)

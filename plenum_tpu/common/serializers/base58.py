@@ -1,0 +1,49 @@
+"""Base58 codec (bitcoin alphabet) — no external dependency available, so
+implemented here. Used for merkle/state roots and DIDs (reference:
+common/serializers/base58_serializer.py)."""
+
+ALPHABET = b'123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz'
+_INDEX = {c: i for i, c in enumerate(ALPHABET)}
+
+
+def b58encode(data: bytes) -> str:
+    n = int.from_bytes(data, 'big')
+    out = bytearray()
+    while n > 0:
+        n, r = divmod(n, 58)
+        out.append(ALPHABET[r])
+    # preserve leading zero bytes
+    pad = 0
+    for b in data:
+        if b == 0:
+            pad += 1
+        else:
+            break
+    return (ALPHABET[0:1] * pad + bytes(reversed(out))).decode('ascii')
+
+
+def b58decode(s) -> bytes:
+    if isinstance(s, bytes):
+        s = s.decode('ascii')
+    n = 0
+    for ch in s.encode('ascii'):
+        try:
+            n = n * 58 + _INDEX[ch]
+        except KeyError:
+            raise ValueError("Invalid base58 character: {!r}".format(chr(ch)))
+    full = n.to_bytes((n.bit_length() + 7) // 8, 'big') if n else b''
+    pad = 0
+    for ch in s:
+        if ch == '1':
+            pad += 1
+        else:
+            break
+    return b'\x00' * pad + full
+
+
+def is_b58(s, length: int = None) -> bool:
+    try:
+        raw = b58decode(s)
+    except Exception:
+        return False
+    return length is None or len(raw) == length

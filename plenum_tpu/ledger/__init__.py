@@ -1,0 +1,4 @@
+from plenum_tpu.ledger.tree_hasher import TreeHasher  # noqa: F401
+from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree  # noqa: F401
+from plenum_tpu.ledger.merkle_verifier import MerkleVerifier  # noqa: F401
+from plenum_tpu.ledger.ledger import Ledger  # noqa: F401

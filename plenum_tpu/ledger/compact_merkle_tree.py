@@ -1,0 +1,186 @@
+"""Append-only Certificate-Transparency-style merkle tree with O(log n)
+frontier state, inclusion & consistency proofs.
+
+Reference: ledger/compact_merkle_tree.py:13 — same capabilities, new design:
+full aligned subtrees are persisted by (start, height) in the HashStore, so
+`merkle_tree_hash(start, end)` resolves any range in O(log² n) lookups and
+the RFC 6962 proof algorithms (§2.1.1/§2.1.2) read straight from storage.
+Batched audit-path generation for catchup rides the TreeHasher TPU seam.
+"""
+from typing import List, Optional, Sequence, Tuple
+
+from plenum_tpu.ledger.hash_store import HashStore, MemoryHashStore, NullHashStore
+from plenum_tpu.ledger.tree_hasher import TreeHasher, _largest_pow2_lt
+
+
+class CompactMerkleTree:
+    def __init__(self, hasher: TreeHasher = None,
+                 hash_store: HashStore = None):
+        self.hasher = hasher or TreeHasher()
+        self.hash_store = hash_store if hash_store is not None \
+            else MemoryHashStore()
+        self._size = 0
+        # frontier: maximal full subtrees, descending height,
+        # entries (start, height, hash)
+        self._frontier: List[Tuple[int, int, bytes]] = []
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def tree_size(self) -> int:
+        return self._size
+
+    def __len__(self):
+        return self._size
+
+    @property
+    def hashes(self) -> Tuple[bytes, ...]:
+        return tuple(h for _, _, h in self._frontier)
+
+    @property
+    def root_hash(self) -> bytes:
+        if not self._frontier:
+            return self.hasher.hash_empty()
+        accum = self._frontier[-1][2]
+        for _, _, h in reversed(self._frontier[:-1]):
+            accum = self.hasher.hash_children(h, accum)
+        return accum
+
+    @property
+    def root_hash_hex(self) -> str:
+        return self.root_hash.hex()
+
+    # ---------------------------------------------------------- appends
+
+    def append(self, new_leaf: bytes) -> List[bytes]:
+        """Append a raw leaf entry; returns the audit path of the appended
+        leaf in the resulting tree (the pre-merge frontier, smallest subtree
+        first) — same contract as the reference's append."""
+        return self._append_hash(self.hasher.hash_leaf(new_leaf))
+
+    def _append_hash(self, leaf_hash: bytes) -> List[bytes]:
+        audit_path = [h for _, _, h in reversed(self._frontier)]
+        index = self._size
+        self.hash_store.write_leaf(index, leaf_hash)
+        entry = (index, 0, leaf_hash)
+        while self._frontier and self._frontier[-1][1] == entry[1]:
+            s, h, left = self._frontier.pop()
+            merged = self.hasher.hash_children(left, entry[2])
+            entry = (s, h + 1, merged)
+            self.hash_store.write_subtree(s, h + 1, merged)
+        self._frontier.append(entry)
+        self._size += 1
+        return audit_path
+
+    def extend(self, new_leaves: Sequence[bytes]):
+        """Batched append: leaf hashing goes through the TPU seam."""
+        for leaf_hash in self.hasher.hash_leaves(list(new_leaves)):
+            self._append_hash(leaf_hash)
+
+    def __copy__(self):
+        other = CompactMerkleTree(self.hasher, NullHashStore())
+        other._size = self._size
+        other._frontier = list(self._frontier)
+        return other
+
+    def copy_shadow(self) -> 'CompactMerkleTree':
+        """A root-only copy for uncommitted staging (no proof support)."""
+        return self.__copy__()
+
+    # ------------------------------------------------------ range hashes
+
+    def merkle_tree_hash(self, start: int, end: int) -> bytes:
+        """MTH over leaves [start, end) (0-based, end exclusive)."""
+        if not 0 <= start <= end <= self._size:
+            raise IndexError("{}..{} outside tree of size {}"
+                             .format(start, end, self._size))
+        return self._mth(start, end)
+
+    def _mth(self, start: int, end: int) -> bytes:
+        width = end - start
+        if width == 0:
+            return self.hasher.hash_empty()
+        if width == 1:
+            return self.hash_store.read_leaf(start)
+        # full aligned subtree? look it up
+        if width & (width - 1) == 0 and start % width == 0:
+            h = width.bit_length() - 1
+            stored = self.hash_store.read_subtree(start, h)
+            if stored is not None:
+                return stored
+        k = _largest_pow2_lt(width)
+        return self.hasher.hash_children(self._mth(start, start + k),
+                                         self._mth(start + k, end))
+
+    # ----------------------------------------------------------- proofs
+
+    def inclusion_proof(self, m: int, n: int) -> List[bytes]:
+        """Audit path for leaf index m in the size-n prefix tree
+        (RFC 6962 §2.1.1 PATH(m, D[0:n]))."""
+        if not 0 <= m < n <= self._size:
+            raise IndexError("invalid inclusion proof request ({}, {}) "
+                             "for size {}".format(m, n, self._size))
+        return self._path(m, 0, n)
+
+    def _path(self, m: int, start: int, end: int) -> List[bytes]:
+        n = end - start
+        if n <= 1:
+            return []
+        k = _largest_pow2_lt(n)
+        if m - start < k:
+            return self._path(m, start, start + k) + [self._mth(start + k, end)]
+        return self._path(m, start + k, end) + [self._mth(start, start + k)]
+
+    def consistency_proof(self, first: int, second: int) -> List[bytes]:
+        """PROOF(m, D[0:n]) (RFC 6962 §2.1.2) that size-`first` tree is a
+        prefix of the size-`second` tree."""
+        if not 0 < first <= second <= self._size:
+            raise IndexError("invalid consistency proof request ({}, {}) "
+                             "for size {}".format(first, second, self._size))
+        return self._subproof(first, 0, second, True)
+
+    def _subproof(self, m: int, start: int, end: int, complete: bool) -> List[bytes]:
+        n = end - start
+        if m == n:
+            return [] if complete else [self._mth(start, end)]
+        k = _largest_pow2_lt(n)
+        if m <= k:
+            return self._subproof(m, start, start + k, complete) + \
+                [self._mth(start + k, end)]
+        return self._subproof(m - k, start + k, end, False) + \
+            [self._mth(start, start + k)]
+
+    # --------------------------------------------------------- recovery
+
+    def load_from_hash_store(self, tree_size: int):
+        """Rebuild the frontier for `tree_size` from persisted subtree
+        hashes (reference recoverTreeFromHashStore)."""
+        self._frontier = []
+        self._size = tree_size
+        start = 0
+        remaining = tree_size
+        while remaining > 0:
+            h = remaining.bit_length() - 1
+            width = 1 << h
+            if h == 0:
+                node = self.hash_store.read_leaf(start)
+            else:
+                node = self.hash_store.read_subtree(start, h)
+                if node is None:
+                    raise ValueError("hash store missing subtree ({}, {})"
+                                     .format(start, h))
+            self._frontier.append((start, h, node))
+            start += width
+            remaining -= width
+
+    def verify_consistency(self, expected_leaf_count: int) -> bool:
+        return self.hash_store.leaf_count >= expected_leaf_count
+
+    def reset(self):
+        self._size = 0
+        self._frontier = []
+        self.hash_store.reset()
+
+    def __repr__(self):
+        return "CompactMerkleTree(size={}, root={})".format(
+            self._size, self.root_hash.hex()[:16])

@@ -1,0 +1,243 @@
+"""Ledger: merkle-hashed append-only transaction log with uncommitted
+staging for 3PC apply/revert.
+
+Reference: ledger/ledger.py:17 (base) + plenum/common/ledger.py (staging
+subclass) — merged into one class here. Txns are msgpack-serialized into an
+int-keyed KV store; each committed txn's leaf hash feeds the
+CompactMerkleTree; uncommitted txns extend a shadow tree (root-only) so
+state roots for PRE-PREPARE are available before commit.
+"""
+from typing import Callable, Dict, Generator, List, Optional, Tuple
+
+from plenum_tpu.common.serializers.base58 import b58decode, b58encode
+from plenum_tpu.common.serializers.serialization import ledger_txn_serializer
+from plenum_tpu.common.txn_util import get_seq_no, append_txn_metadata
+from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+from plenum_tpu.ledger.hash_store import KVHashStore, MemoryHashStore
+from plenum_tpu.ledger.tree_hasher import TreeHasher
+from plenum_tpu.storage.kv_store import KeyValueStorage
+from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+
+SEQ_NO_PAD = 20
+
+
+def _seq_key(seq_no: int) -> bytes:
+    return str(seq_no).zfill(SEQ_NO_PAD).encode()
+
+
+class Ledger:
+    def __init__(self,
+                 tree: CompactMerkleTree = None,
+                 txn_store: KeyValueStorage = None,
+                 txn_serializer=None,
+                 genesis_txn_initiator=None,
+                 tree_hasher: TreeHasher = None):
+        hasher = tree_hasher or TreeHasher()
+        self.tree = tree or CompactMerkleTree(hasher, MemoryHashStore())
+        self.hasher = self.tree.hasher
+        self._store = txn_store if txn_store is not None \
+            else KeyValueStorageInMemory()
+        self.txn_serializer = txn_serializer or ledger_txn_serializer
+        self.genesis_txn_initiator = genesis_txn_initiator
+        self.seqNo = 0
+        # uncommitted staging (reference plenum/common/ledger.py)
+        self.uncommittedTxns: List[dict] = []
+        self.uncommittedTree: Optional[CompactMerkleTree] = None
+        self.uncommittedRootHash: Optional[bytes] = None
+        self.recoverTree()
+        if self.size == 0 and genesis_txn_initiator is not None:
+            for txn in genesis_txn_initiator():
+                self.add(txn)
+
+    # --------------------------------------------------------- recovery
+
+    def recoverTree(self):
+        """Rebuild tree state from the txn store (reference ledger.py:70)."""
+        count = sum(1 for _ in self._store.iterator(include_value=False))
+        if count == 0:
+            self.seqNo = 0
+            return
+        try:
+            self.tree.load_from_hash_store(count)
+            self.seqNo = count
+        except Exception:
+            self.recoverTreeFromTxnLog()
+
+    def recoverTreeFromTxnLog(self):
+        self.tree.reset()
+        self.seqNo = 0
+        for _, value in self._store.iterator():
+            self.tree.append(bytes(value))
+            self.seqNo += 1
+
+    # ---------------------------------------------------------- commits
+
+    def add(self, txn: dict) -> dict:
+        """Append a committed txn; returns merkle info (seqNo, rootHash,
+        auditPath) (reference ledger.py:115)."""
+        seq_no = self.seqNo + 1
+        append_txn_metadata(txn, seq_no=seq_no)
+        serialized = self.serialize_for_tree(txn)
+        audit_path = self.tree.append(serialized)
+        self._store.put(_seq_key(seq_no), serialized)
+        self.seqNo = seq_no
+        return {
+            'seqNo': seq_no,
+            'rootHash': self.hashToStr(self.tree.root_hash),
+            'auditPath': [self.hashToStr(h) for h in audit_path],
+        }
+
+    append = add
+
+    # ----------------------------------------------- uncommitted staging
+
+    def append_txns_metadata(self, txns: List[dict], txn_time: int = None):
+        for i, txn in enumerate(txns):
+            seq_no = self.uncommitted_size + i + 1
+            append_txn_metadata(txn, seq_no=seq_no, txn_time=txn_time)
+        return txns
+
+    def appendTxns(self, txns: List[dict]) -> Tuple[Tuple[int, int], List[dict]]:
+        """Stage txns: extend the shadow tree, track uncommitted root.
+        Returns ((start, end), txns)."""
+        if self.uncommittedTree is None:
+            self.uncommittedTree = self.tree.copy_shadow()
+        first = self.uncommitted_size + 1
+        for txn in txns:
+            self.uncommittedTree._append_hash(
+                self.hasher.hash_leaf(self.serialize_for_tree(txn)))
+        self.uncommittedTxns.extend(txns)
+        self.uncommittedRootHash = self.uncommittedTree.root_hash
+        last = self.uncommitted_size
+        return (first, last), txns
+
+    def commitTxns(self, count: int) -> Tuple[Tuple[int, int], List[dict]]:
+        """Move the oldest `count` uncommitted txns into the durable log +
+        real tree (reference plenum/common/ledger.py commitTxns)."""
+        committed = []
+        first = self.seqNo + 1
+        for txn in self.uncommittedTxns[:count]:
+            self.add(txn)
+            committed.append(txn)
+        self.uncommittedTxns = self.uncommittedTxns[count:]
+        if not self.uncommittedTxns:
+            self.uncommittedTree = None
+            self.uncommittedRootHash = None
+        else:
+            # rebuild shadow from the committed tree + remaining staged txns
+            remaining = self.uncommittedTxns
+            self.uncommittedTxns = []
+            self.uncommittedTree = None
+            self.appendTxns(remaining)
+        return (first, self.seqNo), committed
+
+    def discardTxns(self, count: int):
+        """Drop the newest `count` uncommitted txns (batch revert)."""
+        remaining = self.uncommittedTxns[:-count] if count else self.uncommittedTxns
+        self.uncommittedTxns = []
+        self.uncommittedTree = None
+        self.uncommittedRootHash = None
+        if remaining:
+            self.appendTxns(remaining)
+
+    @property
+    def uncommitted_size(self) -> int:
+        return self.seqNo + len(self.uncommittedTxns)
+
+    @property
+    def uncommitted_root_hash(self) -> bytes:
+        if self.uncommittedRootHash is not None:
+            return self.uncommittedRootHash
+        return self.tree.root_hash
+
+    # ------------------------------------------------------------ reads
+
+    def getBySeqNo(self, seq_no: int) -> Optional[dict]:
+        try:
+            raw = self._store.get(_seq_key(seq_no))
+        except KeyError:
+            return None
+        return self.txn_serializer.deserialize(raw)
+
+    def get_by_seq_no_uncommitted(self, seq_no: int) -> Optional[dict]:
+        if seq_no <= self.seqNo:
+            return self.getBySeqNo(seq_no)
+        idx = seq_no - self.seqNo - 1
+        if idx < len(self.uncommittedTxns):
+            return self.uncommittedTxns[idx]
+        return None
+
+    def __getitem__(self, seq_no: int):
+        return self.getBySeqNo(seq_no)
+
+    def getAllTxn(self, frm: int = None, to: int = None
+                  ) -> Generator[Tuple[int, dict], None, None]:
+        start = _seq_key(frm) if frm is not None else None
+        end = _seq_key(to) if to is not None else None
+        for key, value in self._store.iterator(start=start, end=end):
+            yield int(key), self.txn_serializer.deserialize(value)
+
+    def get_last_txn(self) -> Optional[dict]:
+        return self.getBySeqNo(self.seqNo) if self.seqNo else None
+
+    def get_last_committed_txn(self) -> Optional[dict]:
+        return self.get_last_txn()
+
+    @property
+    def size(self) -> int:
+        return self.seqNo
+
+    def __len__(self):
+        return self.size
+
+    @property
+    def root_hash(self) -> str:
+        return self.hashToStr(self.tree.root_hash)
+
+    @property
+    def root_hash_raw(self) -> bytes:
+        return self.tree.root_hash
+
+    # ------------------------------------------------------------ proofs
+
+    def merkleInfo(self, seq_no: int) -> Dict:
+        """Inclusion proof of txn `seq_no` in the current tree (reference
+        ledger.py:196)."""
+        if not 0 < seq_no <= self.seqNo:
+            raise ValueError("invalid seqNo {}".format(seq_no))
+        path = self.tree.inclusion_proof(seq_no - 1, self.seqNo)
+        return {
+            'seqNo': seq_no,
+            'rootHash': self.hashToStr(self.tree.root_hash),
+            'auditPath': [self.hashToStr(h) for h in path],
+        }
+
+    auditProof = merkleInfo
+
+    # -------------------------------------------------------------- util
+
+    def serialize_for_tree(self, txn: dict) -> bytes:
+        return self.txn_serializer.serialize(txn)
+
+    @staticmethod
+    def hashToStr(h: bytes) -> str:
+        return b58encode(h)
+
+    @staticmethod
+    def strToHash(s: str) -> bytes:
+        return b58decode(s)
+
+    def start(self, loop=None):
+        pass
+
+    def stop(self):
+        self._store.close()
+        self.tree.hash_store.close()
+
+    def reset(self):
+        self.tree.reset()
+        self._store.drop()
+        self.seqNo = 0
+        self.uncommittedTxns = []
+        self.uncommittedTree = None
+        self.uncommittedRootHash = None

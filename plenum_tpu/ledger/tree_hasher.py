@@ -1,0 +1,61 @@
+"""RFC 6962 domain-separated SHA-256 hashing for the merkle transaction log.
+
+Reference: ledger/tree_hasher.py:7 — leaf hash H(0x00||data), node hash
+H(0x01||left||right). THE hot hash path (SURVEY.md §2.6): the batch entry
+points below are the TPU seam — `hash_leaves` / `hash_node_pairs` route to
+the JAX SHA-256 kernel (plenum_tpu.ops.sha256) above a configurable batch
+threshold, with the C-backed hashlib loop as the scalar floor.
+"""
+import hashlib
+from typing import List, Sequence, Tuple
+
+
+class TreeHasher:
+    def __init__(self, hashfunc=hashlib.sha256, batch_backend=None,
+                 batch_threshold: int = 256):
+        self.hashfunc = hashfunc
+        # batch_backend: object with leaf_hashes(list[bytes])->list[bytes]
+        # and node_hashes(list[(l,r)])->list[bytes]; see ops/sha256.py
+        self._batch_backend = batch_backend
+        self._batch_threshold = batch_threshold
+
+    def hash_empty(self) -> bytes:
+        return self.hashfunc().digest()
+
+    def hash_leaf(self, data: bytes) -> bytes:
+        return self.hashfunc(b"\x00" + data).digest()
+
+    def hash_children(self, left: bytes, right: bytes) -> bytes:
+        return self.hashfunc(b"\x01" + left + right).digest()
+
+    # ---- batch entry points (TPU seam) ----
+
+    def hash_leaves(self, datas: Sequence[bytes]) -> List[bytes]:
+        if (self._batch_backend is not None
+                and len(datas) >= self._batch_threshold):
+            return self._batch_backend.leaf_hashes(datas)
+        return [self.hash_leaf(d) for d in datas]
+
+    def hash_node_pairs(self, pairs: Sequence[Tuple[bytes, bytes]]) -> List[bytes]:
+        if (self._batch_backend is not None
+                and len(pairs) >= self._batch_threshold):
+            return self._batch_backend.node_hashes(pairs)
+        return [self.hash_children(l, r) for l, r in pairs]
+
+    # ---- whole-tree hashing (used by verifier and tests) ----
+
+    def hash_full_tree(self, leaves: Sequence[bytes]) -> bytes:
+        """MTH over a list of raw leaf entries (RFC 6962 §2.1)."""
+        n = len(leaves)
+        if n == 0:
+            return self.hash_empty()
+        if n == 1:
+            return self.hash_leaf(leaves[0])
+        k = _largest_pow2_lt(n)
+        return self.hash_children(self.hash_full_tree(leaves[:k]),
+                                  self.hash_full_tree(leaves[k:]))
+
+
+def _largest_pow2_lt(n: int) -> int:
+    """Largest power of two strictly less than n (n >= 2)."""
+    return 1 << ((n - 1).bit_length() - 1)

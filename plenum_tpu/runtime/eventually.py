@@ -36,8 +36,12 @@ async def eventually(coro_func: Callable, *args,
 
 async def eventuallyAll(*coro_funcs, total_timeout: float = 10.0,
                         retry_wait: float = 0.1):
-    per = total_timeout / max(1, len(coro_funcs))
+    """Each check gets whatever remains of the shared budget (reference
+    eventually.py:50) — one slow check may use most of it."""
+    deadline = time.perf_counter() + total_timeout
     results = []
     for f in coro_funcs:
-        results.append(await eventually(f, retry_wait=retry_wait, timeout=per))
+        remaining = max(0.001, deadline - time.perf_counter())
+        results.append(await eventually(f, retry_wait=retry_wait,
+                                        timeout=remaining))
     return results

@@ -20,9 +20,12 @@ class Looper:
     def __init__(self, prodables: Optional[List[Prodable]] = None,
                  loop: Optional[asyncio.AbstractEventLoop] = None,
                  autoStart: bool = True):
-        self.prodables: List[Prodable] = list(prodables) if prodables else []
+        self.prodables: List[Prodable] = []
         self.loop = loop or self._new_loop()
         self.protected_loop = loop is not None
+        for p in (prodables or []):
+            self.prodables.append(p)
+            p.start(self.loop)
         self.running = True
         # larger sleep when nothing happened, to not spin the CPU
         # (reference looper.py:200-218)
@@ -37,7 +40,10 @@ class Looper:
 
     def _new_loop(self):
         try:
-            return asyncio.get_event_loop()
+            loop = asyncio.get_event_loop()
+            if loop.is_closed():
+                raise RuntimeError("closed")
+            return loop
         except RuntimeError:
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)

@@ -7,10 +7,13 @@ Reference: plenum/common/stashing_router.py:93 (StashingRouter),
 owner signals the relevant state change via process_all_stashed/
 process_stashed_until_first_restash.
 """
+import logging
 from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple, Type
 
 from sortedcontainers import SortedList
+
+logger = logging.getLogger(__name__)
 
 PROCESS = 0
 DISCARD = 1
@@ -107,7 +110,11 @@ class StashingRouter:
             else:
                 stash = UnsortedStash(self._limit)
             self._stashes[key] = stash
-        stash.push((message, *args))
+        if not stash.push((message, *args)):
+            logger.warning("Cannot stash %s with code %s: stash is full "
+                           "(limit %s) — dropping", type(message).__name__,
+                           code, self._limit)
+            self.discard(message, "stash overflow")
 
     def discard(self, message, reason):
         pass  # subclass/metric hook
@@ -139,9 +146,13 @@ class StashingRouter:
 
     def _resolve_and_process(self, item) -> bool:
         message, *args = item
+        # an unstash_handler REPLACES processing — it re-routes the message
+        # into the owner's inbox for handling on the next tick (reference
+        # stashing_router.py:193-197); the two paths are mutually exclusive
+        if self._unstash_handler is not None:
+            self._unstash_handler(message)
+            return True
         handler = self._handlers.get(type(message))
         if handler is None:
             return True
-        if self._unstash_handler is not None:
-            self._unstash_handler(message)
         return self._process(handler, message, *args)

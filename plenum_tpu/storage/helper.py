@@ -1,0 +1,24 @@
+"""KV storage factory (reference: storage/helper.py:20 initKeyValueStorage)."""
+from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+from plenum_tpu.storage.kv_file import KeyValueStorageFile
+
+
+_BACKENDS = {
+    'memory': lambda d, n, **kw: KeyValueStorageInMemory(),
+    'file': KeyValueStorageFile,
+}
+
+try:
+    from plenum_tpu.storage.native import NativeKVStore  # noqa
+    _BACKENDS['native'] = NativeKVStore
+except ImportError:
+    pass
+
+
+def initKeyValueStorage(storage_type: str, data_dir: str, db_name: str,
+                        read_only: bool = False, **kwargs):
+    backend = _BACKENDS.get(storage_type)
+    if backend is None:
+        raise ValueError("unknown storage type {}".format(storage_type))
+    return backend(data_dir, db_name, read_only=read_only, **kwargs) \
+        if storage_type != 'memory' else backend(data_dir, db_name)

@@ -1,0 +1,56 @@
+"""In-memory KV store (reference: storage/kv_in_memory.py)."""
+from typing import Iterable, Tuple
+
+from sortedcontainers import SortedDict
+
+from plenum_tpu.storage.kv_store import KeyValueStorage, to_bytes
+
+
+class KeyValueStorageInMemory(KeyValueStorage):
+    def __init__(self, *args, **kwargs):
+        self._dict = SortedDict()
+        self._closed = False
+
+    def put(self, key, value):
+        self._dict[to_bytes(key)] = to_bytes(value)
+
+    def get(self, key) -> bytes:
+        return self._dict[to_bytes(key)]
+
+    def remove(self, key):
+        self._dict.pop(to_bytes(key), None)
+
+    def setBatch(self, batch: Iterable[Tuple]):
+        for key, value in batch:
+            self.put(key, value)
+
+    def do_ops_in_batch(self, batch: Iterable[Tuple]):
+        for op, key, *rest in batch:
+            if op == 'put':
+                self.put(key, rest[0])
+            elif op == 'remove':
+                self.remove(key)
+            else:
+                raise ValueError("unknown batch op {}".format(op))
+
+    def iterator(self, start=None, end=None, include_value=True):
+        start = to_bytes(start) if start is not None else None
+        end = to_bytes(end) if end is not None else None
+        keys = self._dict.irange(minimum=start, maximum=end)
+        if include_value:
+            return ((k, self._dict[k]) for k in keys)
+        return iter(list(keys))
+
+    def drop(self):
+        self._dict.clear()
+
+    def close(self):
+        self._closed = True
+
+    @property
+    def closed(self):
+        return self._closed
+
+    @property
+    def size(self):
+        return len(self._dict)

@@ -1,0 +1,294 @@
+"""Rung-1 tests: KV stores, merkle tree, proofs, ledger staging
+(reference: ledger/test/, storage/test/)."""
+import copy
+import hashlib
+import pytest
+
+from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+from plenum_tpu.storage.kv_file import KeyValueStorageFile
+from plenum_tpu.storage.optimistic_kv_store import OptimisticKVStore
+from plenum_tpu.ledger.tree_hasher import TreeHasher
+from plenum_tpu.ledger.hash_store import MemoryHashStore, KVHashStore
+from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+from plenum_tpu.ledger.merkle_verifier import MerkleVerifier, ProofError
+from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.ledger.genesis_txn import GenesisTxnInitiatorFromMem
+
+H = TreeHasher()
+V = MerkleVerifier(H)
+LEAVES = [f"leaf-{i}".encode() for i in range(257)]
+
+
+@pytest.mark.parametrize("kv_cls", ["memory", "file"])
+def test_kv_store_basics(kv_cls, tdir):
+    kv = KeyValueStorageInMemory() if kv_cls == "memory" \
+        else KeyValueStorageFile(tdir, "test")
+    kv.put(b'a', b'1')
+    kv.put('b', '2')
+    assert kv.get('a') == b'1'
+    assert kv.get(b'b') == b'2'
+    kv.setBatch([(b'c', b'3'), (b'd', b'4')])
+    assert [(k, v) for k, v in kv.iterator()] == \
+        [(b'a', b'1'), (b'b', b'2'), (b'c', b'3'), (b'd', b'4')]
+    assert list(kv.iterator(start=b'b', end=b'c', include_value=False)) == [b'b', b'c']
+    kv.remove('a')
+    assert not kv.has_key('a')
+    assert kv.size == 3
+    kv.do_ops_in_batch([('put', b'e', b'5'), ('remove', b'b')])
+    assert kv.has_key('e') and not kv.has_key('b')
+    kv.close()
+
+
+def test_kv_file_durability(tdir):
+    kv = KeyValueStorageFile(tdir, "dur")
+    for i in range(100):
+        kv.put(str(i), f"value-{i}")
+    kv.remove("50")
+    kv.close()
+    kv2 = KeyValueStorageFile(tdir, "dur")
+    assert kv2.size == 99
+    assert kv2.get("51") == b"value-51"
+    assert not kv2.has_key("50")
+    kv2.compact()
+    assert kv2.get("99") == b"value-99"
+    kv2.close()
+
+
+def test_kv_file_torn_tail_recovery(tdir):
+    kv = KeyValueStorageFile(tdir, "torn")
+    kv.put("k1", "v1")
+    kv.put("k2", "v2")
+    kv.close()
+    path = f"{tdir}/torn.kvlog"
+    with open(path, 'ab') as fh:
+        fh.write(b'\x05\x00\x00\x00\x10\x00')  # torn record
+    kv2 = KeyValueStorageFile(tdir, "torn")
+    assert kv2.size == 2 and kv2.get("k2") == b"v2"
+    kv2.put("k3", "v3")
+    kv2.close()
+    kv3 = KeyValueStorageFile(tdir, "torn")
+    assert kv3.size == 3
+
+
+def test_optimistic_kv_store():
+    kv = KeyValueStorageInMemory()
+    opt = OptimisticKVStore(kv)
+    opt.set(b'x', b'1')
+    assert opt.get(b'x') == b'1'
+    with pytest.raises(KeyError):
+        opt.get(b'x', is_committed=True)
+    opt.create_batch_from_current()
+    opt.set(b'y', b'2')
+    opt.create_batch_from_current()
+    opt.commit_batch()
+    assert kv.get(b'x') == b'1'
+    assert not kv.has_key(b'y')
+    opt.reject_batch()
+    assert opt.un_committed_count == 0
+    with pytest.raises(KeyError):
+        opt.get(b'y')
+
+
+def test_tree_hasher_rfc6962_vectors():
+    # RFC 6962 test vectors (empty tree & single leaf)
+    assert H.hash_empty().hex() == \
+        'e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855'
+    assert H.hash_leaf(b'').hex() == \
+        '6e340b9cffb37a989ca544e6bb780a2c78901d3fb33738768511a30617afa01d'
+    # known CT vector: MTH of d0..d7 from RFC 6962 §2.1.3 test tree
+    # (we check self-consistency instead: full tree == incremental tree)
+    t = CompactMerkleTree(H)
+    for leaf in LEAVES[:7]:
+        t.append(leaf)
+    assert t.root_hash == H.hash_full_tree(LEAVES[:7])
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 8, 13, 64, 100, 257])
+def test_tree_roots_match_full_hash(n):
+    t = CompactMerkleTree(H)
+    for leaf in LEAVES[:n]:
+        t.append(leaf)
+    assert t.tree_size == n
+    assert t.root_hash == H.hash_full_tree(LEAVES[:n])
+
+
+def test_inclusion_proofs_all_positions():
+    t = CompactMerkleTree(H)
+    for leaf in LEAVES[:100]:
+        t.append(leaf)
+    root = t.root_hash
+    for m in range(100):
+        path = t.inclusion_proof(m, 100)
+        assert V.verify_leaf_inclusion(LEAVES[m], m, path, 100, root)
+        assert len(path) == V.audit_path_length(m, 100)
+    # historical tree proofs
+    old_root = t.merkle_tree_hash(0, 50)
+    path = t.inclusion_proof(30, 50)
+    assert V.verify_leaf_inclusion(LEAVES[30], 30, path, 50, old_root)
+    # bad proof fails
+    with pytest.raises(ProofError):
+        V.verify_leaf_inclusion(LEAVES[1], 0, t.inclusion_proof(0, 100),
+                                100, root)
+
+
+def test_append_returns_audit_path_of_new_leaf():
+    t = CompactMerkleTree(H)
+    for i, leaf in enumerate(LEAVES[:40]):
+        path = t.append(leaf)
+        assert V.verify_leaf_inclusion(leaf, i, path, i + 1, t.root_hash)
+
+
+@pytest.mark.parametrize("first,second", [
+    (1, 1), (1, 2), (1, 100), (2, 3), (3, 7), (4, 7), (8, 8), (50, 100),
+    (64, 257), (100, 257), (256, 257)])
+def test_consistency_proofs(first, second):
+    t = CompactMerkleTree(H)
+    roots = {}
+    for i, leaf in enumerate(LEAVES[:second]):
+        t.append(leaf)
+        roots[i + 1] = t.root_hash
+    proof = t.consistency_proof(first, second)
+    assert V.verify_tree_consistency(first, second, roots[first],
+                                     roots[second], proof)
+
+
+def test_consistency_proof_rejects_forgery():
+    t = CompactMerkleTree(H)
+    for leaf in LEAVES[:10]:
+        t.append(leaf)
+    r10 = t.root_hash
+    r5 = t.merkle_tree_hash(0, 5)
+    proof = t.consistency_proof(5, 10)
+    with pytest.raises(ProofError):
+        V.verify_tree_consistency(5, 10, r10, r10, proof)
+    bad = [hashlib.sha256(b'x').digest()] + list(proof[1:])
+    with pytest.raises(ProofError):
+        V.verify_tree_consistency(5, 10, r5, r10, bad)
+
+
+def test_tree_recovery_from_hash_store():
+    store = MemoryHashStore()
+    t = CompactMerkleTree(H, store)
+    for leaf in LEAVES[:37]:
+        t.append(leaf)
+    t2 = CompactMerkleTree(H, store)
+    t2.load_from_hash_store(37)
+    assert t2.root_hash == t.root_hash
+    assert t2.hashes == t.hashes
+    t2.append(LEAVES[37])
+    t.append(LEAVES[37])
+    assert t2.root_hash == t.root_hash
+
+
+def test_kv_hash_store(tdir):
+    kv = KeyValueStorageInMemory()
+    store = KVHashStore(kv)
+    t = CompactMerkleTree(H, store)
+    for leaf in LEAVES[:20]:
+        t.append(leaf)
+    store2 = KVHashStore(kv)
+    assert store2.leaf_count == 20
+    t2 = CompactMerkleTree(H, store2)
+    t2.load_from_hash_store(20)
+    assert t2.root_hash == t.root_hash
+    assert t2.inclusion_proof(7, 20) == t.inclusion_proof(7, 20)
+
+
+def _txn(i):
+    return {'txn': {'type': '1', 'data': {'k': 'v%d' % i}, 'metadata': {}},
+            'txnMetadata': {}, 'reqSignature': {}, 'ver': '1'}
+
+
+def test_ledger_add_and_proofs():
+    ledger = Ledger()
+    infos = [ledger.add(_txn(i)) for i in range(10)]
+    assert ledger.size == 10
+    assert infos[9]['seqNo'] == 10
+    txn5 = ledger.getBySeqNo(5)
+    assert txn5['txn']['data']['k'] == 'v4'
+    assert txn5['txnMetadata']['seqNo'] == 5
+    mi = ledger.merkleInfo(5)
+    leaf = ledger.serialize_for_tree(ledger.getBySeqNo(5))
+    assert V.verify_leaf_inclusion(
+        leaf, 4, [Ledger.strToHash(p) for p in mi['auditPath']],
+        10, Ledger.strToHash(mi['rootHash']))
+    assert list(ledger.getAllTxn(2, 4))[0][0] == 2
+    assert len(list(ledger.getAllTxn())) == 10
+
+
+def test_ledger_uncommitted_staging():
+    ledger = Ledger()
+    ledger.add(_txn(0))
+    committed_root = ledger.root_hash_raw
+    (s, e), _ = ledger.appendTxns(ledger.append_txns_metadata(
+        [_txn(1), _txn(2)], txn_time=1600000000))
+    assert (s, e) == (2, 3)
+    assert ledger.uncommitted_size == 3
+    assert ledger.size == 1
+    assert ledger.uncommitted_root_hash != committed_root
+    staged_root = ledger.uncommitted_root_hash
+    # revert
+    ledger.discardTxns(2)
+    assert ledger.uncommitted_size == 1
+    assert ledger.uncommitted_root_hash == committed_root
+    # stage again and commit: committed tree root equals staged root
+    ledger.appendTxns(ledger.append_txns_metadata(
+        [_txn(1), _txn(2)], txn_time=1600000000))
+    (f, l), txns = ledger.commitTxns(2)
+    assert (f, l) == (2, 3) and len(txns) == 2
+    assert ledger.root_hash_raw == staged_root
+    assert ledger.uncommitted_size == ledger.size == 3
+
+
+def test_ledger_partial_commit():
+    ledger = Ledger()
+    ledger.appendTxns(ledger.append_txns_metadata(
+        [_txn(i) for i in range(5)], txn_time=1600000000))
+    ledger.commitTxns(2)
+    assert ledger.size == 2
+    assert ledger.uncommitted_size == 5
+    assert len(ledger.uncommittedTxns) == 3
+    ledger.commitTxns(3)
+    assert ledger.size == 5
+    # identical txns staged+committed in one go give the same root
+    full = Ledger()
+    full.appendTxns(full.append_txns_metadata(
+        [_txn(i) for i in range(5)], txn_time=1600000000))
+    full.commitTxns(5)
+    assert full.root_hash == ledger.root_hash
+
+
+def test_ledger_durability_and_recovery(tdir):
+    from plenum_tpu.storage.kv_file import KeyValueStorageFile
+    store = KeyValueStorageFile(tdir, "txnlog")
+    hs_kv = KeyValueStorageFile(tdir, "hashes")
+    ledger = Ledger(tree=CompactMerkleTree(H, KVHashStore(hs_kv)),
+                    txn_store=store)
+    for i in range(25):
+        ledger.add(_txn(i))
+    root = ledger.root_hash
+    ledger.stop()
+    store2 = KeyValueStorageFile(tdir, "txnlog")
+    hs_kv2 = KeyValueStorageFile(tdir, "hashes")
+    ledger2 = Ledger(tree=CompactMerkleTree(H, KVHashStore(hs_kv2)),
+                     txn_store=store2)
+    assert ledger2.size == 25
+    assert ledger2.root_hash == root
+    ledger2.add(_txn(25))
+    assert ledger2.size == 26
+    ledger2.stop()
+
+
+def test_ledger_genesis():
+    genesis = [_txn(i) for i in range(3)]
+    ledger = Ledger(genesis_txn_initiator=GenesisTxnInitiatorFromMem(genesis))
+    assert ledger.size == 3
+    assert ledger.getBySeqNo(1)['txn']['data']['k'] == 'v0'
+
+
+def test_batch_inclusion_verification():
+    t = CompactMerkleTree(H)
+    for leaf in LEAVES[:64]:
+        t.append(leaf)
+    items = [(LEAVES[i], i, t.inclusion_proof(i, 64)) for i in range(64)]
+    assert V.verify_leaf_inclusion_batch(items, 64, t.root_hash)

@@ -1,0 +1,175 @@
+"""Tests for serializers, field validators, message schemas, request digests,
+txn envelope (reference rung-1: plenum/test/input_validation, common/test)."""
+import pytest
+
+from plenum_tpu.common.serializers.base58 import b58encode, b58decode
+from plenum_tpu.common.serializers.serializers import (
+    MsgPackSerializer, OrderedJsonSerializer)
+from plenum_tpu.common.serializers.serialization import serialize_msg_for_signing
+from plenum_tpu.common.messages import fields
+from plenum_tpu.common.messages.message_base import (
+    MessageBase, MessageValidationError)
+from plenum_tpu.common.messages.node_messages import (
+    PrePrepare, Prepare, Commit, Checkpoint, ViewChange, NewView,
+    LedgerStatus, CatchupReq, CatchupRep, MessageReq, Propagate, Ordered)
+from plenum_tpu.common.messages.message_factory import node_message_factory
+from plenum_tpu.common.request import Request
+from plenum_tpu.common import txn_util
+from plenum_tpu.common.constants import DOMAIN_LEDGER_ID, NYM
+
+ROOT = b58encode(b'\x01' * 32)
+TS = 1600000000
+
+
+def test_base58_roundtrip():
+    for data in [b'', b'\x00', b'\x00\x00abc', bytes(range(32)), b'\xff' * 40]:
+        assert b58decode(b58encode(data)) == data
+    with pytest.raises(ValueError):
+        b58decode('0OIl')  # invalid alphabet chars
+
+
+def test_msgpack_canonical():
+    s = MsgPackSerializer()
+    a = s.serialize({'b': 1, 'a': 2})
+    b = s.serialize({'a': 2, 'b': 1})
+    assert a == b
+    assert s.deserialize(a) == {'a': 2, 'b': 1}
+
+
+def test_json_canonical():
+    s = OrderedJsonSerializer()
+    assert s.serialize({'b': 1, 'a': [1, 2]}) == b'{"a":[1,2],"b":1}'
+
+
+def test_field_validators():
+    assert fields.NonNegativeNumberField().validate(5) is None
+    assert fields.NonNegativeNumberField().validate(-1)
+    assert fields.NonNegativeNumberField().validate(True)
+    assert fields.NonNegativeNumberField().validate("5")
+    assert fields.NonEmptyStringField().validate("x") is None
+    assert fields.NonEmptyStringField().validate("")
+    assert fields.MerkleRootField().validate(ROOT) is None
+    assert fields.MerkleRootField().validate("tooShort")
+    assert fields.TimestampField().validate(TS) is None
+    assert fields.TimestampField().validate(5)
+    assert fields.LedgerIdField().validate(1) is None
+    assert fields.LedgerIdField().validate(9)
+    assert fields.NetworkPortField().validate(9700) is None
+    assert fields.NetworkPortField().validate(70000)
+    assert fields.NetworkIpAddressField().validate('10.0.0.1') is None
+    assert fields.NetworkIpAddressField().validate('0.0.0.0')
+    assert fields.NetworkIpAddressField().validate('256.1.1.1')
+    assert fields.IterableField(fields.NonNegativeNumberField()).validate([1, 2]) is None
+    assert fields.IterableField(fields.NonNegativeNumberField()).validate([1, -2])
+    assert fields.MapField(fields.NonEmptyStringField(),
+                           fields.NonNegativeNumberField()).validate({'a': 1}) is None
+    assert fields.ChooseField(['x', 'y']).validate('x') is None
+    assert fields.ChooseField(['x', 'y']).validate('z')
+    assert fields.HexField(length=4).validate('дЕаД')
+    assert fields.Sha256HexField().validate('a' * 64) is None
+    assert fields.VersionField().validate('1.2.3') is None
+    assert fields.VersionField().validate('1.2.x')
+    assert fields.BatchIDField().validate([0, 0, 1, 'd1']) is None
+    assert fields.BatchIDField().validate([0, 0, 'x', 'd1'])
+    assert fields.BlsMultiSignatureField().validate(
+        ['sig', ['Alpha'], [1, ROOT, ROOT, ROOT, TS]]) is None
+
+
+def test_preprepare_message():
+    pp = PrePrepare(
+        instId=0, viewNo=0, ppSeqNo=1, ppTime=TS,
+        reqIdr=['d1', 'd2'], discarded=0, digest='pp-digest',
+        ledgerId=DOMAIN_LEDGER_ID, stateRootHash=ROOT, txnRootHash=ROOT,
+        sub_seq_no=0, final=False)
+    assert pp.ppSeqNo == 1
+    assert pp.auditTxnRootHash is None
+    d = pp.to_dict()
+    assert d['op'] == 'PREPREPARE'
+    # round-trip through the factory (wire deserialization)
+    pp2 = node_message_factory.get_instance(**d)
+    assert pp2 == pp
+    with pytest.raises(AttributeError):
+        pp.ppSeqNo = 5  # immutable
+
+
+def test_message_validation_errors():
+    with pytest.raises(MessageValidationError):
+        Prepare(instId=0, viewNo=0, ppSeqNo=-1, ppTime=TS, digest='d',
+                stateRootHash=ROOT, txnRootHash=ROOT)
+    with pytest.raises(MessageValidationError):
+        Checkpoint(instId=0, viewNo=0, seqNoStart=0, seqNoEnd=100, digest='')
+    with pytest.raises(MessageValidationError):
+        Commit(instId=0, viewNo=0)  # missing ppSeqNo
+
+
+def test_viewchange_newview():
+    cp = Checkpoint(instId=0, viewNo=0, seqNoStart=0, seqNoEnd=100, digest='cd')
+    vc = ViewChange(viewNo=1, stableCheckpoint=100,
+                    prepared=[[0, 0, 1, 'd1']], preprepared=[[0, 0, 1, 'd1']],
+                    checkpoints=[cp.as_dict()])
+    nv = NewView(viewNo=1, viewChanges=[['Alpha', 'vcd']],
+                 checkpoint=cp.as_dict(), batches=[[0, 0, 1, 'd1']])
+    assert vc.viewNo == 1 and nv.batches == [[0, 0, 1, 'd1']]
+
+
+def test_catchup_messages():
+    ls = LedgerStatus(ledgerId=1, txnSeqNo=10, viewNo=None, ppSeqNo=None,
+                      merkleRoot=ROOT, protocolVersion=2)
+    assert ls.viewNo is None
+    cr = CatchupReq(ledgerId=1, seqNoStart=1, seqNoEnd=5, catchupTill=10)
+    rep = CatchupRep(ledgerId=1, txns={'1': {'txn': {}}}, consProof=[])
+    assert rep.txns['1'] == {'txn': {}}
+    mr = MessageReq(msg_type='PREPREPARE', params={'ppSeqNo': 1})
+    with pytest.raises(MessageValidationError):
+        MessageReq(msg_type='BOGUS', params={})
+
+
+def test_request_digests_stable():
+    op = {'type': NYM, 'dest': 'A' * 22}
+    r1 = Request(identifier='id1', reqId=1, operation=op, signature='sig')
+    r2 = Request(identifier='id1', reqId=1, operation=dict(op), signature='sig')
+    assert r1.digest == r2.digest
+    assert r1.payload_digest == r2.payload_digest
+    # signature does not affect payload digest but does affect full digest
+    r3 = Request(identifier='id1', reqId=1, operation=op, signature='other')
+    assert r3.payload_digest == r1.payload_digest
+    assert r3.digest != r1.digest
+    rt = Request.from_dict(r1.as_dict())
+    assert rt.digest == r1.digest
+
+
+def test_txn_envelope_roundtrip():
+    op = {'type': NYM, 'dest': 'B' * 22, 'verkey': '~' + 'C' * 16}
+    req = Request(identifier='id1', reqId=7, operation=op, signature='s1')
+    txn = txn_util.reqToTxn(req)
+    assert txn_util.get_type(txn) == NYM
+    assert txn_util.get_from(txn) == 'id1'
+    assert txn_util.get_req_id(txn) == 7
+    assert txn_util.get_payload_data(txn)['dest'] == 'B' * 22
+    assert txn_util.get_digest(txn) == req.digest
+    txn_util.append_txn_metadata(txn, seq_no=3, txn_time=TS)
+    assert txn_util.get_seq_no(txn) == 3
+    assert txn_util.get_txn_time(txn) == TS
+    sig = txn_util.get_req_signature(txn)
+    assert sig['values'][0]['value'] == 's1'
+
+
+def test_signing_serialization_deterministic():
+    a = serialize_msg_for_signing({'b': 1, 'a': {'y': 2, 'x': 3}})
+    b = serialize_msg_for_signing({'a': {'x': 3, 'y': 2}, 'b': 1})
+    assert a == b
+
+
+def test_client_message_validator():
+    from plenum_tpu.common.messages.client_request import ClientMessageValidator
+    from plenum_tpu.common.exceptions import InvalidClientRequest
+    v = ClientMessageValidator()
+    good = {'identifier': 'A' * 22, 'reqId': 1,
+            'operation': {'type': NYM, 'dest': 'B' * 22}}
+    v.validate(good)
+    with pytest.raises(InvalidClientRequest):
+        v.validate({'reqId': 1})  # no operation
+    with pytest.raises(InvalidClientRequest):
+        v.validate({'reqId': 1, 'operation': {'dest': 'x'}})  # no type
+    with pytest.raises(InvalidClientRequest):
+        v.validate({'reqId': -1, 'operation': {'type': NYM}})
