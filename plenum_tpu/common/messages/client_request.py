@@ -55,20 +55,23 @@ class ClientMessageValidator:
         if TXN_TYPE not in op:
             raise InvalidClientRequest(identifier, req_id,
                                        'missed fields in operation - type')
+        if req_id is None:
+            raise InvalidClientRequest(identifier, req_id,
+                                       'missed fields - {}'.format(REQ_ID))
+        if identifier is None and not dct.get(SIGNATURES):
+            raise InvalidClientRequest(
+                identifier, req_id,
+                'missed fields - {} or {}'.format(IDENTIFIER, SIGNATURES))
         for name, validator in self.schema:
             if validator is None:
                 continue
             val = dct.get(name)
-            if val is None:
-                if validator.nullable or name not in dct:
-                    continue
+            if val is None and (validator.nullable or name not in dct):
+                continue
             err = validator.validate(val)
             if err:
                 raise InvalidClientRequest(identifier, req_id,
                                            '{} ({})'.format(err, name))
-        if not dct.get(SIGNATURE) and not dct.get(SIGNATURES):
-            # reads may be unsigned; writes are checked again by authnr
-            pass
         taa = dct.get(TAA_ACCEPTANCE)
         if taa is not None:
             self._validate_taa(identifier, req_id, taa)

@@ -96,8 +96,7 @@ class MessageBase:
         return self.typename == other.typename and self.as_dict() == other.as_dict()
 
     def __hash__(self):
-        return hash((self.typename, repr(sorted(self.as_dict().items(),
-                                                key=lambda kv: kv[0]))))
+        return hash((self.typename, _hashable(self.as_dict())))
 
     def __repr__(self):
         return "{}({})".format(
@@ -113,4 +112,14 @@ def _plain(v):
         return [_plain(x) for x in v]
     if isinstance(v, dict):
         return {k: _plain(x) for k, x in v.items()}
+    return v
+
+
+def _hashable(v):
+    """Order-insensitive hashable form: equal as_dict()s (dict equality
+    ignores insertion order) must hash identically."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
     return v

@@ -24,15 +24,24 @@ class Serializer(ABC):
         ...
 
 
+def _sort_deep(data: Any) -> Any:
+    """Recursively order dict keys (incl. inside lists/tuples) so msgpack
+    output is bit-identical regardless of insertion order — consensus
+    digests and merkle roots depend on it."""
+    if isinstance(data, dict):
+        return {k: _sort_deep(data[k]) for k in sorted(data.keys())}
+    if isinstance(data, (list, tuple)):
+        return [_sort_deep(v) for v in data]
+    return data
+
+
 class MsgPackSerializer(Serializer):
     """Reference: common/serializers/msgpack_serializer.py:13.
-    Keys are sorted so serialization is canonical across nodes (consensus
-    digests depend on it)."""
+    Keys are sorted at every nesting level so serialization is canonical
+    across nodes (consensus digests depend on it)."""
 
     def serialize(self, data: Any, to_bytes=True) -> bytes:
-        if isinstance(data, dict):
-            data = {k: data[k] for k in sorted(data.keys())}
-        return msgpack.packb(data, use_bin_type=True)
+        return msgpack.packb(_sort_deep(data), use_bin_type=True)
 
     def deserialize(self, data: Any) -> Any:
         if isinstance(data, (bytes, bytearray, memoryview)):

@@ -1,0 +1,7 @@
+"""Crypto layer: ed25519 signing/verify, BLS12-381 multi-signatures.
+
+Mirrors the reference's pluggable seams (SURVEY.md §2.7):
+`stp_core/crypto/signer.py:9` (Signer), `crypto/bls/bls_crypto.py:15,32`
+(BlsCryptoSigner/Verifier). Scalar paths are pure Python; bulk verification
+routes to the JAX kernels in plenum_tpu.ops.
+"""

@@ -1,0 +1,71 @@
+"""Batched ed25519 verification provider — the north-star dispatch seam.
+
+The reference authenticates each request inline through libsodium
+(`plenum/server/client_authn.py:84`). Here verification requests are
+gathered per prod tick and dispatched as ONE device batch when the queue
+is deep enough; small batches take the scalar floor so a quiet pool never
+regresses (SURVEY.md §7 "hard parts" #3: dispatch policy by queue depth).
+
+Providers:
+  - ScalarVerifier: pure-Python RFC 8032 (crypto/ed25519.py), per item.
+  - JaxBatchVerifier: one fused TPU dispatch (ops/ed25519_jax.py).
+  - AdaptiveVerifier: routes by batch size; default `tpu_batch` provider.
+
+All providers share one interface: verify_batch([(msg, sig, vk)]) → [bool].
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+VerifyItem = Tuple[bytes, bytes, bytes]  # (message, signature64, verkey32)
+
+
+class ScalarVerifier:
+    name = "scalar"
+
+    def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        from . import ed25519
+        return [ed25519.verify(m, s, vk) for (m, s, vk) in items]
+
+
+class JaxBatchVerifier:
+    name = "tpu_batch"
+
+    def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        from plenum_tpu.ops import ed25519_jax
+        msgs = [m for m, _, _ in items]
+        sigs = [s for _, s, _ in items]
+        vks = [vk for _, _, vk in items]
+        return list(ed25519_jax.verify_batch(msgs, sigs, vks))
+
+
+class AdaptiveVerifier:
+    """Scalar floor below `threshold` items, device batch above."""
+
+    name = "adaptive"
+
+    def __init__(self, threshold: int = 32, scalar=None, batch=None):
+        self.threshold = threshold
+        self._scalar = scalar or ScalarVerifier()
+        self._batch = batch or JaxBatchVerifier()
+
+    def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        if len(items) >= self.threshold:
+            return self._batch.verify_batch(items)
+        return self._scalar.verify_batch(items)
+
+
+_PROVIDERS = {
+    "scalar": ScalarVerifier,
+    "tpu_batch": JaxBatchVerifier,
+    "adaptive": AdaptiveVerifier,
+}
+
+
+def create_verifier(name: str = "adaptive", **kwargs):
+    try:
+        cls = _PROVIDERS[name]
+    except KeyError:
+        raise ValueError(f"unknown verifier provider {name!r}; "
+                         f"one of {sorted(_PROVIDERS)}")
+    return cls(**kwargs)

@@ -1,0 +1,223 @@
+"""BLS multi-signatures over BLS12-381 — the state-proof seam.
+
+Mirrors the reference's pluggable BLS abstractions (SURVEY.md §2.7):
+`crypto/bls/bls_crypto.py:15,32` (BlsCryptoSigner / BlsCryptoVerifier) and
+`crypto/bls/bls_multi_signature.py:70` (MultiSignature value object). The
+concrete backend is our from-scratch BLS12-381 (bls12_381.py) instead of
+Ursa: signatures in G1 (48 B), public keys in G2 (96 B), aggregation by
+plain point addition, one 2-pairing check per multi-sig verify.
+
+Proof-of-possession guards against rogue-key attacks: a key share ships
+with a signature over its own compressed public key under a distinct
+domain separation tag.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+from abc import ABC, abstractmethod
+from typing import List, Optional, Sequence
+
+from . import bls12_381 as bls
+
+_DST_SIG = b"PLENUM_TPU_BLS_SIG"
+_DST_POP = b"PLENUM_TPU_BLS_POP"
+
+
+def _b58(data: bytes) -> str:
+    from plenum_tpu.common.serializers.base58 import b58encode
+    return b58encode(data)
+
+
+def _unb58(s: str) -> bytes:
+    from plenum_tpu.common.serializers.base58 import b58decode
+    return b58decode(s)
+
+
+class BlsCryptoVerifier(ABC):
+    """Reference seam: crypto/bls/bls_crypto.py:15."""
+
+    @abstractmethod
+    def verify_sig(self, signature: str, message: bytes, pk: str) -> bool: ...
+
+    @abstractmethod
+    def verify_multi_sig(self, signature: str, message: bytes,
+                         pks: Sequence[str]) -> bool: ...
+
+    @abstractmethod
+    def create_multi_sig(self, signatures: Sequence[str]) -> str: ...
+
+    @abstractmethod
+    def verify_key_proof_of_possession(self, key_proof: str, pk: str) -> bool: ...
+
+
+class BlsCryptoSigner(ABC):
+    """Reference seam: crypto/bls/bls_crypto.py:32."""
+
+    @abstractmethod
+    def sign(self, message: bytes) -> str: ...
+
+    @property
+    @abstractmethod
+    def pk(self) -> str: ...
+
+
+def generate_bls_keys(seed: Optional[bytes] = None):
+    """→ (sk_int, pk_str, key_proof_str)."""
+    if seed is None:
+        import os
+        seed = os.urandom(32)
+    sk = int.from_bytes(hashlib.sha512(b"PLENUM_TPU_BLS_KEYGEN" + seed)
+                        .digest(), "big") % bls.R
+    if sk == 0:
+        sk = 1
+    pk_point = bls.g2_mul(bls.G2_GEN, sk)
+    pk_bytes = bls.g2_compress(pk_point)
+    pop_point = bls.g1_mul(bls.hash_to_g1(pk_bytes, _DST_POP), sk)
+    return sk, _b58(pk_bytes), _b58(bls.g1_compress(pop_point))
+
+
+class BlsCryptoSignerPlenum(BlsCryptoSigner):
+    def __init__(self, sk: int, pk: str):
+        self._sk = sk
+        self._pk = pk
+
+    @classmethod
+    def generate(cls, seed: Optional[bytes] = None):
+        sk, pk, proof = generate_bls_keys(seed)
+        return cls(sk, pk), proof
+
+    @property
+    def pk(self) -> str:
+        return self._pk
+
+    def sign(self, message: bytes) -> str:
+        h = bls.hash_to_g1(message, _DST_SIG)
+        return _b58(bls.g1_compress(bls.g1_mul(h, self._sk)))
+
+
+class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
+    def _g1(self, s: str):
+        return bls.g1_decompress(_unb58(s))
+
+    def _g2(self, s: str):
+        return bls.g2_decompress(_unb58(s))
+
+    def verify_sig(self, signature: str, message: bytes, pk: str) -> bool:
+        try:
+            sig = self._g1(signature)
+            pub = self._g2(pk)
+        except (ValueError, KeyError):
+            return False
+        if sig is None or pub is None:
+            return False
+        if not (bls.g1_in_subgroup(sig) and bls.g2_in_subgroup(pub)):
+            return False
+        h = bls.hash_to_g1(message, _DST_SIG)
+        # e(sig, G2) == e(H(m), pk)  ⇔  e(sig, -G2)·e(H(m), pk) == 1
+        out = bls.multi_pairing([(sig, bls.g2_neg(bls.G2_GEN)), (h, pub)])
+        return out == bls.FQ12_ONE
+
+    def verify_multi_sig(self, signature: str, message: bytes,
+                         pks: Sequence[str]) -> bool:
+        if not pks:
+            return False
+        try:
+            agg_pk = None
+            for pk in pks:
+                p = self._g2(pk)
+                if p is None or not bls.g2_in_subgroup(p):
+                    return False
+                agg_pk = bls.g2_add(agg_pk, p)
+            sig = self._g1(signature)
+        except (ValueError, KeyError):
+            return False
+        if sig is None or agg_pk is None:
+            return False
+        if not bls.g1_in_subgroup(sig):
+            return False
+        h = bls.hash_to_g1(message, _DST_SIG)
+        out = bls.multi_pairing([(sig, bls.g2_neg(bls.G2_GEN)), (h, agg_pk)])
+        return out == bls.FQ12_ONE
+
+    def create_multi_sig(self, signatures: Sequence[str]) -> str:
+        agg = None
+        for s in signatures:
+            agg = bls.g1_add(agg, self._g1(s))
+        return _b58(bls.g1_compress(agg))
+
+    def verify_key_proof_of_possession(self, key_proof: str, pk: str) -> bool:
+        try:
+            proof = self._g1(key_proof)
+            pub = self._g2(pk)
+        except (ValueError, KeyError):
+            return False
+        if proof is None or pub is None:
+            return False
+        if not (bls.g1_in_subgroup(proof) and bls.g2_in_subgroup(pub)):
+            return False
+        pk_bytes = _unb58(pk)
+        h = bls.hash_to_g1(pk_bytes, _DST_POP)
+        out = bls.multi_pairing([(proof, bls.g2_neg(bls.G2_GEN)), (h, pub)])
+        return out == bls.FQ12_ONE
+
+
+class MultiSignatureValue:
+    """What gets BLS-signed on ordering: the batch's roots and 3PC info.
+    Reference: crypto/bls/bls_multi_signature.py (MultiSignatureValue)."""
+
+    def __init__(self, ledger_id: int, state_root_hash: str,
+                 txn_root_hash: str, pool_state_root_hash: str,
+                 timestamp: int):
+        self.ledger_id = ledger_id
+        self.state_root_hash = state_root_hash
+        self.txn_root_hash = txn_root_hash
+        self.pool_state_root_hash = pool_state_root_hash
+        self.timestamp = timestamp
+
+    def as_dict(self) -> dict:
+        return {
+            "ledger_id": self.ledger_id,
+            "state_root_hash": self.state_root_hash,
+            "txn_root_hash": self.txn_root_hash,
+            "pool_state_root_hash": self.pool_state_root_hash,
+            "timestamp": self.timestamp,
+        }
+
+    def as_single_value(self) -> bytes:
+        items = sorted(self.as_dict().items())
+        return b"|".join(f"{k}={v}".encode() for k, v in items)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MultiSignatureValue":
+        return cls(d["ledger_id"], d["state_root_hash"], d["txn_root_hash"],
+                   d["pool_state_root_hash"], d["timestamp"])
+
+    def __eq__(self, other):
+        return isinstance(other, MultiSignatureValue) and \
+            self.as_dict() == other.as_dict()
+
+
+class MultiSignature:
+    """Aggregated signature + participant names + signed value.
+    Reference: crypto/bls/bls_multi_signature.py:70."""
+
+    def __init__(self, signature: str, participants: List[str],
+                 value: MultiSignatureValue):
+        self.signature = signature
+        self.participants = list(participants)
+        self.value = value
+
+    def as_dict(self) -> dict:
+        return {"signature": self.signature,
+                "participants": self.participants,
+                "value": self.value.as_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MultiSignature":
+        return cls(d["signature"], d["participants"],
+                   MultiSignatureValue.from_dict(d["value"]))
+
+    def __eq__(self, other):
+        return isinstance(other, MultiSignature) and \
+            self.as_dict() == other.as_dict()

@@ -123,12 +123,9 @@ class Ledger:
         if not self.uncommittedTxns:
             self.uncommittedTree = None
             self.uncommittedRootHash = None
-        else:
-            # rebuild shadow from the committed tree + remaining staged txns
-            remaining = self.uncommittedTxns
-            self.uncommittedTxns = []
-            self.uncommittedTree = None
-            self.appendTxns(remaining)
+        # else: the shadow tree already contains exactly the leaves the
+        # committed tree just gained plus the remaining staged txns — its
+        # root is unchanged, so no rebuild is needed.
         return (first, self.seqNo), committed
 
     def discardTxns(self, count: int):

@@ -1,0 +1,7 @@
+"""TPU-accelerated batch primitives (JAX/XLA).
+
+The framework's hot data paths — merkle SHA-256 hashing, ed25519 signature
+verification, BLS12-381 aggregation — are expressed as pure batched JAX
+functions in this package, dispatched from the host-side consensus loop
+behind pluggable provider seams (SURVEY.md §2.9).
+"""

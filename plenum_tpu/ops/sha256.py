@@ -1,0 +1,160 @@
+"""Batched SHA-256 in JAX — the merkle tree's TPU hash path.
+
+The reference hashes merkle leaves/nodes one at a time through OpenSSL
+(`ledger/tree_hasher.py:7`, `hashlib.sha256`). Here the compression
+function is a pure uint32 JAX program, `vmap`-style batched over thousands
+of independent messages per device step: leaf hashing during bulk ledger
+append/catchup, node hashing level-by-level when rebuilding or batch-proving
+(BASELINE.json "1M-leaf audit-path batch" config).
+
+Design notes (TPU-first):
+ - All arithmetic is uint32 — native on the VPU; no 64-bit emulation.
+ - Message padding happens on host (cheap, data-dependent lengths); the
+   device sees fixed-shape [batch, nblocks, 16] uint32 words plus a
+   per-message block count, and masks inactive blocks inside a lax.scan.
+ - One compiled executable per (nblocks) bucket; callers bucket message
+   lengths (merkle node hashes are always exactly 2 blocks: 65 bytes).
+ - The 64 rounds run under lax.fori_loop with the schedule computed
+   in-loop from a rolling 16-word window, keeping VMEM pressure flat.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_IV = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+
+def _rotr(x, n):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def _compress(state, block):
+    """One SHA-256 compression. state: [..., 8] u32, block: [..., 16] u32."""
+    a, b, c, d, e, f, g, h = [state[..., i] for i in range(8)]
+    k = jnp.asarray(_K)
+
+    # Rolling 16-word schedule window, advanced one word per round.
+    w = jnp.moveaxis(block, -1, 0)  # [16, ...]
+
+    def round_fn(t, carry):
+        a, b, c, d, e, f, g, h, w = carry
+        wt = w[0]
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k[t] + wt
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        # next schedule word from the rolling window
+        w1 = w[1]
+        w14 = w[14]
+        sig0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> jnp.uint32(3))
+        sig1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> jnp.uint32(10))
+        w_next = w[0] + sig0 + w[9] + sig1
+        w = jnp.concatenate([w[1:], w_next[None]], axis=0)
+        return (t1 + t2, a, b, c, d + t1, e, f, g, w)
+
+    init = (a, b, c, d, e, f, g, h, w)
+    a, b, c, d, e, f, g, h, _ = lax.fori_loop(0, 64, round_fn, init)
+    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return state + out
+
+
+@functools.partial(jax.jit, static_argnames=("nblocks",))
+def _sha256_blocks(blocks, nvalid, nblocks: int):
+    """blocks: [B, nblocks, 16] u32; nvalid: [B] i32 → digests [B, 8] u32."""
+    state = jnp.broadcast_to(jnp.asarray(_IV), blocks.shape[:-2] + (8,))
+
+    def step(state, xs):
+        block, idx = xs
+        new = _compress(state, block)
+        mask = (idx < nvalid)[..., None]
+        return jnp.where(mask, new, state), None
+
+    idxs = jnp.arange(nblocks, dtype=jnp.int32)
+    # scan over the block axis
+    blocks_t = jnp.moveaxis(blocks, -2, 0)  # [nblocks, B, 16]
+    state, _ = lax.scan(step, state, (blocks_t, idxs))
+    return state
+
+
+def pad_messages(msgs: Sequence[bytes], nblocks: int = None
+                 ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """SHA-pad `msgs` into ([B, nblocks, 16] u32 big-endian words, [B] i32)."""
+    need = [(len(m) + 9 + 63) // 64 for m in msgs]
+    maxb = max(need) if need else 1
+    if nblocks is None:
+        # bucket to power of two to bound recompiles
+        nblocks = 1
+        while nblocks < maxb:
+            nblocks *= 2
+    assert maxb <= nblocks
+    out = np.zeros((len(msgs), nblocks * 64), dtype=np.uint8)
+    for i, m in enumerate(msgs):
+        ln = len(m)
+        out[i, :ln] = np.frombuffer(m, dtype=np.uint8)
+        out[i, ln] = 0x80
+        bitlen = ln * 8
+        end = need[i] * 64
+        out[i, end - 8:end] = np.frombuffer(
+            bitlen.to_bytes(8, "big"), dtype=np.uint8)
+    words = out.reshape(len(msgs), nblocks, 16, 4)
+    words = (words[..., 0].astype(np.uint32) << 24
+             | words[..., 1].astype(np.uint32) << 16
+             | words[..., 2].astype(np.uint32) << 8
+             | words[..., 3].astype(np.uint32))
+    return words, np.asarray(need, dtype=np.int32), nblocks
+
+
+def digests_to_bytes(dig: np.ndarray) -> List[bytes]:
+    """[B, 8] u32 → list of 32-byte digests."""
+    arr = np.asarray(dig).astype(">u4")
+    return [arr[i].tobytes() for i in range(arr.shape[0])]
+
+
+def sha256_many(msgs: Sequence[bytes]) -> List[bytes]:
+    """Batched SHA-256 over arbitrary same-or-mixed-length messages."""
+    if not msgs:
+        return []
+    words, nvalid, nblocks = pad_messages(msgs)
+    dig = _sha256_blocks(jnp.asarray(words), jnp.asarray(nvalid), nblocks)
+    return digests_to_bytes(np.asarray(dig))
+
+
+class JaxSha256Backend:
+    """Batch backend for `TreeHasher` (ledger/tree_hasher.py seam)."""
+
+    def leaf_hashes(self, datas: Sequence[bytes]) -> List[bytes]:
+        return sha256_many([b"\x00" + d for d in datas])
+
+    def node_hashes(self, pairs: Sequence[Tuple[bytes, bytes]]) -> List[bytes]:
+        return sha256_many([b"\x01" + l + r for l, r in pairs])

@@ -23,10 +23,16 @@ class Looper:
         self.prodables: List[Prodable] = []
         self.loop = loop or self._new_loop()
         self.protected_loop = loop is not None
-        for p in (prodables or []):
-            self.prodables.append(p)
-            p.start(self.loop)
         self.running = True
+        if autoStart:
+            for p in (prodables or []):
+                self.add(p)
+        else:
+            for p in (prodables or []):
+                if p.name in [q.name for q in self.prodables]:
+                    raise RuntimeError(
+                        "Prodable {} already added".format(p.name))
+                self.prodables.append(p)
         # larger sleep when nothing happened, to not spin the CPU
         # (reference looper.py:200-218)
         self._min_sleep = 0.0

@@ -150,7 +150,7 @@ class StashingRouter:
         # into the owner's inbox for handling on the next tick (reference
         # stashing_router.py:193-197); the two paths are mutually exclusive
         if self._unstash_handler is not None:
-            self._unstash_handler(message)
+            self._unstash_handler(message, *args)
             return True
         handler = self._handlers.get(type(message))
         if handler is None:

@@ -5,7 +5,10 @@ log compaction on open. Fills the role RocksDB plays in the reference
 truncated on recovery), and adequate for ledgers whose hot path is
 sequential append.
 
-Record format: [klen u32][vlen u32 | 0xFFFFFFFF=tombstone][key][value]
+Record format: [klen u32][vlen u32 | 0xFFFFFFFF=tombstone][key][value].
+Batches are framed as one [klen=0xFFFFFFFE][body_len][records...] record,
+so a crash mid-batch truncates the WHOLE batch on recovery (atomicity —
+the role RocksDB WriteBatch plays in the reference).
 """
 import os
 import struct
@@ -17,6 +20,7 @@ from plenum_tpu.storage.kv_store import KeyValueStorage, to_bytes
 
 _HDR = struct.Struct('<II')
 _TOMBSTONE = 0xFFFFFFFF
+_BATCH = 0xFFFFFFFE
 
 
 class KeyValueStorageFile(KeyValueStorage):
@@ -38,9 +42,29 @@ class KeyValueStorageFile(KeyValueStorage):
         pos = 0
         while pos + _HDR.size <= len(data):
             klen, vlen = _HDR.unpack_from(data, pos)
+            if klen == _BATCH:
+                if pos + _HDR.size + vlen > len(data):
+                    break  # torn batch: drop it whole
+                end = pos + _HDR.size + vlen
+                self._apply_records(data, pos + _HDR.size, end)
+                pos = end
+            else:
+                body = klen + (0 if vlen == _TOMBSTONE else vlen)
+                if pos + _HDR.size + body > len(data):
+                    break  # torn tail
+                self._apply_records(data, pos, pos + _HDR.size + body)
+                pos += _HDR.size + body
+            valid_end = pos
+        if valid_end < len(data) and not self._read_only:
+            with open(self._path, 'r+b') as fh:
+                fh.truncate(valid_end)
+
+    def _apply_records(self, data: bytes, pos: int, end: int):
+        while pos + _HDR.size <= end:
+            klen, vlen = _HDR.unpack_from(data, pos)
             body = klen + (0 if vlen == _TOMBSTONE else vlen)
-            if pos + _HDR.size + body > len(data):
-                break  # torn tail
+            if pos + _HDR.size + body > end:
+                break  # defensive: malformed interior record
             key = data[pos + _HDR.size: pos + _HDR.size + klen]
             if vlen == _TOMBSTONE:
                 self._index.pop(key, None)
@@ -48,10 +72,6 @@ class KeyValueStorageFile(KeyValueStorage):
                 val = data[pos + _HDR.size + klen: pos + _HDR.size + klen + vlen]
                 self._index[key] = val
             pos += _HDR.size + body
-            valid_end = pos
-        if valid_end < len(data) and not self._read_only:
-            with open(self._path, 'r+b') as fh:
-                fh.truncate(valid_end)
 
     def _append(self, key: bytes, value) -> None:
         if self._read_only:
@@ -78,27 +98,47 @@ class KeyValueStorageFile(KeyValueStorage):
             self._fh.flush()
             del self._index[key]
 
+    @staticmethod
+    def _record(key: bytes, value) -> bytes:
+        if value is None:
+            return _HDR.pack(len(key), _TOMBSTONE) + key
+        return _HDR.pack(len(key), len(value)) + key + value
+
+    def _write_framed(self, records, updates):
+        """One atomic batch frame: all-or-nothing on crash recovery."""
+        if self._read_only:
+            raise RuntimeError("read-only store")
+        body = b''.join(records)
+        self._fh.write(_HDR.pack(_BATCH, len(body)) + body)
+        self._fh.flush()
+        for key, value in updates:
+            if value is None:
+                self._index.pop(key, None)
+            else:
+                self._index[key] = value
+
     def setBatch(self, batch: Iterable[Tuple]):
+        records, updates = [], []
         for key, value in batch:
             key, value = to_bytes(key), to_bytes(value)
-            self._append(key, value)
-            self._index[key] = value
-        self._fh.flush()
+            records.append(self._record(key, value))
+            updates.append((key, value))
+        self._write_framed(records, updates)
 
     def do_ops_in_batch(self, batch: Iterable[Tuple]):
+        records, updates = [], []
         for op, key, *rest in batch:
             key = to_bytes(key)
             if op == 'put':
                 value = to_bytes(rest[0])
-                self._append(key, value)
-                self._index[key] = value
+                records.append(self._record(key, value))
+                updates.append((key, value))
             elif op == 'remove':
-                if key in self._index:
-                    self._append(key, None)
-                    del self._index[key]
+                records.append(self._record(key, None))
+                updates.append((key, None))
             else:
                 raise ValueError("unknown batch op {}".format(op))
-        self._fh.flush()
+        self._write_framed(records, updates)
 
     def iterator(self, start=None, end=None, include_value=True):
         start = to_bytes(start) if start is not None else None

@@ -1,0 +1,162 @@
+"""Crypto layer tests: ed25519 (RFC 8032 vectors), BLS12-381, signers,
+batched SHA-256, provider dispatch.
+
+The heavy JAX ed25519 kernel cross-check lives in test_ops_slow.py
+(first compile of the 256-bit scalar-mult loop is minutes on CPU).
+"""
+import hashlib
+
+import pytest
+
+from plenum_tpu.crypto import ed25519 as ed
+from plenum_tpu.crypto.signer import DidSigner, SimpleSigner, verkey_from_identifier
+from plenum_tpu.common.serializers.base58 import b58decode
+
+
+# ---------------------------------------------------------------- ed25519
+
+RFC8032_VECTORS = [
+    # (seed, pk, msg, sig) — RFC 8032 §7.1 TEST 1-3
+    ("9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+     "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+     "",
+     "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e065224901555fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"),
+    ("4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+     "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+     "72",
+     "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"),
+    ("c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+     "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+     "af82",
+     "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"),
+]
+
+
+@pytest.mark.parametrize("seed,pk,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_vectors(seed, pk, msg, sig):
+    seed = bytes.fromhex(seed)
+    msg = bytes.fromhex(msg)
+    assert ed.publickey_from_seed(seed) == bytes.fromhex(pk)
+    assert ed.sign(msg, seed) == bytes.fromhex(sig)
+    assert ed.verify(msg, bytes.fromhex(sig), bytes.fromhex(pk))
+
+
+def test_ed25519_rejects():
+    seed = bytes(range(32))
+    vk, _ = ed.keypair_from_seed(seed)
+    sig = ed.sign(b"msg", seed)
+    assert ed.verify(b"msg", sig, vk)
+    assert not ed.verify(b"msg2", sig, vk)
+    assert not ed.verify(b"msg", sig[:32] + b"\x00" * 32, vk)
+    assert not ed.verify(b"msg", sig, bytes(32))
+    assert not ed.verify(b"msg", b"short", vk)
+    # non-canonical S >= L rejected
+    bad_s = (ed.L + 1).to_bytes(32, "little")
+    assert not ed.verify(b"msg", sig[:32] + bad_s, vk)
+
+
+# ---------------------------------------------------------------- signers
+
+def test_simple_signer_roundtrip():
+    s = SimpleSigner(seed=b"\x07" * 32)
+    assert b58decode(s.verkey) == s.verraw
+    msg = {"op": "NYM", "data": 1}
+    sig = s.sign(msg)
+    from plenum_tpu.common.serializers.serialization import serialize_msg_for_signing
+    assert ed.verify(serialize_msg_for_signing(msg), b58decode(sig), s.verraw)
+
+
+def test_did_signer_abbreviation():
+    d = DidSigner(seed=b"\x09" * 32)
+    assert d.verkey.startswith("~")
+    raw = verkey_from_identifier(d.identifier, d.verkey)
+    assert raw == b58decode(d.full_verkey)
+    # cryptonym: no verkey → identifier is the verkey
+    s = SimpleSigner(seed=b"\x0a" * 32)
+    assert verkey_from_identifier(s.identifier, None) == s.verraw
+
+
+# ---------------------------------------------------------------- sha256 op
+
+def test_jax_sha256_matches_hashlib():
+    from plenum_tpu.ops.sha256 import sha256_many
+    msgs = [b"", b"abc", b"x" * 55, b"y" * 56, b"z" * 64, b"w" * 200]
+    assert sha256_many(msgs) == [hashlib.sha256(m).digest() for m in msgs]
+
+
+def test_jax_tree_hasher_backend():
+    from plenum_tpu.ops.sha256 import JaxSha256Backend
+    from plenum_tpu.ledger.tree_hasher import TreeHasher
+    plain = TreeHasher()
+    batched = TreeHasher(batch_backend=JaxSha256Backend(), batch_threshold=1)
+    datas = [b"txn%d" % i for i in range(10)]
+    assert batched.hash_leaves(datas) == [plain.hash_leaf(d) for d in datas]
+    pairs = [(bytes([i]) * 32, bytes([i + 1]) * 32) for i in range(5)]
+    assert batched.hash_node_pairs(pairs) == \
+        [plain.hash_children(l, r) for l, r in pairs]
+
+
+# ---------------------------------------------------------------- provider
+
+def test_provider_dispatch_scalar_floor():
+    from plenum_tpu.crypto.batch_verifier import AdaptiveVerifier, create_verifier
+
+    calls = []
+
+    class FakeBatch:
+        def verify_batch(self, items):
+            calls.append(len(items))
+            return [True] * len(items)
+
+    v = AdaptiveVerifier(threshold=4, batch=FakeBatch())
+    seed = bytes(range(32))
+    vk, _ = ed.keypair_from_seed(seed)
+    item = (b"m", ed.sign(b"m", seed), vk)
+    assert v.verify_batch([item, item]) == [True, True]   # scalar path
+    assert calls == []
+    assert v.verify_batch([item] * 5) == [True] * 5        # batch path
+    assert calls == [5]
+    with pytest.raises(ValueError):
+        create_verifier("nope")
+
+
+# ---------------------------------------------------------------- BLS
+
+@pytest.fixture(scope="module")
+def bls_pool():
+    from plenum_tpu.crypto.bls import BlsCryptoSignerPlenum
+    out = []
+    for i in range(4):
+        signer, proof = BlsCryptoSignerPlenum.generate(bytes([i]) * 32)
+        out.append((signer, proof))
+    return out
+
+
+def test_bls_single_and_multi(bls_pool):
+    from plenum_tpu.crypto.bls import BlsCryptoVerifierPlenum
+    v = BlsCryptoVerifierPlenum()
+    msg = b"state_root|42"
+    signers = [s for s, _ in bls_pool]
+    sigs = [s.sign(msg) for s in signers]
+    assert v.verify_sig(sigs[0], msg, signers[0].pk)
+    assert not v.verify_sig(sigs[0], msg, signers[1].pk)
+    multi = v.create_multi_sig(sigs)
+    assert v.verify_multi_sig(multi, msg, [s.pk for s in signers])
+    assert not v.verify_multi_sig(multi, msg, [s.pk for s in signers[:3]])
+    assert not v.verify_multi_sig(multi, b"other", [s.pk for s in signers])
+
+
+def test_bls_proof_of_possession(bls_pool):
+    from plenum_tpu.crypto.bls import BlsCryptoVerifierPlenum
+    v = BlsCryptoVerifierPlenum()
+    (s0, p0), (s1, p1) = bls_pool[0], bls_pool[1]
+    assert v.verify_key_proof_of_possession(p0, s0.pk)
+    assert not v.verify_key_proof_of_possession(p0, s1.pk)
+
+
+def test_multi_signature_value_roundtrip():
+    from plenum_tpu.crypto.bls import MultiSignature, MultiSignatureValue
+    val = MultiSignatureValue(1, "sr", "tr", "pr", 1234)
+    ms = MultiSignature("sig", ["Alpha", "Beta"], val)
+    assert MultiSignature.from_dict(ms.as_dict()) == ms
+    assert b"ledger_id=1" in val.as_single_value()

@@ -1,0 +1,40 @@
+"""Slow op tests: full JAX ed25519 batch-verify cross-check vs the scalar
+RFC 8032 implementation. First compile of the 256-bit scalar-mult loop is
+minutes on CPU, so this is opt-in: RUN_SLOW_OPS=1 python -m pytest
+tests/test_ops_slow.py.  The driver's bench runs exercise the same kernel
+on real TPU hardware every round.
+"""
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("RUN_SLOW_OPS"),
+    reason="set RUN_SLOW_OPS=1 to run the ed25519 kernel cross-check")
+
+
+def test_ed25519_jax_batch_cross_check():
+    from plenum_tpu.crypto import ed25519 as ed
+    from plenum_tpu.ops import ed25519_jax as edj
+
+    rng = np.random.RandomState(7)
+    msgs, sigs, vks, expected = [], [], [], []
+    for i in range(16):
+        seed = bytes(rng.randint(0, 256, 32, dtype=np.uint8))
+        vk, _ = ed.keypair_from_seed(seed)
+        msg = bytes(rng.randint(0, 256, rng.randint(0, 200), dtype=np.uint8))
+        sig = ed.sign(msg, seed)
+        kind = i % 4
+        if kind == 1:
+            msg = msg + b"tamper"
+        elif kind == 2:
+            sig = sig[:3] + bytes([sig[3] ^ 0xFF]) + sig[4:]
+        elif kind == 3:
+            vk = vks[0] if vks else vk
+        msgs.append(msg)
+        sigs.append(sig)
+        vks.append(vk)
+        expected.append(ed.verify(msg, sig, vk))
+    got = edj.verify_batch(msgs, sigs, vks)
+    assert list(got) == expected
