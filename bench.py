@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Headline benchmark — prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Current headline (BASELINE.json north star path): batched ed25519
+signature verification throughput per chip — the hot operation under
+ordered write-requests/sec (every client write costs >= 1 sig verify, and
+the reference's CPU pool baselines at <1k req/s). vs_baseline is the
+speedup over the scalar verification floor measured on this host.
+
+Once the consensus pool lands, this will switch to ordered write-reqs/sec
+on a 4-node in-process pool with TPU-batched verification.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# persistent compilation cache: first compile of the verify kernel is
+# tens of seconds; subsequent runs hit the cache
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+UNIQUE = 256
+
+
+def main():
+    import numpy as np
+    from plenum_tpu.crypto import ed25519 as ed
+    from plenum_tpu.crypto.fixtures import make_signed_batch
+    from plenum_tpu.ops import ed25519_jax as edj
+
+    msgs, sigs, vks = make_signed_batch(BATCH, seed=42, unique=UNIQUE,
+                                        msg_prefix=b"bench-req")
+
+    # warmup (compile)
+    ok = edj.verify_batch(msgs[:BATCH], sigs[:BATCH], vks[:BATCH])
+    assert bool(np.all(ok)), "benchmark signatures failed to verify"
+
+    runs = 3
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        edj.verify_batch(msgs, sigs, vks)
+    dt = (time.perf_counter() - t0) / runs
+    device_rate = BATCH / dt
+
+    # scalar floor on this host (pure-Python RFC 8032)
+    n_scalar = 30
+    t0 = time.perf_counter()
+    for i in range(n_scalar):
+        ed.verify(msgs[i], sigs[i], vks[i])
+    scalar_rate = n_scalar / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "ed25519 batch verify throughput per chip (batch=%d)" % BATCH,
+        "value": round(device_rate, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(device_rate / scalar_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

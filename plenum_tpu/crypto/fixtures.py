@@ -1,0 +1,29 @@
+"""Deterministic signature fixtures shared by bench.py, __graft_entry__,
+and tests — one generator so every harness exercises the same data path.
+"""
+from typing import List, Tuple
+
+import numpy as np
+
+
+def make_signed_batch(count: int, seed: int = 0, unique: int = None,
+                      msg_prefix: bytes = b"fixture"
+                      ) -> Tuple[List[bytes], List[bytes], List[bytes]]:
+    """→ (msgs, sigs, verkeys), `unique` distinct keypairs tiled to
+    `count` entries (signing is pure-Python; tiling keeps fixture
+    generation cheap while device work is identical per entry)."""
+    from plenum_tpu.crypto import ed25519 as ed
+
+    unique = min(count, unique or count)
+    rng = np.random.RandomState(seed)
+    msgs, sigs, vks = [], [], []
+    for i in range(unique):
+        kseed = bytes(rng.randint(0, 256, 32, dtype=np.uint8))
+        vk, _ = ed.keypair_from_seed(kseed)
+        msg = msg_prefix + b"-%d" % i
+        msgs.append(msg)
+        sigs.append(ed.sign(msg, kseed))
+        vks.append(vk)
+    reps = (count + unique - 1) // unique
+    return ((msgs * reps)[:count], (sigs * reps)[:count],
+            (vks * reps)[:count])

@@ -97,25 +97,85 @@ _SPREAD_8P = _spread_8p()
 
 # ----------------------------------------------------- field arithmetic
 
-def _carry_tail(c: List):
-    """Carry chain over 20 columns with top fold (2^255 ≡ 19).
+# Anti-diagonal scatter matrix: flat outer-product index (i*20+j) → column
+# i+j. One [.., 400]×[400, 42] int32 matmul replaces 400 unrolled
+# multiply-adds — tiny XLA graphs and VPU-friendly vector work.
+def _fold_matrix() -> np.ndarray:
+    m = np.zeros((NLIMB * NLIMB, 2 * NLIMB + 2), dtype=np.int32)
+    for i in range(NLIMB):
+        for j in range(NLIMB):
+            m[i * NLIMB + j, i + j] = 1
+    return m
 
-    Post: limbs ≤ MASK+1, top limb < 2^9. Works for signed columns too
-    (arithmetic shifts), provided the represented value is nonnegative.
-    """
-    for k in range(NLIMB - 1):
-        cr = c[k] >> RADIX
-        c[k] = c[k] - (cr << RADIX)
-        c[k + 1] = c[k + 1] + cr
-    # limb 19 holds bits 247..; bits ≥ 255 fold back ×19
-    top = c[NLIMB - 1] >> 8
-    c[NLIMB - 1] = c[NLIMB - 1] - (top << 8)
-    c[0] = c[0] + top * 19
-    for k in range(3):
-        cr = c[k] >> RADIX
-        c[k] = c[k] - (cr << RADIX)
-        c[k + 1] = c[k + 1] + cr
-    return c
+
+_FOLD_MAT = _fold_matrix()
+
+
+def _shift_up(c):
+    """Shift columns up one position (carry from col k lands in col k+1)."""
+    pad = [(0, 0)] * (c.ndim - 1) + [(1, 0)]
+    return jnp.pad(c[..., :-1], pad)
+
+
+def _carry_round(c):
+    """One parallel carry step over all columns; top carry must be vacuous
+    (caller guarantees headroom in the last column)."""
+    cr = c >> RADIX
+    return (c & MASK) + _shift_up(cr)
+
+
+def _carry_wrap_round(c):
+    """Parallel carry on 20 columns where the top carry wraps to column 0
+    multiplied by 608 (2^260 ≡ 19·2^5 mod p)."""
+    cr = c >> RADIX
+    wrapped = jnp.concatenate([cr[..., -1:] * 608, cr[..., :-1]], axis=-1)
+    return (c & MASK) + wrapped
+
+
+def _finalize20(c):
+    """Normalize 20 columns (each < 2^25 — the verified headroom: the
+    first wrap round's cr·608 term then stays < 2^21, far from int32
+    overflow) to the invariant: limbs ≤ MASK+1, top limb < 2^9 (bits
+    ≥ 255 folded back ×19)."""
+    c = _carry_wrap_round(c)
+    c = _carry_wrap_round(c)
+    top = c[..., -1:] >> 8
+    c = jnp.concatenate([c[..., :1] + top * 19, c[..., 1:-1],
+                         c[..., -1:] - (top << 8)], axis=-1)
+    return _carry_wrap_round(c)
+
+
+def fmul(a, b):
+    """Field multiply. a, b: [..., 20] int32, limbs ≤ MASK+1, top < 2^9."""
+    outer = a[..., :, None] * b[..., None, :]
+    flat = outer.reshape(outer.shape[:-2] + (NLIMB * NLIMB,))
+    c = flat @ jnp.asarray(_FOLD_MAT)          # [..., 42], cols < 20·2^26
+    c = _carry_round(c)
+    c = _carry_round(c)
+    c = _carry_round(c)                         # all 42 cols ≤ MASK+1
+    # fold: col 20+k carries weight 2^260·2^13k ≡ 608·2^13k, col 40+k
+    # carries (2^260)²·2^13k ≡ 608²·2^13k (cols 40-41 hold only carry
+    # residue ≤ 2^5 after the rounds above, so 608² ≈ 2^18.5 is safe)
+    extra = c[..., 40:42] * (608 * 608)
+    pad = [(0, 0)] * (extra.ndim - 1) + [(0, NLIMB - 2)]
+    c = c[..., :20] + c[..., 20:40] * 608 + jnp.pad(extra, pad)
+    return _finalize20(c)                       # input cols < 2^25
+
+
+def fsq(a):
+    return fmul(a, a)
+
+
+def fadd(a, b):
+    return _finalize20(a + b)
+
+
+def fsub(a, b):
+    return _finalize20(a + jnp.asarray(_SPREAD_8P) - b)
+
+
+def fneg(a):
+    return fsub(jnp.zeros_like(a), a)
 
 
 def _stack(c: List):
@@ -124,47 +184,6 @@ def _stack(c: List):
 
 def _cols(x):
     return [x[..., i] for i in range(x.shape[-1])]
-
-
-def fmul(a, b):
-    """Field multiply. a, b: [..., 20] int32, limbs ≤ MASK+1, top < 2^9."""
-    al = _cols(a)
-    bl = _cols(b)
-    cols = []
-    for k in range(2 * NLIMB - 1):
-        lo = max(0, k - (NLIMB - 1))
-        hi = min(NLIMB - 1, k)
-        t = al[lo] * bl[k - lo]
-        for i in range(lo + 1, hi + 1):
-            t = t + al[i] * bl[k - i]
-        cols.append(t)
-    cols.append(jnp.zeros_like(cols[0]))  # column 39 receives the last carry
-    # first carry pass over all 40 columns
-    for k in range(2 * NLIMB - 1):
-        cr = cols[k] >> RADIX
-        cols[k] = cols[k] & MASK
-        cols[k + 1] = cols[k + 1] + cr
-    # fold columns ≥ 20: 2^260 ≡ 19·2^5 = 608 (mod p)
-    for k in range(NLIMB, 2 * NLIMB):
-        cols[k - NLIMB] = cols[k - NLIMB] + cols[k] * 608
-    return _stack(_carry_tail(cols[:NLIMB]))
-
-
-def fsq(a):
-    return fmul(a, a)
-
-
-def fadd(a, b):
-    return _stack(_carry_tail(_cols(a + b)))
-
-
-def fsub(a, b):
-    spread = jnp.asarray(_SPREAD_8P)
-    return _stack(_carry_tail(_cols(a + spread - b)))
-
-
-def fneg(a):
-    return fsub(jnp.zeros_like(a), a)
 
 
 def fcanon(x):
@@ -349,17 +368,15 @@ def _pack_words(values: Sequence[int]) -> np.ndarray:
     return out
 
 
-def verify_batch(msgs: Sequence[bytes], sigs: Sequence[bytes],
-                 verkeys: Sequence[bytes]) -> np.ndarray:
-    """Batched cofactorless ed25519 verify → np.bool_ array [B].
+def host_pack(msgs: Sequence[bytes], sigs: Sequence[bytes],
+              verkeys: Sequence[bytes]):
+    """Host-side preprocessing: parse/canonicality-check sigs and keys,
+    compute k = SHA-512(R||A||M) mod L (hashlib C core), pack limb arrays.
 
-    Host computes k = SHA-512(R||A||M) mod L (hashlib C core) and packs
-    limbs; device does all elliptic-curve math.
+    → ([ay, asign, ry, rsign, s_words, k_words] jnp arrays, valid bool[B])
     """
     n = len(msgs)
     assert len(sigs) == n and len(verkeys) == n
-    if n == 0:
-        return np.zeros(0, dtype=bool)
     ay, asign, ry, rsign, s_sc, k_sc = [], [], [], [], [], []
     valid = np.ones(n, dtype=bool)
     for i in range(n):
@@ -387,8 +404,24 @@ def verify_batch(msgs: Sequence[bytes], sigs: Sequence[bytes],
         rsign.append(rs_v)
         s_sc.append(s_int)
         k_sc.append(k_int)
-    ok = _verify_kernel(
-        jnp.asarray(_pack_fe(ay)), jnp.asarray(np.asarray(asign, np.int32)),
-        jnp.asarray(_pack_fe(ry)), jnp.asarray(np.asarray(rsign, np.int32)),
-        jnp.asarray(_pack_words(s_sc)), jnp.asarray(_pack_words(k_sc)))
+    arrays = [jnp.asarray(_pack_fe(ay)),
+              jnp.asarray(np.asarray(asign, np.int32)),
+              jnp.asarray(_pack_fe(ry)),
+              jnp.asarray(np.asarray(rsign, np.int32)),
+              jnp.asarray(_pack_words(s_sc)),
+              jnp.asarray(_pack_words(k_sc))]
+    return arrays, valid
+
+
+def verify_batch(msgs: Sequence[bytes], sigs: Sequence[bytes],
+                 verkeys: Sequence[bytes]) -> np.ndarray:
+    """Batched cofactorless ed25519 verify → np.bool_ array [B].
+
+    Host does the cheap data-dependent prep (host_pack); the device does
+    all elliptic-curve math in one dispatch.
+    """
+    if len(msgs) == 0:
+        return np.zeros(0, dtype=bool)
+    arrays, valid = host_pack(msgs, sigs, verkeys)
+    ok = _verify_kernel(*arrays)
     return np.asarray(ok) & valid

@@ -1,0 +1,144 @@
+"""State: committed vs uncommitted heads over the trie.
+
+Reference: state/state.py:5 (State ABC), state/pruning_state.py:14
+(PruningState). `headHash` moves with every applied-but-uncommitted batch;
+`committedHeadHash` moves only on 3PC commit; revert rewinds head to the
+committed root (the trie keeps all nodes, so rewinding is just a root
+swap — same trick the reference uses).
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from plenum_tpu.common.serializers.base58 import b58encode
+from plenum_tpu.state.trie import BLANK_ROOT, Trie, verify_proof
+
+
+class State(ABC):
+    @abstractmethod
+    def set(self, key: bytes, value: bytes): ...
+
+    @abstractmethod
+    def get(self, key: bytes, isCommitted: bool = True) -> Optional[bytes]: ...
+
+    @abstractmethod
+    def remove(self, key: bytes): ...
+
+    @property
+    @abstractmethod
+    def head(self): ...
+
+    @property
+    @abstractmethod
+    def committedHead(self): ...
+
+    @abstractmethod
+    def commit(self, rootHash: Optional[bytes] = None): ...
+
+    @abstractmethod
+    def revertToHead(self, headHash: bytes): ...
+
+    @property
+    @abstractmethod
+    def headHash(self) -> bytes: ...
+
+    @property
+    @abstractmethod
+    def committedHeadHash(self) -> bytes: ...
+
+
+class PruningState(State):
+    # key under which the committed root hash survives restarts
+    rootHashKey = b"\x88\x88committedRoot"
+
+    def __init__(self, kv):
+        """kv: KeyValueStorage for trie nodes (+ the committed-root key)."""
+        self._kv = kv
+        try:
+            committed = bytes(kv.get(self.rootHashKey))
+        except KeyError:
+            committed = BLANK_ROOT
+        self._trie = Trie(kv, committed)
+        self._committed_root = committed
+
+    # ------------------------------------------------------------ writes
+
+    def set(self, key: bytes, value: bytes):
+        self._trie.set(key, value)
+
+    def remove(self, key: bytes):
+        self._trie.delete(key)
+
+    def get(self, key: bytes, isCommitted: bool = True) -> Optional[bytes]:
+        if isCommitted:
+            return self._trie.get_at_root(self._committed_root, key)
+        return self._trie.get(key)
+
+    def get_for_root_hash(self, root_hash: bytes, key: bytes
+                          ) -> Optional[bytes]:
+        return self._trie.get_at_root(root_hash, key)
+
+    # ------------------------------------------------------- commit/revert
+
+    def commit(self, rootHash: Optional[bytes] = None):
+        """Advance the committed head (to `rootHash` if given — must be a
+        root previously produced by apply — else to the current head)."""
+        root = rootHash if rootHash is not None else self._trie.root_hash
+        self._committed_root = root
+        self._trie.root_hash = root
+        self._kv.put(self.rootHashKey, root)
+
+    def revertToHead(self, headHash: bytes):
+        self._trie.root_hash = headHash
+
+    # ------------------------------------------------------------- heads
+
+    @property
+    def head(self):
+        return self._trie
+
+    @property
+    def committedHead(self):
+        return Trie(self._kv, self._committed_root)
+
+    @property
+    def headHash(self) -> bytes:
+        return self._trie.root_hash
+
+    @property
+    def committedHeadHash(self) -> bytes:
+        return self._committed_root
+
+    @property
+    def committedHeadHash_b58(self) -> str:
+        return b58encode(self._committed_root)
+
+    # ------------------------------------------------------------- proofs
+
+    def generate_state_proof(self, key: bytes, root: Optional[bytes] = None,
+                             serialize: bool = False):
+        """Proof nodes for `key`; serialize=True wraps them in one
+        base64-encoded RLP list (the wire form clients receive)."""
+        nodes = self._trie.produce_spv_proof(
+            key, root if root is not None else self.committedHeadHash)
+        if serialize:
+            import base64
+            from plenum_tpu.state import rlp as _rlp
+            return base64.b64encode(_rlp.encode(list(nodes))).decode("ascii")
+        return nodes
+
+    @staticmethod
+    def deserialize_proof(proof: str) -> List[bytes]:
+        import base64
+        from plenum_tpu.state import rlp as _rlp
+        return [bytes(n) for n in _rlp.decode(base64.b64decode(proof))]
+
+    @staticmethod
+    def verify_state_proof(root_hash: bytes, key: bytes,
+                           value: Optional[bytes],
+                           proof_nodes: List[bytes]) -> bool:
+        return verify_proof(root_hash, key, value, proof_nodes)
+
+    def close(self):
+        self._kv.close()

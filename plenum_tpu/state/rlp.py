@@ -1,0 +1,85 @@
+"""Minimal RLP encoding (trie node serialization).
+
+The reference uses RLP for MPT nodes (state/util/fast_rlp.py). Wire
+compatibility with Ethereum is not a goal, but RLP is compact, canonical,
+and self-delimiting, so trie hashes are well-defined. Supports bytes and
+(nested) lists of bytes — all a trie node needs.
+"""
+from typing import List, Tuple, Union
+
+RlpItem = Union[bytes, List["RlpItem"]]
+
+
+def encode(item: RlpItem) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        item = bytes(item)
+        if len(item) == 1 and item[0] < 0x80:
+            return item
+        return _len_prefix(len(item), 0x80) + item
+    if isinstance(item, (list, tuple)):
+        body = b"".join(encode(x) for x in item)
+        return _len_prefix(len(body), 0xC0) + body
+    raise TypeError("cannot RLP-encode {}".format(type(item)))
+
+
+def _len_prefix(length: int, offset: int) -> bytes:
+    if length < 56:
+        return bytes([offset + length])
+    ll = length.to_bytes((length.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(ll)]) + ll
+
+
+def decode(data: bytes) -> RlpItem:
+    item, rest = _decode_one(bytes(data))
+    if rest:
+        raise ValueError("trailing RLP bytes")
+    return item
+
+
+def _decode_one(data: bytes) -> Tuple[RlpItem, bytes]:
+    if not data:
+        raise ValueError("empty RLP")
+    b0 = data[0]
+    if b0 < 0x80:
+        return data[:1], data[1:]
+    if b0 < 0xB8:  # short string
+        n = b0 - 0x80
+        _check(data, 1 + n)
+        if n == 1 and data[1] < 0x80:
+            raise ValueError("non-canonical RLP single byte")
+        return data[1:1 + n], data[1 + n:]
+    if b0 < 0xC0:  # long string
+        ln = b0 - 0xB7
+        n = _read_len(data, ln, 56)
+        return data[1 + ln:1 + ln + n], data[1 + ln + n:]
+    if b0 < 0xF8:  # short list
+        n = b0 - 0xC0
+        _check(data, 1 + n)
+        return _decode_list(data[1:1 + n]), data[1 + n:]
+    ln = b0 - 0xF7  # long list
+    n = _read_len(data, ln, 56)
+    return _decode_list(data[1 + ln:1 + ln + n]), data[1 + ln + n:]
+
+
+def _read_len(data: bytes, ln: int, minimum: int) -> int:
+    _check(data, 1 + ln)
+    if data[1] == 0:
+        raise ValueError("leading zero in RLP length")
+    n = int.from_bytes(data[1:1 + ln], "big")
+    if n < minimum:
+        raise ValueError("non-canonical RLP length")
+    _check(data, 1 + ln + n)
+    return n
+
+
+def _decode_list(body: bytes) -> List[RlpItem]:
+    out = []
+    while body:
+        item, body = _decode_one(body)
+        out.append(item)
+    return out
+
+
+def _check(data: bytes, need: int):
+    if len(data) < need:
+        raise ValueError("truncated RLP")

@@ -1,0 +1,206 @@
+"""State layer: RLP, trie operations, SPV proofs, committed/uncommitted
+heads with revert — plus a randomized differential test against a dict.
+"""
+import random
+
+import pytest
+
+from plenum_tpu.state import rlp
+from plenum_tpu.state.trie import BLANK_ROOT, Trie, verify_proof
+from plenum_tpu.state.pruning_state import PruningState
+from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+
+
+# ------------------------------------------------------------------- RLP
+
+def test_rlp_roundtrip():
+    cases = [
+        b"",
+        b"\x00",
+        b"\x7f",
+        b"\x80",
+        b"dog",
+        b"x" * 55,
+        b"y" * 56,
+        b"z" * 1000,
+        [],
+        [b"cat", b"dog"],
+        [b"", [b"a", [b"b"]], b"c" * 60],
+    ]
+    for c in cases:
+        assert rlp.decode(rlp.encode(c)) == c
+
+
+def test_rlp_rejects_noncanonical():
+    with pytest.raises(ValueError):
+        rlp.decode(b"\x81\x05")  # single byte < 0x80 must be itself
+    with pytest.raises(ValueError):
+        rlp.decode(b"\x80\x00")  # trailing bytes
+    with pytest.raises(ValueError):
+        rlp.decode(b"\xb8\x01a" + b"")  # long form for short length
+
+
+# ------------------------------------------------------------------ trie
+
+@pytest.fixture
+def trie():
+    return Trie(KeyValueStorageInMemory())
+
+
+def test_trie_basic(trie):
+    assert trie.root_hash == BLANK_ROOT
+    trie.set(b"k1", b"v1")
+    trie.set(b"k2", b"v2")
+    trie.set(b"key-longer", b"v3")
+    assert trie.get(b"k1") == b"v1"
+    assert trie.get(b"k2") == b"v2"
+    assert trie.get(b"key-longer") == b"v3"
+    assert trie.get(b"missing") is None
+    trie.set(b"k1", b"v1b")  # overwrite
+    assert trie.get(b"k1") == b"v1b"
+
+
+def test_trie_delete(trie):
+    for i in range(20):
+        trie.set(b"key%d" % i, b"val%d" % i)
+    root_full = trie.root_hash
+    trie.delete(b"key7")
+    assert trie.get(b"key7") is None
+    assert trie.get(b"key8") == b"val8"
+    # deleting a missing key is a no-op for content
+    trie.delete(b"nope")
+    # re-adding restores the exact root (canonical structure)
+    trie.set(b"key7", b"val7")
+    assert trie.root_hash == root_full
+
+
+def test_trie_root_deterministic():
+    t1 = Trie(KeyValueStorageInMemory())
+    t2 = Trie(KeyValueStorageInMemory())
+    items = [(b"abc%d" % i, b"v%d" % i) for i in range(50)]
+    for k, v in items:
+        t1.set(k, v)
+    for k, v in reversed(items):
+        t2.set(k, v)
+    assert t1.root_hash == t2.root_hash
+
+
+def test_trie_differential_random():
+    rng = random.Random(1234)
+    trie = Trie(KeyValueStorageInMemory())
+    model = {}
+    keys = [bytes([rng.randrange(256) for _ in range(rng.randrange(1, 8))])
+            for _ in range(120)]
+    for step in range(600):
+        k = rng.choice(keys)
+        op = rng.random()
+        if op < 0.6:
+            v = b"v%d" % step
+            trie.set(k, v)
+            model[k] = v
+        else:
+            trie.delete(k)
+            model.pop(k, None)
+        if step % 97 == 0:
+            for kk in keys:
+                assert trie.get(kk) == model.get(kk)
+    assert dict(trie.items()) == model
+
+
+def test_trie_old_roots_still_readable(trie):
+    trie.set(b"a", b"1")
+    r1 = trie.root_hash
+    trie.set(b"a", b"2")
+    trie.set(b"b", b"3")
+    assert trie.get_at_root(r1, b"a") == b"1"
+    assert trie.get_at_root(r1, b"b") is None
+    assert trie.get(b"a") == b"2"
+
+
+# ----------------------------------------------------------------- proofs
+
+def test_spv_proof_membership(trie):
+    for i in range(40):
+        trie.set(b"proof-key-%d" % i, b"proof-val-%d" % i)
+    root = trie.root_hash
+    proof = trie.produce_spv_proof(b"proof-key-17")
+    assert verify_proof(root, b"proof-key-17", b"proof-val-17", proof)
+    assert not verify_proof(root, b"proof-key-17", b"wrong", proof)
+    assert not verify_proof(root, b"proof-key-18", b"proof-val-17", proof)
+
+
+def test_spv_proof_non_membership(trie):
+    for i in range(10):
+        trie.set(b"nm%d" % i, b"v%d" % i)
+    root = trie.root_hash
+    proof = trie.produce_spv_proof(b"absent-key")
+    assert verify_proof(root, b"absent-key", None, proof)
+    assert not verify_proof(root, b"nm3", None, trie.produce_spv_proof(b"nm3"))
+
+
+def test_spv_proof_tamper_detected(trie):
+    trie.set(b"t1", b"v1")
+    trie.set(b"t2", b"v2")
+    root = trie.root_hash
+    proof = trie.produce_spv_proof(b"t1")
+    tampered = [p[:-1] + bytes([p[-1] ^ 1]) for p in proof]
+    assert not verify_proof(root, b"t1", b"v1", tampered)
+
+
+# ------------------------------------------------------------ PruningState
+
+def test_state_committed_vs_head():
+    st = PruningState(KeyValueStorageInMemory())
+    st.set(b"x", b"1")
+    assert st.get(b"x", isCommitted=False) == b"1"
+    assert st.get(b"x", isCommitted=True) is None
+    st.commit()
+    assert st.get(b"x", isCommitted=True) == b"1"
+    assert st.headHash == st.committedHeadHash
+
+
+def test_state_revert():
+    st = PruningState(KeyValueStorageInMemory())
+    st.set(b"a", b"1")
+    st.commit()
+    committed = st.committedHeadHash
+    st.set(b"a", b"2")
+    st.set(b"b", b"3")
+    assert st.headHash != committed
+    st.revertToHead(committed)
+    assert st.get(b"a", isCommitted=False) == b"1"
+    assert st.get(b"b", isCommitted=False) is None
+    assert st.headHash == committed
+
+
+def test_state_commit_to_intermediate_root():
+    st = PruningState(KeyValueStorageInMemory())
+    st.set(b"k", b"1")
+    r1 = st.headHash
+    st.set(b"k", b"2")
+    st.commit(rootHash=r1)  # commit only the first batch
+    assert st.get(b"k", isCommitted=True) == b"1"
+
+
+def test_state_persists_committed_root(tdir):
+    from plenum_tpu.storage.kv_file import KeyValueStorageFile
+    kv = KeyValueStorageFile(tdir, "state")
+    st = PruningState(kv)
+    st.set(b"persist", b"me")
+    st.commit()
+    root = st.committedHeadHash
+    st.close()
+    kv2 = KeyValueStorageFile(tdir, "state")
+    st2 = PruningState(kv2)
+    assert st2.committedHeadHash == root
+    assert st2.get(b"persist") == b"me"
+    st2.close()
+
+
+def test_state_proof_roundtrip():
+    st = PruningState(KeyValueStorageInMemory())
+    st.set(b"did:alpha", b'{"verkey":"abc"}')
+    st.commit()
+    proof = st.generate_state_proof(b"did:alpha")
+    assert PruningState.verify_state_proof(
+        st.committedHeadHash, b"did:alpha", b'{"verkey":"abc"}', proof)
