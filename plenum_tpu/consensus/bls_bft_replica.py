@@ -1,0 +1,167 @@
+"""BlsBftReplica — BLS multi-signatures woven into 3PC.
+
+Reference: crypto/bls/bls_bft_replica.py:7 (ABC: validate/process/update
+per 3PC message, process_order :83) + plenum/bls/bls_bft_replica_plenum.py
+(concrete, 400 LoC) + plenum/bls/bls_store.py (BlsStore).
+
+Flow: the primary's PRE-PREPARE fixes the pool state root; every replica's
+COMMIT carries its BLS signature share over (ledger_id, state_root,
+txn_root, pool_root, timestamp); COMMIT validation checks the share; on
+ordering, n-f shares aggregate into a MultiSignature persisted in the
+BlsStore keyed by state root — the material for client state proofs.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from plenum_tpu.crypto.bls import (
+    BlsCryptoSigner, BlsCryptoVerifier, MultiSignature, MultiSignatureValue)
+
+logger = logging.getLogger(__name__)
+
+
+class BlsStore:
+    """state_root (b58 str) → MultiSignature (reference plenum/bls/bls_store.py:8)."""
+
+    def __init__(self, kv=None):
+        from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+        self._kv = kv or KeyValueStorageInMemory()
+
+    def put(self, multi_sig: MultiSignature):
+        import json
+        self._kv.put(multi_sig.value.state_root_hash.encode(),
+                     json.dumps(multi_sig.as_dict()).encode())
+
+    def get(self, state_root: str) -> Optional[MultiSignature]:
+        import json
+        try:
+            raw = self._kv.get(state_root.encode())
+        except KeyError:
+            return None
+        return MultiSignature.from_dict(json.loads(bytes(raw).decode()))
+
+
+class BlsKeyRegister:
+    """node name → BLS public key (reference
+    plenum/bls/bls_key_register_pool_ledger.py — keys come from the pool
+    ledger; here a provider callable so the pool manager can back it)."""
+
+    def __init__(self, provider=None):
+        self._provider = provider or (lambda node: None)
+
+    def get_key_by_name(self, node_name: str) -> Optional[str]:
+        return self._provider(node_name)
+
+
+class BlsBftReplica:
+    def __init__(self, node_name: str,
+                 bls_signer: Optional[BlsCryptoSigner],
+                 bls_verifier: BlsCryptoVerifier,
+                 key_register: BlsKeyRegister,
+                 bls_store: Optional[BlsStore] = None,
+                 get_pool_root=None):
+        self._name = node_name
+        self._signer = bls_signer
+        self._verifier = bls_verifier
+        self._keys = key_register
+        self.bls_store = bls_store or BlsStore()
+        self._get_pool_root = get_pool_root or (lambda: "")
+        # (view_no, pp_seq_no) -> pp fields needed to bind commit sigs
+        self._pp_values: Dict[tuple, MultiSignatureValue] = {}
+
+    # ------------------------------------------------------- PRE-PREPARE
+
+    def update_pre_prepare(self, params: dict, ledger_id: int) -> dict:
+        params["poolStateRootHash"] = self._get_pool_root() or None
+        return params
+
+    def validate_pre_prepare(self, pp, sender: str) -> Optional[str]:
+        return None  # multi-sig inside PP validated lazily on use
+
+    def process_pre_prepare(self, pp, sender: str):
+        self._remember_value(pp)
+
+    def _remember_value(self, pp):
+        self._pp_values[(pp.viewNo, pp.ppSeqNo)] = MultiSignatureValue(
+            ledger_id=pp.ledgerId,
+            state_root_hash=pp.stateRootHash or "",
+            txn_root_hash=pp.txnRootHash or "",
+            pool_state_root_hash=pp.poolStateRootHash or "",
+            timestamp=pp.ppTime,
+        )
+
+    # ------------------------------------------------------------ PREPARE
+
+    def process_prepare(self, prepare, sender: str):
+        pass
+
+    # ------------------------------------------------------------- COMMIT
+
+    def update_commit(self, params: dict, pp) -> dict:
+        if self._signer is None:
+            return params
+        self._remember_value(pp)
+        value = self._pp_values[(pp.viewNo, pp.ppSeqNo)]
+        params["blsSig"] = self._signer.sign(value.as_single_value())
+        return params
+
+    def validate_commit(self, commit, sender: str, pp) -> Optional[str]:
+        sig = getattr(commit, "blsSig", None)
+        if sig is None:
+            return None  # shares are optional (node without BLS keys)
+        pk = self._keys.get_key_by_name(sender)
+        if pk is None:
+            return None  # unknown key: can't check, don't block consensus
+        self._remember_value(pp)
+        value = self._pp_values[(commit.viewNo, commit.ppSeqNo)]
+        if not self._verifier.verify_sig(sig, value.as_single_value(), pk):
+            return "invalid BLS signature share from {}".format(sender)
+        return None
+
+    def process_commit(self, commit, sender: str):
+        pass
+
+    # -------------------------------------------------------------- ORDER
+
+    def process_order(self, key, commits: Dict[str, "Commit"], pp,
+                      quorums=None):
+        """Aggregate shares → MultiSignature → BlsStore (reference
+        bls_bft_replica_plenum.py process_order). Every share is verified
+        here — a COMMIT can arrive (and be counted for consensus) before
+        its PrePrepare, in which case its share was never checked — and
+        the aggregate is only persisted with a bls_signatures (n-f)
+        quorum of valid shares, so stored proofs always verify."""
+        value = self._pp_values.get((pp.viewNo, pp.ppSeqNo))
+        if value is None:
+            return
+        signed = value.as_single_value()
+        sigs, participants = [], []
+        for sender, commit in commits.items():
+            sig = getattr(commit, "blsSig", None)
+            if sig is None:
+                continue
+            pk = self._keys.get_key_by_name(sender)
+            if pk is None:
+                continue
+            if not self._verifier.verify_sig(sig, signed, pk):
+                logger.warning("%s dropping invalid BLS share from %s at %s",
+                               self._name, sender, key)
+                continue
+            sigs.append(sig)
+            participants.append(sender)
+        if quorums is not None \
+                and not quorums.bls_signatures.is_reached(len(sigs)):
+            return
+        if not sigs:
+            return
+        multi = MultiSignature(
+            signature=self._verifier.create_multi_sig(sigs),
+            participants=sorted(participants),
+            value=value)
+        self.bls_store.put(multi)
+        self._gc(pp.ppSeqNo)
+
+    def _gc(self, below_seq: int):
+        for k in [k for k in self._pp_values if k[1] < below_seq - 10]:
+            del self._pp_values[k]

@@ -1,0 +1,699 @@
+"""OrderingService — the three-phase commit itself.
+
+Reference: plenum/server/consensus/ordering_service.py (2,491 LoC):
+batch creation (send_3pc_batch :1961, send_pre_prepare :2169),
+PRE-PREPARE/PREPARE/COMMIT processing (:501/:223/:436), ordering
+(_order_3pc_key :1482), and re-ordering after view change
+(process_new_view_checkpoints_applied :2380).
+
+Execution is delegated through the BatchExecutor seam (the request
+pipeline implements it over ledgers + MPT state; tests use SimExecutor),
+keeping this service pure protocol logic — deterministic, mock-timed,
+network-agnostic. Bulk signature verification happens OUTSIDE this
+service (requests arrive already finalized via quorum of PROPAGATEs), so
+the TPU batch path never blocks 3PC.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+from abc import ABC, abstractmethod
+from collections import OrderedDict, defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.messages.internal_messages import (
+    CheckpointStabilized, NeedViewChange, NewViewCheckpointsApplied,
+    MasterReorderedAfterVC, RaisedSuspicion, ViewChangeStarted)
+from plenum_tpu.common.messages.node_messages import (
+    Commit, NewView, OldViewPrePrepareReply, OldViewPrePrepareRequest,
+    Ordered, PrePrepare, Prepare)
+from plenum_tpu.consensus.batch_id import BatchID, batch_id_from
+from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
+from plenum_tpu.runtime.stashing_router import (
+    DISCARD, PROCESS, StashingRouter)
+from plenum_tpu.runtime.timer import TimerService
+
+logger = logging.getLogger(__name__)
+
+# stash buckets (any verdict >= STASH stashes into its own bucket)
+STASH_VIEW_3PC = 2          # future view / waiting for NEW_VIEW
+STASH_CATCH_UP = 3          # node is catching up
+STASH_WATERMARKS = 4        # outside [h, H]
+STASH_WAITING_PREDECESSOR = 5  # PRE-PREPARE arrived out of order
+
+DOMAIN_LEDGER_ID = 1
+AUDIT_LEDGER_ID = 3
+
+
+class SuspiciousNode(Exception):
+    def __init__(self, node: str, code: int, reason: str, msg=None):
+        super().__init__("suspicion {} on {}: {}".format(code, node, reason))
+        self.node = node
+        self.code = code
+        self.reason = reason
+        self.msg = msg
+
+
+class Suspicions:
+    """Byzantine suspicion codes (reference plenum/server/suspicion_codes.py)."""
+    PPR_DIGEST_WRONG = 5
+    PPR_STATE_WRONG = 14
+    PPR_TXN_WRONG = 15
+    PPR_AUDIT_TXN_ROOT_HASH_WRONG = 19
+    PPR_TIME_WRONG = 16
+    PR_DIGEST_WRONG = 8
+    PR_STATE_WRONG = 17
+    PR_TXN_WRONG = 18
+    CM_BLS_SIG_WRONG = 21
+    PPR_BLS_MULTISIG_WRONG = 22
+    PPR_FRM_NON_PRIMARY = 2
+    DUPLICATE_PPR_SENT = 3
+    NEW_VIEW_INVALID_BATCHES = 26
+
+
+class BatchExecutor(ABC):
+    """Seam to the request/ledger pipeline (reference WriteRequestManager +
+    node executeBatch glue)."""
+
+    @abstractmethod
+    def apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
+                    pp_time: int) -> Tuple[str, str, str]:
+        """Apply finalized requests (by digest) as one uncommitted batch.
+        → (state_root_b58, txn_root_b58, audit_root_b58)."""
+
+    @abstractmethod
+    def revert_unordered_batches(self) -> int:
+        """Revert ALL uncommitted batches (view change). → count reverted."""
+
+    @abstractmethod
+    def revert_last_batch(self):
+        """Revert only the newest applied (uncommitted) batch — used when
+        ONE incoming PRE-PREPARE fails root comparison; earlier good
+        batches must stay applied."""
+
+    @abstractmethod
+    def commit_batch(self, ordered: Ordered):
+        """Durably commit the oldest applied batch."""
+
+    def is_request_known(self, digest: str) -> bool:
+        return True
+
+
+class SimExecutor(BatchExecutor):
+    """Deterministic executor for rung-2 consensus tests: 'roots' are a
+    hash chain over batch digests; no real ledgers."""
+
+    def __init__(self):
+        self.committed_root = "genesis"
+        self.applied: List[Tuple] = []
+        self.committed: List[Ordered] = []
+
+    def apply_batch(self, digests, ledger_id, pp_time):
+        from plenum_tpu.common.serializers.base58 import b58encode
+        base = self.applied[-1][0] if self.applied else self.committed_root
+        h = hashlib.sha256(
+            (base + "|" + "|".join(digests)).encode()).digest()
+        root = b58encode(h)
+        self.applied.append((root, list(digests), ledger_id))
+        return root, root, root
+
+    def revert_unordered_batches(self) -> int:
+        n = len(self.applied)
+        self.applied = []
+        return n
+
+    def revert_last_batch(self):
+        if self.applied:
+            self.applied.pop()
+
+    def commit_batch(self, ordered: Ordered):
+        if self.applied:
+            self.committed_root = self.applied.pop(0)[0]
+        self.committed.append(ordered)
+
+
+class OrderingService:
+    def __init__(self, data: ConsensusSharedData, timer: TimerService,
+                 bus, network, executor: BatchExecutor,
+                 stasher: Optional[StashingRouter] = None,
+                 config: Optional[Config] = None,
+                 bls_bft_replica=None,
+                 get_current_time=None,
+                 freshness_checker=None):
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._network = network
+        self._executor = executor
+        self._config = config or Config()
+        self._bls = bls_bft_replica
+        self._freshness_checker = freshness_checker
+        self._get_time = get_current_time or (
+            lambda: int(timer.get_current_time()))
+
+        self._stasher = stasher or StashingRouter(
+            limit=100000, buses=[bus, network])
+        self._stasher.subscribe(PrePrepare, self.process_preprepare)
+        self._stasher.subscribe(Prepare, self.process_prepare)
+        self._stasher.subscribe(Commit, self.process_commit)
+        self._stasher.subscribe(OldViewPrePrepareRequest,
+                                self.process_old_view_preprepare_request)
+        self._stasher.subscribe(OldViewPrePrepareReply,
+                                self.process_old_view_preprepare_reply)
+        bus.subscribe(ViewChangeStarted, self.process_view_change_started)
+        bus.subscribe(NewViewCheckpointsApplied,
+                      self.process_new_view_checkpoints_applied)
+        bus.subscribe(CheckpointStabilized, self.process_checkpoint_stabilized)
+
+        # finalized request digests awaiting ordering, per ledger
+        self.requestQueues: Dict[int, OrderedDict] = defaultdict(OrderedDict)
+        self._queue_entry_time: Dict[str, float] = {}
+
+        # 3PC message logs, keyed (view_no, pp_seq_no)
+        self.sent_preprepares: Dict[Tuple[int, int], PrePrepare] = {}
+        self.prePrepares: Dict[Tuple[int, int], PrePrepare] = {}
+        self.prepares: Dict[Tuple[int, int], Dict[str, Prepare]] = \
+            defaultdict(dict)
+        self.commits: Dict[Tuple[int, int], Dict[str, Commit]] = \
+            defaultdict(dict)
+        self.ordered: Set[Tuple[int, int]] = set()
+        self.batches: Dict[Tuple[int, int], PrePrepare] = {}  # applied order
+        # PrePrepares kept from the old view for re-ordering
+        self.old_view_preprepares: Dict[Tuple[int, int, str], PrePrepare] = {}
+        self._new_view_bids_to_reorder: List[BatchID] = []
+
+        self.lastPrePrepareSeqNo = 0
+        # highest pp_seq_no applied to uncommitted state, in order —
+        # PRE-PREPAREs must apply sequentially or roots diverge
+        self._last_applied_seq = 0
+        self._first_batch_after_vc = False
+
+    # ======================================================== properties
+
+    @property
+    def name(self):
+        return self._data.name
+
+    @property
+    def view_no(self):
+        return self._data.view_no
+
+    @property
+    def is_master(self):
+        return self._data.is_master
+
+    def _is_primary(self) -> bool:
+        return self._data.is_primary
+
+    # =========================================================== batching
+
+    def add_finalized_request(self, digest: str,
+                              ledger_id: int = DOMAIN_LEDGER_ID):
+        """Owner feeds quorum-propagated requests here (reference
+        Replica.readyFor3PC)."""
+        q = self.requestQueues[ledger_id]
+        if digest not in q:
+            q[digest] = True
+            self._queue_entry_time[digest] = self._timer.get_current_time()
+
+    def send_3pc_batch(self) -> int:
+        """Primary: create and send batches if triggers fire. Called every
+        prod tick (reference ordering_service.py:1961). → batches sent."""
+        if not self._is_primary() or self._data.waiting_for_new_view:
+            return 0
+        if not self._data.node_mode_participating:
+            return 0
+        sent = 0
+        for ledger_id in list(self.requestQueues.keys()):
+            queue = self.requestQueues[ledger_id]
+            if not queue:
+                continue
+            in_flight = self.lastPrePrepareSeqNo - self._data.last_ordered_3pc[1]
+            if in_flight >= self._config.Max3PCBatchesInFlight:
+                break
+            full = len(queue) >= self._config.Max3PCBatchSize
+            oldest = next(iter(queue), None)
+            waited = (self._timer.get_current_time()
+                      - self._queue_entry_time.get(oldest, 0))
+            if not full and waited < self._config.Max3PCBatchWait:
+                continue
+            if not self._data.is_in_watermarks(self.lastPrePrepareSeqNo + 1):
+                break
+            self._send_one_batch(ledger_id, queue)
+            sent += 1
+        return sent
+
+    def _send_one_batch(self, ledger_id: int, queue: OrderedDict):
+        digests = []
+        while queue and len(digests) < self._config.Max3PCBatchSize:
+            d, _ = queue.popitem(last=False)
+            self._queue_entry_time.pop(d, None)
+            digests.append(d)
+        pp_seq_no = self.lastPrePrepareSeqNo + 1
+        pp_time = self._get_time()
+        state_root, txn_root, audit_root = self._executor.apply_batch(
+            digests, ledger_id, pp_time)
+        params = dict(
+            instId=self._data.inst_id,
+            viewNo=self.view_no,
+            ppSeqNo=pp_seq_no,
+            ppTime=pp_time,
+            reqIdr=digests,
+            discarded="0",
+            digest=self.generate_pp_digest(digests, self.view_no, pp_time),
+            ledgerId=ledger_id,
+            stateRootHash=state_root,
+            txnRootHash=txn_root,
+            sub_seq_no=0,
+            final=False,
+            auditTxnRootHash=audit_root,
+            originalViewNo=self.view_no,
+        )
+        if self._bls is not None:
+            params = self._bls.update_pre_prepare(params, ledger_id)
+        pp = PrePrepare(**params)
+        self.lastPrePrepareSeqNo = pp_seq_no
+        self._last_applied_seq = pp_seq_no
+        self._data.pp_seq_no = pp_seq_no
+        self.sent_preprepares[(self.view_no, pp_seq_no)] = pp
+        self.prePrepares[(self.view_no, pp_seq_no)] = pp
+        self.batches[(self.view_no, pp_seq_no)] = pp
+        self._add_to_preprepared(pp)
+        self._network.send(pp)
+        self._try_prepared(pp)  # n=1 pools order immediately
+
+    @staticmethod
+    def generate_pp_digest(req_digests: List[str], original_view_no: int,
+                           pp_time: int) -> str:
+        # length-prefixed fields: no two distinct batch contents may
+        # collide (['ab','c'] vs ['a','bc'] would without framing)
+        h = hashlib.sha256()
+        for field in [str(original_view_no), str(pp_time), *req_digests]:
+            raw = field.encode()
+            h.update(len(raw).to_bytes(4, "big"))
+            h.update(raw)
+        return h.hexdigest()
+
+    # ====================================================== PRE-PREPARE
+
+    def process_preprepare(self, pp: PrePrepare, frm: str):
+        verdict = self._validate_3pc(pp)
+        if verdict is not None:
+            return verdict
+        key = (pp.viewNo, pp.ppSeqNo)
+        sender_is_primary = frm == self._data.primary_name
+        if self._is_primary():
+            # the primary does not process others' pre-prepares
+            return (DISCARD, "primary ignores incoming PRE-PREPARE")
+        if not sender_is_primary:
+            self._raise_suspicion(frm, Suspicions.PPR_FRM_NON_PRIMARY,
+                                  "PRE-PREPARE from non-primary", pp)
+            return (DISCARD, "PRE-PREPARE from non-primary")
+        if self.is_master and pp.ppSeqNo > self._last_applied_seq + 1:
+            # must apply in sequence or state roots diverge
+            return (STASH_WAITING_PREDECESSOR, "out-of-order PRE-PREPARE")
+        if key in self.prePrepares:
+            if self.prePrepares[key].digest != pp.digest:
+                self._raise_suspicion(frm, Suspicions.DUPLICATE_PPR_SENT,
+                                      "conflicting PRE-PREPARE", pp)
+            return (DISCARD, "duplicate PRE-PREPARE")
+        # content checks
+        if pp.digest != self.generate_pp_digest(
+                list(pp.reqIdr), pp.originalViewNo
+                if pp.originalViewNo is not None else pp.viewNo, pp.ppTime):
+            self._raise_suspicion(frm, Suspicions.PPR_DIGEST_WRONG,
+                                  "pp digest mismatch", pp)
+            return (DISCARD, "wrong digest")
+        deviation = abs(self._get_time() - pp.ppTime)
+        if deviation > self._config.ACCEPTABLE_DEVIATION_PREPREPARE_SECS:
+            self._raise_suspicion(frm, Suspicions.PPR_TIME_WRONG,
+                                  "pp time too far off", pp)
+            return (DISCARD, "bad ppTime")
+        if self._bls is not None:
+            err = self._bls.validate_pre_prepare(pp, frm)
+            if err:
+                self._raise_suspicion(
+                    frm, Suspicions.PPR_BLS_MULTISIG_WRONG, err, pp)
+                return (DISCARD, "bad BLS in PRE-PREPARE")
+        # apply and compare roots (only the master executes batches)
+        if self.is_master:
+            state_root, txn_root, audit_root = self._executor.apply_batch(
+                list(pp.reqIdr), pp.ledgerId, pp.ppTime)
+            if pp.stateRootHash is not None and state_root != pp.stateRootHash:
+                self._executor.revert_last_batch()
+                self._raise_suspicion(frm, Suspicions.PPR_STATE_WRONG,
+                                      "state root mismatch", pp)
+                return (DISCARD, "state root mismatch")
+            if pp.txnRootHash is not None and txn_root != pp.txnRootHash:
+                self._executor.revert_last_batch()
+                self._raise_suspicion(frm, Suspicions.PPR_TXN_WRONG,
+                                      "txn root mismatch", pp)
+                return (DISCARD, "txn root mismatch")
+            if pp.auditTxnRootHash is not None \
+                    and audit_root != pp.auditTxnRootHash:
+                self._executor.revert_last_batch()
+                self._raise_suspicion(
+                    frm, Suspicions.PPR_AUDIT_TXN_ROOT_HASH_WRONG,
+                    "audit root mismatch", pp)
+                return (DISCARD, "audit root mismatch")
+        self.prePrepares[key] = pp
+        self.batches[key] = pp
+        self.lastPrePrepareSeqNo = max(self.lastPrePrepareSeqNo, pp.ppSeqNo)
+        if self.is_master:
+            self._last_applied_seq = pp.ppSeqNo
+        self._consume_from_queue(pp)
+        self._add_to_preprepared(pp)
+        if self._bls is not None:
+            self._bls.process_pre_prepare(pp, frm)
+        self._send_prepare(pp)
+        # the successor may be waiting on us
+        self._stasher.process_all_stashed(STASH_WAITING_PREDECESSOR)
+        return None
+
+    def _add_to_preprepared(self, pp: PrePrepare):
+        bid = BatchID(pp.viewNo,
+                      pp.originalViewNo if pp.originalViewNo is not None
+                      else pp.viewNo,
+                      pp.ppSeqNo, pp.digest)
+        self._data.add_preprepared(bid)
+
+    def _send_prepare(self, pp: PrePrepare):
+        prepare = Prepare(
+            instId=self._data.inst_id,
+            viewNo=pp.viewNo,
+            ppSeqNo=pp.ppSeqNo,
+            ppTime=pp.ppTime,
+            digest=pp.digest,
+            stateRootHash=pp.stateRootHash,
+            txnRootHash=pp.txnRootHash,
+            auditTxnRootHash=pp.auditTxnRootHash,
+        )
+        if self._bls is not None:
+            self._bls.process_prepare(prepare, self.name)
+        self.prepares[(pp.viewNo, pp.ppSeqNo)][self.name] = prepare
+        self._network.send(prepare)
+        self._try_prepared(pp)
+
+    # ========================================================== PREPARE
+
+    def process_prepare(self, prepare: Prepare, frm: str):
+        verdict = self._validate_3pc(prepare)
+        if verdict is not None:
+            return verdict
+        key = (prepare.viewNo, prepare.ppSeqNo)
+        if frm in self.prepares[key]:
+            return (DISCARD, "duplicate PREPARE from {}".format(frm))
+        pp = self.prePrepares.get(key)
+        if pp is not None and prepare.digest != pp.digest:
+            self._raise_suspicion(frm, Suspicions.PR_DIGEST_WRONG,
+                                  "PREPARE digest mismatch", prepare)
+            return (DISCARD, "PREPARE digest mismatch")
+        self.prepares[key][frm] = prepare
+        if pp is not None:
+            self._try_prepared(pp)
+        return None
+
+    def _has_prepared(self, key: Tuple[int, int]) -> bool:
+        """Quorum n-f-1 of PREPAREs (non-primary nodes incl. self)."""
+        if key not in self.prePrepares:
+            return False
+        count = len([s for s in self.prepares[key]
+                     if s != self._data.primary_name])
+        return self._data.quorums.prepare.is_reached(count)
+
+    def _try_prepared(self, pp: PrePrepare):
+        key = (pp.viewNo, pp.ppSeqNo)
+        n = self._data.total_nodes
+        if n > 1 and not self._has_prepared(key):
+            return
+        if key in self.ordered:
+            return
+        bid = BatchID(pp.viewNo,
+                      pp.originalViewNo if pp.originalViewNo is not None
+                      else pp.viewNo,
+                      pp.ppSeqNo, pp.digest)
+        if bid not in self._data.prepared:
+            self._data.add_prepared(bid)
+            self._data.last_batch_prepared = bid
+            self._send_commit(pp)
+        self._try_order(pp)
+
+    def _send_commit(self, pp: PrePrepare):
+        key = (pp.viewNo, pp.ppSeqNo)
+        params = dict(instId=self._data.inst_id, viewNo=pp.viewNo,
+                      ppSeqNo=pp.ppSeqNo)
+        if self._bls is not None:
+            params = self._bls.update_commit(params, pp)
+        commit = Commit(**params)
+        self.commits[key][self.name] = commit
+        self._network.send(commit)
+
+    # =========================================================== COMMIT
+
+    def process_commit(self, commit: Commit, frm: str):
+        verdict = self._validate_3pc(commit)
+        if verdict is not None:
+            return verdict
+        key = (commit.viewNo, commit.ppSeqNo)
+        if frm in self.commits[key]:
+            return (DISCARD, "duplicate COMMIT from {}".format(frm))
+        if self._bls is not None:
+            pp = self.prePrepares.get(key)
+            if pp is not None:
+                err = self._bls.validate_commit(commit, frm, pp)
+                if err:
+                    self._raise_suspicion(frm, Suspicions.CM_BLS_SIG_WRONG,
+                                          err, commit)
+                    return (DISCARD, "bad BLS sig in COMMIT")
+        self.commits[key][frm] = commit
+        pp = self.prePrepares.get(key)
+        if pp is not None:
+            self._try_order(pp)
+        return None
+
+    def _has_committed(self, key: Tuple[int, int]) -> bool:
+        return self._data.quorums.commit.is_reached(len(self.commits[key]))
+
+    def _try_order(self, pp: PrePrepare):
+        key = (pp.viewNo, pp.ppSeqNo)
+        if key in self.ordered:
+            return
+        n = self._data.total_nodes
+        if n > 1:
+            if not self._has_prepared(key) or not self._has_committed(key):
+                return
+        # order strictly in sequence
+        if pp.ppSeqNo != self._data.last_ordered_3pc[1] + 1:
+            return
+        self._order(pp)
+        # cascade: later batches may now be orderable
+        next_key = (self.view_no, pp.ppSeqNo + 1)
+        next_pp = self.prePrepares.get(next_key)
+        if next_pp is not None:
+            self._try_order(next_pp)
+
+    def _consume_from_queue(self, pp: PrePrepare):
+        """Requests inside a PrePrepare leave the proposal queue — a later
+        primary must not re-propose them after a view change."""
+        queue = self.requestQueues.get(pp.ledgerId)
+        if queue is not None:
+            for digest in pp.reqIdr:
+                queue.pop(digest, None)
+                self._queue_entry_time.pop(digest, None)
+
+    def _order(self, pp: PrePrepare):
+        key = (pp.viewNo, pp.ppSeqNo)
+        self.ordered.add(key)
+        self._data.last_ordered_3pc = key
+        self._consume_from_queue(pp)
+        if self._bls is not None:
+            self._bls.process_order(key, self.commits[key], pp,
+                                    self._data.quorums)
+        ordered = Ordered(
+            instId=pp.instId,
+            viewNo=pp.viewNo,
+            valid_reqIdr=list(pp.reqIdr),
+            invalid_reqIdr=[],
+            ppSeqNo=pp.ppSeqNo,
+            ppTime=pp.ppTime,
+            ledgerId=pp.ledgerId,
+            stateRootHash=pp.stateRootHash,
+            txnRootHash=pp.txnRootHash,
+            auditTxnRootHash=pp.auditTxnRootHash,
+            primaries=[self._data.primary_name or ""],
+            originalViewNo=pp.originalViewNo,
+            digest=pp.digest,
+        )
+        self._bus.send(ordered)
+        if self._new_view_bids_to_reorder:
+            self._new_view_bids_to_reorder = [
+                b for b in self._new_view_bids_to_reorder
+                if b.pp_seq_no > pp.ppSeqNo]
+            if not self._new_view_bids_to_reorder and self.is_master:
+                self._bus.send(MasterReorderedAfterVC())
+
+    # ======================================================= validation
+
+    def _validate_3pc(self, msg):
+        """Common 3PC message validation verdicts (reference
+        ordering_service_msg_validator.py)."""
+        if msg.instId != self._data.inst_id:
+            return (DISCARD, "wrong instance")
+        if not self._data.node_mode_participating:
+            return (STASH_CATCH_UP, "catching up")
+        if msg.viewNo < self.view_no:
+            return (DISCARD, "old view")
+        if msg.viewNo > self.view_no:
+            return (STASH_VIEW_3PC, "future view")
+        if self._data.waiting_for_new_view:
+            return (STASH_VIEW_3PC, "waiting for NEW_VIEW")
+        if msg.ppSeqNo <= self._data.low_watermark:
+            return (DISCARD, "below low watermark")
+        if msg.ppSeqNo > self._data.high_watermark:
+            return (STASH_WATERMARKS, "above high watermark")
+        return None
+
+    def _raise_suspicion(self, frm: str, code: int, reason: str, msg):
+        self._bus.send(RaisedSuspicion(
+            inst_id=self._data.inst_id,
+            ex=SuspiciousNode(frm, code, reason, msg)))
+
+    # ===================================================== view changes
+
+    def process_view_change_started(self, msg: ViewChangeStarted):
+        """Revert uncommitted work; keep old-view PrePrepares for
+        re-ordering (reference ordering_service view_change hooks)."""
+        if self.is_master:
+            self._executor.revert_unordered_batches()
+        self._last_applied_seq = self._data.last_ordered_3pc[1]
+        # reverted (unordered) requests go back in the queue: if NEW_VIEW
+        # re-orders them they are consumed again at re-apply; if not, the
+        # new primary re-proposes them
+        for key, pp in list(self.prePrepares.items()) + \
+                list(self.sent_preprepares.items()):
+            if pp.ppSeqNo > self._data.last_ordered_3pc[1]:
+                for digest in pp.reqIdr:
+                    self.add_finalized_request(digest, pp.ledgerId)
+        for key, pp in self.prePrepares.items():
+            ov = pp.originalViewNo if pp.originalViewNo is not None \
+                else pp.viewNo
+            self.old_view_preprepares[(ov, pp.ppSeqNo, pp.digest)] = pp
+        for key, pp in self.sent_preprepares.items():
+            ov = pp.originalViewNo if pp.originalViewNo is not None \
+                else pp.viewNo
+            self.old_view_preprepares[(ov, pp.ppSeqNo, pp.digest)] = pp
+        self.sent_preprepares.clear()
+        self.prePrepares.clear()
+        self.prepares.clear()
+        self.commits.clear()
+        self.batches.clear()
+        self._new_view_bids_to_reorder = []
+
+    def process_new_view_checkpoints_applied(
+            self, msg: NewViewCheckpointsApplied):
+        """Re-order batches chosen by the NEW_VIEW (reference :2380).
+        Re-application is strictly sequential: a missing old-view
+        PrePrepare pauses everything after it until the reply arrives —
+        applying out of order would diverge the uncommitted state."""
+        pending = sorted(
+            (batch_id_from(b) for b in msg.batches
+             if batch_id_from(b).pp_seq_no > self._data.last_ordered_3pc[1]),
+            key=lambda b: b.pp_seq_no)
+        self._new_view_bids_to_reorder = list(pending)
+        missing = [b for b in pending if self.old_view_preprepares.get(
+            (b.pp_view_no, b.pp_seq_no, b.pp_digest)) is None]
+        if missing:
+            req = OldViewPrePrepareRequest(
+                instId=self._data.inst_id,
+                batch_ids=[list(b) for b in missing])
+            self._network.send(req)
+        self.lastPrePrepareSeqNo = self._data.last_ordered_3pc[1]
+        self._reapply_ready_batches()
+        if not msg.batches and self.is_master:
+            self._bus.send(MasterReorderedAfterVC())
+
+    def _reapply_ready_batches(self):
+        """Re-apply pending new-view batches in sequence, stopping at the
+        first one whose old-view PrePrepare we still lack."""
+        for bid in sorted(self._new_view_bids_to_reorder,
+                          key=lambda b: b.pp_seq_no):
+            if (self.view_no, bid.pp_seq_no) in self.prePrepares:
+                continue  # already re-applied
+            pp = self.old_view_preprepares.get(
+                (bid.pp_view_no, bid.pp_seq_no, bid.pp_digest))
+            if pp is None:
+                break  # wait for OldViewPrePrepareReply
+            self._reapply_old_view_preprepare(bid, pp)
+
+    def _reapply_old_view_preprepare(self, bid: BatchID, old_pp: PrePrepare):
+        params = dict(old_pp.as_dict())
+        params["viewNo"] = self.view_no
+        params["originalViewNo"] = bid.pp_view_no
+        pp = PrePrepare(**params)
+        key = (pp.viewNo, pp.ppSeqNo)
+        self.prePrepares[key] = pp
+        self.batches[key] = pp
+        self.lastPrePrepareSeqNo = max(self.lastPrePrepareSeqNo, pp.ppSeqNo)
+        if self.is_master:
+            self._executor.apply_batch(list(pp.reqIdr), pp.ledgerId,
+                                       pp.ppTime)
+            self._last_applied_seq = pp.ppSeqNo
+        self._consume_from_queue(pp)
+        self._add_to_preprepared(pp)
+        if self._is_primary():
+            self.sent_preprepares[key] = pp
+            self._network.send(pp)
+            self._try_prepared(pp)
+        else:
+            self._send_prepare(pp)
+
+    def process_old_view_preprepare_request(
+            self, msg: OldViewPrePrepareRequest, frm: str):
+        pps = []
+        for bid in msg.batch_ids:
+            bid = batch_id_from(bid)
+            pp = self.old_view_preprepares.get(
+                (bid.pp_view_no, bid.pp_seq_no, bid.pp_digest))
+            if pp is not None:
+                pps.append(pp.as_dict())
+        if pps:
+            self._network.send(
+                OldViewPrePrepareReply(instId=self._data.inst_id,
+                                       preprepares=pps), [frm])
+        return None
+
+    def process_old_view_preprepare_reply(self, msg: OldViewPrePrepareReply,
+                                          frm: str):
+        for pp_dict in msg.preprepares:
+            try:
+                pp = PrePrepare(**pp_dict)
+            except Exception:
+                continue
+            ov = pp.originalViewNo if pp.originalViewNo is not None \
+                else pp.viewNo
+            self.old_view_preprepares[(ov, pp.ppSeqNo, pp.digest)] = pp
+        # whatever is now contiguous from the front can be re-applied
+        self._reapply_ready_batches()
+        return None
+
+    # ====================================================== checkpoints
+
+    def process_checkpoint_stabilized(self, msg: CheckpointStabilized):
+        """GC 3PC logs at or below the stable checkpoint (reference
+        ordering_service.py:2459 gc)."""
+        stable_seq = msg.last_stable_3pc[1]
+        for store in (self.sent_preprepares, self.prePrepares,
+                      self.prepares, self.commits, self.batches):
+            for key in [k for k in store if k[1] <= stable_seq]:
+                del store[key]
+        self.ordered = {k for k in self.ordered if k[1] > stable_seq}
+        self._stasher.process_all_stashed(STASH_WATERMARKS)
+
+    # ============================================================= misc
+
+    def on_catchup_finished(self):
+        self._stasher.process_all_stashed(STASH_CATCH_UP)
+
+    def on_view_change_completed(self):
+        self._stasher.process_all_stashed(STASH_VIEW_3PC)

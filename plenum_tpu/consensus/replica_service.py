@@ -1,0 +1,131 @@
+"""ReplicaService — the clean aggregate of all consensus services.
+
+Reference: plenum/server/consensus/replica_service.py:33 — "the intended
+plenum 2.0 Replica". One protocol instance on one node: shared data + the
+ordering/checkpoint/view-change services wired over one InternalBus, one
+ExternalBus (the network), one TimerService and one StashingRouter. This
+is also the unit the simulation tests drive (SURVEY.md §4 rung 2).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.messages.internal_messages import (
+    NeedViewChange, NewViewAccepted, RaisedSuspicion, VoteForViewChange)
+from plenum_tpu.common.messages.node_messages import Ordered
+from plenum_tpu.consensus.checkpoint_service import CheckpointService
+from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
+from plenum_tpu.consensus.ordering_service import (
+    BatchExecutor, OrderingService, SimExecutor)
+from plenum_tpu.consensus.primary_selector import (
+    RoundRobinConstantNodesPrimariesSelector)
+from plenum_tpu.consensus.view_change_service import ViewChangeService
+from plenum_tpu.consensus.view_change_trigger_service import (
+    ViewChangeTriggerService)
+from plenum_tpu.runtime.bus import InternalBus
+from plenum_tpu.runtime.stashing_router import StashingRouter
+from plenum_tpu.runtime.timer import TimerService
+
+
+class ReplicaService:
+    def __init__(self, name: str, validators: List[str],
+                 timer: TimerService, network,
+                 inst_id: int = 0, is_master: bool = True,
+                 executor: Optional[BatchExecutor] = None,
+                 config: Optional[Config] = None,
+                 bls_bft_replica=None,
+                 internal_bus: Optional[InternalBus] = None,
+                 checkpoint_digest_source: Optional[Callable] = None):
+        self.name = name
+        self.config = config or Config()
+        self.internal_bus = internal_bus or InternalBus()
+        self.network = network
+        self.timer = timer
+        self.executor = executor or SimExecutor()
+
+        self._data = ConsensusSharedData(
+            name, validators, inst_id, is_master,
+            log_size=self.config.LOG_SIZE)
+        selector = RoundRobinConstantNodesPrimariesSelector(validators)
+        self._data.primary_name = selector.select_master_primary(0)
+
+        self.stasher = StashingRouter(
+            limit=self.config.MAX_REQUEST_QUEUE_SIZE,
+            buses=[self.internal_bus, network])
+
+        self.ordering = OrderingService(
+            data=self._data, timer=timer, bus=self.internal_bus,
+            network=network, executor=self.executor, stasher=self.stasher,
+            config=self.config, bls_bft_replica=bls_bft_replica)
+        self.checkpointer = CheckpointService(
+            data=self._data, bus=self.internal_bus, network=network,
+            stasher=self.stasher, config=self.config,
+            digest_source=checkpoint_digest_source)
+        self.view_changer = ViewChangeService(
+            data=self._data, timer=timer, bus=self.internal_bus,
+            network=network, stasher=self.stasher, config=self.config,
+            primaries_selector=selector)
+        self.vc_trigger = ViewChangeTriggerService(
+            data=self._data, timer=timer, bus=self.internal_bus,
+            network=network, config=self.config)
+        from plenum_tpu.consensus.message_req_service import MessageReqService
+        self.message_req = MessageReqService(
+            data=self._data, timer=timer, bus=self.internal_bus,
+            network=network, ordering=self.ordering, config=self.config)
+
+        self.internal_bus.subscribe(Ordered, self._on_ordered)
+        self.internal_bus.subscribe(NewViewAccepted, self._on_new_view)
+        self.internal_bus.subscribe(RaisedSuspicion, self._on_suspicion)
+        self.ordered_log: List[Ordered] = []
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def data(self) -> ConsensusSharedData:
+        return self._data
+
+    @property
+    def view_no(self) -> int:
+        return self._data.view_no
+
+    @property
+    def is_primary(self) -> bool:
+        return self._data.is_primary
+
+    @property
+    def last_ordered(self):
+        return self._data.last_ordered_3pc
+
+    # ------------------------------------------------------------ inputs
+
+    def submit_request(self, digest: str, ledger_id: int = 1):
+        """Feed a finalized (quorum-propagated) request digest."""
+        self.ordering.add_finalized_request(digest, ledger_id)
+
+    def service(self):
+        """One prod tick: send batches if primary."""
+        return self.ordering.send_3pc_batch()
+
+    def start_view_change(self, view_no: Optional[int] = None):
+        """Vote for a view change (broadcast INSTANCE_CHANGE); the view
+        change itself starts when a strong quorum of votes accumulates."""
+        self.internal_bus.send(VoteForViewChange(suspicion="external",
+                                                 view_no=view_no))
+
+    # ------------------------------------------------------------- hooks
+
+    def _on_ordered(self, ordered: Ordered):
+        self.ordered_log.append(ordered)
+        self.executor.commit_batch(ordered)
+
+    def _on_new_view(self, msg: NewViewAccepted):
+        if msg.checkpoint:
+            self.checkpointer.on_view_change_completed(
+                msg.checkpoint["seqNoEnd"])
+        self.ordering.on_view_change_completed()
+
+    def _on_suspicion(self, msg: RaisedSuspicion):
+        # route byzantine suspicions into view-change votes (master only)
+        if self._data.is_master:
+            self.internal_bus.send(VoteForViewChange(suspicion=msg.ex))

@@ -1,0 +1,354 @@
+"""ViewChangeService — the NEW_VIEW protocol.
+
+Reference: plenum/server/consensus/view_change_service.py:
+process_need_view_change (:71), _build_view_change_msg (:141),
+process_view_change_message (:162), _send_new_view_if_needed (:242),
+_finish_view_change (:314), NewViewBuilder.calc_checkpoint (:363) /
+calc_batches (:398).
+
+Flow: NeedViewChange → view_no += 1, broadcast VIEW_CHANGE carrying this
+replica's prepared/preprepared evidence + checkpoints; every node acks
+others' VIEW_CHANGEs to the NEW primary; the new primary, once it holds
+n-f VIEW_CHANGEs (each confirmed by quorum of acks or direct receipt),
+deterministically computes the checkpoint and batch set and broadcasts
+NEW_VIEW; everyone validates it by recomputing the same decision.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.messages.internal_messages import (
+    NeedViewChange, NewViewAccepted, NewViewCheckpointsApplied,
+    VoteForViewChange, ViewChangeStarted)
+from plenum_tpu.common.messages.node_messages import (
+    Checkpoint, NewView, ViewChange, ViewChangeAck)
+from plenum_tpu.common.serializers.serialization import serialize_msg_for_signing
+from plenum_tpu.consensus.batch_id import BatchID, batch_id_from
+from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
+from plenum_tpu.consensus.primary_selector import (
+    RoundRobinConstantNodesPrimariesSelector)
+from plenum_tpu.runtime.stashing_router import DISCARD, StashingRouter
+from plenum_tpu.runtime.timer import RepeatingTimer, TimerService
+
+logger = logging.getLogger(__name__)
+
+STASH_FUTURE_VIEW = 7
+
+
+def view_change_digest(vc: ViewChange) -> str:
+    return hashlib.sha256(serialize_msg_for_signing(vc.as_dict())).hexdigest()
+
+
+class NewViewBuilder:
+    """Deterministic batch-set / checkpoint merge from n-f VIEW_CHANGEs
+    (reference view_change_service.py:355-487). Pure functions of the
+    input set — every honest node computes the same NEW_VIEW."""
+
+    def __init__(self, data: ConsensusSharedData):
+        self._data = data
+
+    def calc_checkpoint(self, vcs: List[ViewChange]) -> Optional[dict]:
+        """Highest checkpoint claimed stable by a weak quorum (f+1) and
+        not ahead of a strong quorum's progress."""
+        candidates = []
+        for vc in vcs:
+            for chk in vc.checkpoints:
+                if chk not in candidates:
+                    candidates.append(chk)
+        best = None
+        for chk in candidates:
+            end = chk["seqNoEnd"]
+            # at least f+1 replicas have this checkpoint
+            have = sum(1 for vc in vcs if chk in vc.checkpoints)
+            if not self._data.quorums.weak.is_reached(have):
+                continue
+            # at least n-f replicas can reach it (stable ≤ end)
+            reachable = sum(1 for vc in vcs if vc.stableCheckpoint <= end)
+            if not self._data.quorums.strong.is_reached(reachable):
+                continue
+            if best is None or end > best["seqNoEnd"]:
+                best = chk
+        return best
+
+    def calc_batches(self, checkpoint: Optional[dict],
+                     vcs: List[ViewChange]) -> Optional[List[BatchID]]:
+        """Batches to re-order in the new view: PBFT-style merge —
+        a batch is included if prepared in ≥ f+1 VIEW_CHANGEs (strong
+        evidence it may have been ordered) or preprepared in ≥ n-f
+        (could not have been ordered differently)."""
+        if checkpoint is None:
+            return None
+        start = checkpoint["seqNoEnd"]
+        max_seq = max((batch_id_from(b).pp_seq_no
+                       for vc in vcs for b in vc.prepared + vc.preprepared),
+                      default=start)
+        batches: List[BatchID] = []
+        for seq in range(start + 1, max_seq + 1):
+            bid = self._select_batch_for_seq(seq, vcs)
+            if bid is None:
+                # nothing at all was pre-prepared here, so nothing after
+                # it can have been ordered either (primaries allocate
+                # seq_nos sequentially): safe end of the chain
+                break
+            batches.append(bid)
+        return batches
+
+    def _select_batch_for_seq(self, seq: int,
+                              vcs: List[ViewChange]) -> Optional[BatchID]:
+        """Deterministic choice for one seq_no. Safety: a batch ordered at
+        this seq had n-f commits ⇒ n-f prepared ⇒ any n-f subset of
+        VIEW_CHANGEs contains ≥ n-2f ≥ f+1 that prepared it, so it always
+        shows up as a weak-quorum prepared candidate. If no candidate has
+        weak-quorum prepared support, nothing was ordered here and any
+        deterministic pick among pre-prepared candidates preserves
+        consistency (everyone computes from the same referenced set)."""
+        prepared_votes: Dict[Tuple, int] = defaultdict(int)
+        preprepared_votes: Dict[Tuple, int] = defaultdict(int)
+        for vc in vcs:
+            for b in vc.prepared:
+                b = batch_id_from(b)
+                if b.pp_seq_no == seq:
+                    prepared_votes[(b.pp_view_no, b.pp_digest)] += 1
+            for b in vc.preprepared:
+                b = batch_id_from(b)
+                if b.pp_seq_no == seq:
+                    preprepared_votes[(b.pp_view_no, b.pp_digest)] += 1
+        best = None
+        for (view, digest), votes in prepared_votes.items():
+            if self._data.quorums.weak.is_reached(votes):
+                if best is None or (view, digest) > best:
+                    best = (view, digest)
+        if best is None and preprepared_votes:
+            # keep the chain contiguous: deterministic (votes, view,
+            # digest)-max among pre-prepared candidates
+            ranked = sorted(preprepared_votes.items(),
+                            key=lambda kv: (kv[1], kv[0]))
+            best = ranked[-1][0]
+        if best is None:
+            return None
+        return BatchID(self._data.view_no, best[0], seq, best[1])
+
+
+class ViewChangeService:
+    def __init__(self, data: ConsensusSharedData, timer: TimerService,
+                 bus, network, stasher: Optional[StashingRouter] = None,
+                 config: Optional[Config] = None,
+                 primaries_selector=None):
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._network = network
+        self._config = config or Config()
+        self._selector = primaries_selector or \
+            RoundRobinConstantNodesPrimariesSelector(data.validators)
+        self._builder = NewViewBuilder(data)
+
+        self._stasher = stasher or StashingRouter(limit=10000,
+                                                  buses=[bus, network])
+        self._stasher.subscribe(ViewChange, self.process_view_change_message)
+        self._stasher.subscribe(ViewChangeAck, self.process_view_change_ack)
+        self._stasher.subscribe(NewView, self.process_new_view_message)
+        bus.subscribe(NeedViewChange, self.process_need_view_change)
+
+        # view_no -> frm -> ViewChange
+        self._view_changes: Dict[int, Dict[str, ViewChange]] = \
+            defaultdict(dict)
+        # view_no -> (frm, digest) -> set of ack senders
+        self._acks: Dict[int, Dict[Tuple[str, str], set]] = \
+            defaultdict(lambda: defaultdict(set))
+        self._new_view: Optional[NewView] = None
+        self._new_view_timer: Optional[RepeatingTimer] = None
+        self._resend_timer: Optional[RepeatingTimer] = None
+
+    # ------------------------------------------------------------ trigger
+
+    def process_need_view_change(self, msg: NeedViewChange):
+        proposed = msg.view_no if msg.view_no is not None \
+            else self._data.view_no + 1
+        if proposed <= self._data.view_no and self._data.view_no != 0:
+            return
+        self._start_view_change(proposed)
+
+    def _start_view_change(self, proposed_view_no: int):
+        old_view = self._data.view_no
+        self._data.view_no = proposed_view_no
+        self._data.waiting_for_new_view = True
+        self._data.primary_name = self._selector.select_master_primary(
+            proposed_view_no)
+        self._new_view = None
+        logger.info("%s starting view change %d → %d (new primary %s)",
+                    self._data.name, old_view, proposed_view_no,
+                    self._data.primary_name)
+        # tell ordering to revert uncommitted + archive old-view PPs
+        self._bus.send(ViewChangeStarted(view_no=proposed_view_no))
+        vc = self._build_view_change_msg()
+        self._view_changes[proposed_view_no][self._data.name] = vc
+        self._network.send(vc)
+        self._schedule_new_view_timeout()
+        self._stasher.process_all_stashed(STASH_FUTURE_VIEW)
+        self._try_finish()
+
+    def _build_view_change_msg(self) -> ViewChange:
+        return ViewChange(
+            viewNo=self._data.view_no,
+            stableCheckpoint=self._data.stable_checkpoint,
+            prepared=[list(b) for b in self._data.prepared],
+            preprepared=[list(b) for b in self._data.preprepared],
+            checkpoints=[c.as_dict() for c in self._data.checkpoints],
+        )
+
+    def _schedule_new_view_timeout(self):
+        self._cancel_timers()
+        view_at_schedule = self._data.view_no
+
+        def on_timeout():
+            if self._data.waiting_for_new_view \
+                    and self._data.view_no == view_at_schedule:
+                logger.warning("%s NEW_VIEW timeout in view %d",
+                               self._data.name, view_at_schedule)
+                self._bus.send(VoteForViewChange(
+                    suspicion="NEW_VIEW_TIMEOUT",
+                    view_no=view_at_schedule + 1))
+
+        self._new_view_timer = RepeatingTimer(
+            self._timer, self._config.NEW_VIEW_TIMEOUT, on_timeout)
+
+    def _cancel_timers(self):
+        if self._new_view_timer is not None:
+            self._new_view_timer.stop()
+            self._new_view_timer = None
+
+    # ----------------------------------------------------------- messages
+
+    def process_view_change_message(self, vc: ViewChange, frm: str):
+        if vc.viewNo < self._data.view_no:
+            return (DISCARD, "old view change")
+        if vc.viewNo > self._data.view_no:
+            return (STASH_FUTURE_VIEW, "future view change")
+        self._view_changes[vc.viewNo][frm] = vc
+        # ack to the new primary (they may not have received it directly)
+        primary = self._selector.select_master_primary(vc.viewNo)
+        if self._data.name != primary and frm != primary:
+            ack = ViewChangeAck(viewNo=vc.viewNo, name=frm,
+                                digest=view_change_digest(vc))
+            self._network.send(ack, [primary])
+        self._try_finish()
+        return None
+
+    def process_view_change_ack(self, ack: ViewChangeAck, frm: str):
+        if ack.viewNo < self._data.view_no:
+            return (DISCARD, "old ack")
+        if ack.viewNo > self._data.view_no:
+            return (STASH_FUTURE_VIEW, "future ack")
+        self._acks[ack.viewNo][(ack.name, ack.digest)].add(frm)
+        self._try_finish()
+        return None
+
+    def process_new_view_message(self, nv: NewView, frm: str):
+        if nv.viewNo < self._data.view_no:
+            return (DISCARD, "old new view")
+        if nv.viewNo > self._data.view_no:
+            return (STASH_FUTURE_VIEW, "future new view")
+        primary = self._selector.select_master_primary(nv.viewNo)
+        if frm != primary:
+            return (DISCARD, "NEW_VIEW from non-primary")
+        if not self._data.waiting_for_new_view:
+            return (DISCARD, "not in view change")
+        self._new_view = nv
+        self._try_finish()
+        return None
+
+    # ------------------------------------------------------------- finish
+
+    def _confirmed_view_changes(self, view_no: int) -> List[ViewChange]:
+        """VIEW_CHANGEs usable as NEW_VIEW evidence. The new primary only
+        uses a VIEW_CHANGE once a quorum (n-f-1) of OTHER nodes has acked
+        the same digest — so a byzantine node cannot feed the primary a
+        VIEW_CHANGE nobody else saw (reference view_change_service
+        ack handling). Non-primaries recompute from direct receipts."""
+        vcs = self._view_changes[view_no]
+        if self._data.primary_name != self._data.name:
+            return list(vcs.values())
+        confirmed = []
+        for frm, vc in vcs.items():
+            if frm == self._data.name:
+                confirmed.append(vc)
+                continue
+            ackers = self._acks[view_no][(frm, view_change_digest(vc))]
+            ackers = ackers - {frm, self._data.name}
+            # the primary's own direct receipt counts as one confirmation
+            # (otherwise a single dead node makes the quorum unreachable)
+            if self._data.quorums.view_change_ack.is_reached(
+                    len(ackers) + 1):
+                confirmed.append(vc)
+        return confirmed
+
+    def _try_finish(self):
+        if not self._data.waiting_for_new_view:
+            return
+        view_no = self._data.view_no
+        vcs = self._confirmed_view_changes(view_no)
+        if not self._data.quorums.view_change.is_reached(len(vcs)):
+            return
+        i_am_primary = self._data.primary_name == self._data.name
+        if i_am_primary and self._new_view is None:
+            self._send_new_view(view_no, vcs)
+        if self._new_view is None:
+            return
+        self._finish_view_change(self._new_view)
+
+    def _send_new_view(self, view_no: int, vcs: List[ViewChange]):
+        checkpoint = self._builder.calc_checkpoint(vcs)
+        batches = self._builder.calc_batches(checkpoint, vcs)
+        if batches is None:
+            return  # not enough evidence yet; wait for more view changes
+        nv = NewView(
+            viewNo=view_no,
+            viewChanges=sorted(
+                [[frm, view_change_digest(vc)]
+                 for frm, vc in self._view_changes[view_no].items()]),
+            checkpoint=checkpoint,
+            batches=[list(b) for b in batches],
+        )
+        self._new_view = nv
+        self._network.send(nv)
+
+    def _finish_view_change(self, nv: NewView):
+        # validate the primary's decision by recomputing it from our own
+        # set of VIEW_CHANGEs (if we have them all)
+        view_no = self._data.view_no
+        have = self._view_changes[view_no]
+        referenced = {tuple(x) for x in nv.viewChanges}
+        usable = [have[frm] for frm, digest in referenced
+                  if frm in have
+                  and view_change_digest(have[frm]) == digest]
+        if not self._data.quorums.view_change.is_reached(len(usable)):
+            return  # wait until we hold the referenced VIEW_CHANGEs
+        checkpoint = self._builder.calc_checkpoint(usable)
+        batches = self._builder.calc_batches(checkpoint, usable)
+        if checkpoint != nv.checkpoint or \
+                [list(b) for b in (batches or [])] != \
+                [list(batch_id_from(b)) for b in nv.batches]:
+            logger.warning("%s NEW_VIEW mismatch — voting next view",
+                           self._data.name)
+            self._bus.send(VoteForViewChange(
+                suspicion="NEW_VIEW_MISMATCH", view_no=view_no + 1))
+            return
+        self._data.waiting_for_new_view = False
+        self._cancel_timers()
+        self._bus.send(NewViewAccepted(
+            view_no=view_no,
+            view_changes=list(nv.viewChanges),
+            checkpoint=checkpoint,
+            batches=[batch_id_from(b) for b in nv.batches]))
+        self._bus.send(NewViewCheckpointsApplied(
+            view_no=view_no,
+            view_changes=list(nv.viewChanges),
+            checkpoint=checkpoint,
+            batches=[batch_id_from(b) for b in nv.batches]))
+        logger.info("%s completed view change to view %d",
+                    self._data.name, view_no)

@@ -1,0 +1,105 @@
+"""ViewChangeTriggerService — InstanceChange votes → NeedViewChange.
+
+Reference: plenum/server/consensus/view_change_trigger_service.py (146 LoC)
++ plenum/server/view_change/instance_change_provider.py (vote cache with
+TTL). Suspicions/timeouts become INSTANCE_CHANGE broadcasts; a strong
+quorum (n-f) of votes for the same higher view — including our own —
+starts the view change.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.messages.internal_messages import (
+    NeedViewChange, VoteForViewChange)
+from plenum_tpu.common.messages.node_messages import InstanceChange
+from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
+from plenum_tpu.runtime.stashing_router import DISCARD
+from plenum_tpu.runtime.timer import TimerService
+
+logger = logging.getLogger(__name__)
+
+GENERIC_SUSPICION_CODE = 25
+
+
+class InstanceChangeCache:
+    """view_no -> voter -> vote timestamp, with TTL expiry."""
+
+    def __init__(self, timer: TimerService, ttl: float):
+        self._timer = timer
+        self._ttl = ttl
+        self._votes: Dict[int, Dict[str, float]] = {}
+
+    def add_vote(self, view_no: int, voter: str):
+        self._votes.setdefault(view_no, {})[voter] = \
+            self._timer.get_current_time()
+
+    def votes(self, view_no: int) -> int:
+        self._expire(view_no)
+        return len(self._votes.get(view_no, {}))
+
+    def has_vote_from(self, view_no: int, voter: str) -> bool:
+        self._expire(view_no)
+        return voter in self._votes.get(view_no, {})
+
+    def _expire(self, view_no: int):
+        now = self._timer.get_current_time()
+        votes = self._votes.get(view_no, {})
+        for voter in [v for v, ts in votes.items()
+                      if now - ts > self._ttl]:
+            del votes[voter]
+
+    def clear_below(self, view_no: int):
+        for v in [v for v in self._votes if v <= view_no]:
+            del self._votes[v]
+
+
+class ViewChangeTriggerService:
+    def __init__(self, data: ConsensusSharedData, timer: TimerService,
+                 bus, network, config: Optional[Config] = None):
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._network = network
+        self._config = config or Config()
+        self._cache = InstanceChangeCache(
+            timer, self._config.OUTDATED_INSTANCE_CHANGES_CHECK_INTERVAL)
+        bus.subscribe(VoteForViewChange, self.process_vote_for_view_change)
+        network.subscribe(InstanceChange, self.process_instance_change)
+
+    def process_vote_for_view_change(self, msg: VoteForViewChange):
+        proposed = msg.view_no if msg.view_no is not None \
+            else self._data.view_no + 1
+        self._send_instance_change(proposed, msg.suspicion)
+
+    def _send_instance_change(self, proposed_view_no: int, reason):
+        code = getattr(reason, "code", GENERIC_SUSPICION_CODE)
+        if not isinstance(code, int):
+            code = GENERIC_SUSPICION_CODE
+        msg = InstanceChange(viewNo=proposed_view_no, reason=code)
+        logger.info("%s voting for view change to %d (%s)",
+                    self._data.name, proposed_view_no, reason)
+        self._cache.add_vote(proposed_view_no, self._data.name)
+        self._network.send(msg)
+        self._try_start(proposed_view_no)
+
+    def process_instance_change(self, msg: InstanceChange, frm: str):
+        if msg.viewNo <= self._data.view_no:
+            return (DISCARD, "instance change for current/old view")
+        self._cache.add_vote(msg.viewNo, frm)
+        self._try_start(msg.viewNo)
+        return None
+
+    def _try_start(self, view_no: int):
+        if view_no <= self._data.view_no:
+            return
+        votes = self._cache.votes(view_no)
+        if not self._data.quorums.view_change.is_reached(votes):
+            return
+        if not self._cache.has_vote_from(view_no, self._data.name):
+            # quorum of OTHERS without us: join anyway (we are behind)
+            self._cache.add_vote(view_no, self._data.name)
+        self._cache.clear_below(view_no)
+        self._bus.send(NeedViewChange(view_no=view_no))

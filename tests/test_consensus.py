@@ -1,0 +1,285 @@
+"""Rung-2 consensus tests: pools of ReplicaServices on SimNetwork +
+MockTimer — deterministic, no sockets, no real time (SURVEY.md §4).
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.consensus.quorums import Quorums
+from plenum_tpu.consensus.replica_service import ReplicaService
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.testing.mock_timer import MockTimer
+from plenum_tpu.testing.sim_network import Discard, SimNetwork
+
+
+SIM_EPOCH = 1600000000
+
+
+def make_pool(n, timer, net, conf=None, seed_names=None):
+    if timer.get_current_time() < SIM_EPOCH:
+        timer.set_time(SIM_EPOCH)  # TimestampField wants realistic epochs
+    names = seed_names or ["Node%d" % i for i in range(1, n + 1)]
+    conf = conf or Config(Max3PCBatchWait=0.1, CHK_FREQ=10, LOG_SIZE=30)
+    replicas = []
+    for name in names:
+        bus = net.create_peer(name)
+        replicas.append(ReplicaService(name, names, timer, bus, config=conf))
+    return replicas
+
+
+def pump(timer, replicas, seconds=5.0, step=0.05):
+    """Advance simulated time, servicing replicas each step."""
+    end = timer.get_current_time() + seconds
+    while timer.get_current_time() < end:
+        for r in replicas:
+            r.service()
+        timer.run_for(step)
+
+
+# ---------------------------------------------------------------- quorums
+
+def test_quorums_formulas():
+    q = Quorums(4)
+    assert q.f == 1
+    assert q.propagate.value == 2
+    assert q.prepare.value == 2
+    assert q.commit.value == 3
+    assert q.view_change.value == 3
+    q7 = Quorums(7)
+    assert q7.f == 2
+    assert q7.commit.value == 5
+
+
+# --------------------------------------------------------------- ordering
+
+@pytest.mark.parametrize("n", [4, 6, 7])
+def test_pool_orders_requests(n, mock_timer):
+    net = SimNetwork(mock_timer, DefaultSimRandom(42))
+    pool = make_pool(n, mock_timer, net)
+    for i in range(5):
+        for r in pool:
+            r.submit_request("req-digest-%d" % i)
+    pump(mock_timer, pool, seconds=10)
+    for r in pool:
+        assert r.last_ordered[1] >= 1, r.name
+    # all replicas ordered the same batches in the same order
+    first = [(o.viewNo, o.ppSeqNo, tuple(o.valid_reqIdr))
+             for o in pool[0].ordered_log]
+    assert first
+    for r in pool[1:]:
+        assert [(o.viewNo, o.ppSeqNo, tuple(o.valid_reqIdr))
+                for o in r.ordered_log] == first
+
+
+def test_ordering_is_sequential_and_batched(mock_timer):
+    conf = Config(Max3PCBatchSize=3, Max3PCBatchWait=0.1, CHK_FREQ=10,
+                  LOG_SIZE=30)
+    net = SimNetwork(mock_timer, DefaultSimRandom(7))
+    pool = make_pool(4, mock_timer, net, conf)
+    for i in range(7):
+        for r in pool:
+            r.submit_request("d%d" % i)
+    pump(mock_timer, pool, seconds=10)
+    r0 = pool[0]
+    seqs = [o.ppSeqNo for o in r0.ordered_log]
+    assert seqs == sorted(seqs)
+    assert seqs == list(range(1, len(seqs) + 1))
+    # batching: 7 reqs with batch size 3 → 3 batches
+    ordered_digests = [d for o in r0.ordered_log for d in o.valid_reqIdr]
+    assert sorted(ordered_digests) == sorted("d%d" % i for i in range(7))
+    assert len(r0.ordered_log) == 3
+
+
+def test_executor_state_matches_across_pool(mock_timer):
+    net = SimNetwork(mock_timer, DefaultSimRandom(3))
+    pool = make_pool(4, mock_timer, net)
+    for i in range(4):
+        for r in pool:
+            r.submit_request("x%d" % i)
+    pump(mock_timer, pool, seconds=10)
+    roots = {r.executor.committed_root for r in pool}
+    assert len(roots) == 1  # deterministic execution on every replica
+    assert pool[0].executor.committed_root != "genesis"
+
+
+# ------------------------------------------------------------ checkpoints
+
+def test_checkpoint_stabilization_advances_watermarks(mock_timer):
+    conf = Config(Max3PCBatchSize=1, Max3PCBatchWait=0.01, CHK_FREQ=2,
+                  LOG_SIZE=6)
+    net = SimNetwork(mock_timer, DefaultSimRandom(5))
+    pool = make_pool(4, mock_timer, net, conf)
+    for i in range(6):
+        for r in pool:
+            r.submit_request("c%d" % i)
+        pump(mock_timer, pool, seconds=2)
+    for r in pool:
+        assert r.last_ordered[1] == 6
+        assert r.data.stable_checkpoint >= 4, r.name
+        assert r.data.low_watermark == r.data.stable_checkpoint
+
+
+# ------------------------------------------------------------ view change
+
+def test_view_change_rotates_primary(mock_timer):
+    net = SimNetwork(mock_timer, DefaultSimRandom(11))
+    pool = make_pool(4, mock_timer, net)
+    assert pool[0].is_primary
+    for r in pool:
+        r.start_view_change()
+    pump(mock_timer, pool, seconds=10)
+    for r in pool:
+        assert r.view_no == 1
+        assert not r.data.waiting_for_new_view
+        assert r.data.primary_name == "Node2"
+    assert pool[1].is_primary
+
+
+def test_view_change_preserves_ordered_batches(mock_timer):
+    conf = Config(Max3PCBatchSize=1, Max3PCBatchWait=0.01, CHK_FREQ=10,
+                  LOG_SIZE=30)
+    net = SimNetwork(mock_timer, DefaultSimRandom(13))
+    pool = make_pool(4, mock_timer, net, conf)
+    for i in range(3):
+        for r in pool:
+            r.submit_request("pre-%d" % i)
+    pump(mock_timer, pool, seconds=8)
+    ordered_before = pool[0].last_ordered[1]
+    assert ordered_before >= 3
+    for r in pool:
+        r.start_view_change()
+    pump(mock_timer, pool, seconds=10)
+    # ordering continues in the new view
+    for i in range(2):
+        for r in pool:
+            r.submit_request("post-%d" % i)
+    pump(mock_timer, pool, seconds=8)
+    for r in pool:
+        assert r.view_no == 1
+        assert r.last_ordered[1] >= ordered_before + 2, r.name
+    logs = [[(o.ppSeqNo, tuple(o.valid_reqIdr)) for o in r.ordered_log]
+            for r in pool]
+    assert all(l == logs[0] for l in logs)
+
+
+def test_view_change_by_quorum_of_instance_changes(mock_timer):
+    """A node that didn't vote joins when n-f others want the change."""
+    net = SimNetwork(mock_timer, DefaultSimRandom(17))
+    pool = make_pool(4, mock_timer, net)
+    for r in pool[:3]:   # 3 of 4 = n-f vote
+        r.start_view_change()
+    pump(mock_timer, pool, seconds=10)
+    for r in pool:
+        assert r.view_no == 1, r.name
+        assert not r.data.waiting_for_new_view
+
+
+def test_view_change_reorders_prepared_batches(mock_timer):
+    """Batches prepared but not ordered before the VC are re-ordered in
+    the new view (NewViewBuilder.calc_batches path)."""
+    from plenum_tpu.common.messages.node_messages import Commit, MessageRep
+    conf = Config(Max3PCBatchSize=1, Max3PCBatchWait=0.01, CHK_FREQ=10,
+                  LOG_SIZE=30)
+    net = SimNetwork(mock_timer, DefaultSimRandom(19))
+    pool = make_pool(4, mock_timer, net, conf)
+    # block all COMMITs (and the MessageReq repair channel) so batches
+    # prepare but never order
+    blocker = Discard(DefaultSimRandom(0), probability=1.1,
+                      message_types=[Commit, MessageRep])
+    net.add_processor(blocker)
+    for r in pool:
+        r.submit_request("stuck-req")
+    pump(mock_timer, pool, seconds=6)
+    assert all(r.last_ordered[1] == 0 for r in pool)
+    assert any(r.data.prepared for r in pool)
+    net.remove_processor(blocker)
+    for r in pool:
+        r.start_view_change()
+    pump(mock_timer, pool, seconds=12)
+    for r in pool:
+        assert r.view_no == 1
+        assert r.last_ordered[1] >= 1, r.name
+        assert [tuple(o.valid_reqIdr) for o in r.ordered_log] == \
+            [("stuck-req",)]
+
+
+def test_primary_crash_new_view_timeout_escalates(mock_timer):
+    """If the new primary is dead, NEW_VIEW timeout votes view+1 and the
+    pool converges on the next live primary."""
+    from plenum_tpu.common.messages.node_messages import NewView
+    net = SimNetwork(mock_timer, DefaultSimRandom(23))
+    conf = Config(Max3PCBatchWait=0.1, CHK_FREQ=10, LOG_SIZE=30,
+                  NEW_VIEW_TIMEOUT=5)
+    pool = make_pool(4, mock_timer, net, conf)
+    # Node2 (primary of view 1) drops everything it would send
+    dead = Discard(DefaultSimRandom(0), probability=1.1, frm=["Node2"])
+    net.add_processor(dead)
+    for r in pool:
+        if r.name != "Node2":
+            r.start_view_change()
+    pump(mock_timer, pool, seconds=40)
+    live = [r for r in pool if r.name != "Node2"]
+    for r in live:
+        assert r.view_no == 2, (r.name, r.view_no)
+        assert not r.data.waiting_for_new_view
+        assert r.data.primary_name == "Node3"
+
+
+# ----------------------------------------------------- byzantine defenses
+
+def test_preprepare_from_non_primary_discarded(mock_timer):
+    from plenum_tpu.common.messages.node_messages import PrePrepare
+    from plenum_tpu.consensus.ordering_service import OrderingService
+    net = SimNetwork(mock_timer, DefaultSimRandom(29))
+    pool = make_pool(4, mock_timer, net)
+    evil_pp = PrePrepare(
+        instId=0, viewNo=0, ppSeqNo=1, ppTime=int(mock_timer.get_current_time()),
+        reqIdr=["evil"], discarded="0",
+        digest=OrderingService.generate_pp_digest(["evil"], 0, int(mock_timer.get_current_time())),
+        ledgerId=1, stateRootHash=None, txnRootHash=None,
+        sub_seq_no=0, final=False)
+    # inject as if from Node2 (not the primary)
+    pool[2].network.process_incoming(evil_pp, "Node2")
+    pump(mock_timer, pool, seconds=3)
+    assert pool[2].last_ordered[1] == 0
+    assert (0, 1) not in pool[2].ordering.prePrepares
+
+
+def test_wrong_digest_preprepare_rejected(mock_timer):
+    from plenum_tpu.common.messages.node_messages import PrePrepare
+    net = SimNetwork(mock_timer, DefaultSimRandom(31))
+    pool = make_pool(4, mock_timer, net)
+    bad_pp = PrePrepare(
+        instId=0, viewNo=0, ppSeqNo=1, ppTime=int(mock_timer.get_current_time()),
+        reqIdr=["r1"], discarded="0", digest="f" * 64,
+        ledgerId=1, stateRootHash=None, txnRootHash=None,
+        sub_seq_no=0, final=False)
+    pool[1].network.process_incoming(bad_pp, "Node1")  # from real primary
+    pump(mock_timer, pool, seconds=3)
+    assert (0, 1) not in pool[1].ordering.prePrepares
+
+
+# ----------------------------------------------------- randomized (seeded)
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_ordering_with_lossy_network(seed, mock_timer):
+    """With 20% random message loss the pool still converges (quorums +
+    retransmission-free design tolerance: batches only need n-f)."""
+    net = SimNetwork(mock_timer, DefaultSimRandom(seed))
+    conf = Config(Max3PCBatchSize=2, Max3PCBatchWait=0.05, CHK_FREQ=10,
+                  LOG_SIZE=30)
+    pool = make_pool(7, mock_timer, net, conf)
+    lossy = Discard(DefaultSimRandom(seed + 1), probability=0.2)
+    net.add_processor(lossy)
+    for i in range(6):
+        for r in pool:
+            r.submit_request("lossy-%d" % i)
+    pump(mock_timer, pool, seconds=30)
+    # quorum of replicas makes progress despite loss
+    progressed = [r for r in pool if r.last_ordered[1] >= 1]
+    assert len(progressed) >= 5, [(r.name, r.last_ordered) for r in pool]
+    # and whatever was ordered is consistent
+    logs = [[(o.ppSeqNo, tuple(o.valid_reqIdr)) for o in r.ordered_log]
+            for r in pool]
+    shortest = min(len(l) for l in logs)
+    for l in logs:
+        assert l[:shortest] == logs[0][:shortest]
