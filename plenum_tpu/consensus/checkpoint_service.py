@@ -23,8 +23,6 @@ from plenum_tpu.runtime.stashing_router import DISCARD, StashingRouter
 
 logger = logging.getLogger(__name__)
 
-STASH_WAITING_OWN = 6
-
 
 class CheckpointService:
     def __init__(self, data: ConsensusSharedData, bus, network,

@@ -131,6 +131,14 @@ class MessageReqService:
         params = rep.params or {}
         if params.get("instId") != self._data.inst_id:
             return
+        # only accept replies we actually asked for — an unsolicited
+        # MESSAGE_RESPONSE is a forgery vector (esp. PRE-PREPAREs, which
+        # get re-attributed to the primary below)
+        tkey = (rep.msg_type, params.get("viewNo"), params.get("ppSeqNo"))
+        if tkey not in self._requested:
+            logger.debug("%s ignoring unsolicited MESSAGE_RESPONSE %s "
+                         "from %s", self._data.name, tkey, frm)
+            return
         try:
             if rep.msg_type == PREPREPARE:
                 msg = PrePrepare(**rep.msg)

@@ -22,6 +22,7 @@ from collections import OrderedDict, defaultdict
 from typing import Dict, List, Optional, Set, Tuple
 
 from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import AUDIT_LEDGER_ID, DOMAIN_LEDGER_ID
 from plenum_tpu.common.messages.internal_messages import (
     CheckpointStabilized, NeedViewChange, NewViewCheckpointsApplied,
     MasterReorderedAfterVC, RaisedSuspicion, ViewChangeStarted)
@@ -41,10 +42,7 @@ STASH_VIEW_3PC = 2          # future view / waiting for NEW_VIEW
 STASH_CATCH_UP = 3          # node is catching up
 STASH_WATERMARKS = 4        # outside [h, H]
 STASH_WAITING_PREDECESSOR = 5  # PRE-PREPARE arrived out of order
-
-DOMAIN_LEDGER_ID = 1
-AUDIT_LEDGER_ID = 3
-
+STASH_WAITING_REQUESTS = 8     # PRE-PREPARE references unknown requests
 
 class SuspiciousNode(Exception):
     def __init__(self, node: str, code: int, reason: str, msg=None):
@@ -216,6 +214,8 @@ class OrderingService:
         if digest not in q:
             q[digest] = True
             self._queue_entry_time[digest] = self._timer.get_current_time()
+        # a stashed PRE-PREPARE may have been waiting for this request
+        self._stasher.process_all_stashed(STASH_WAITING_REQUESTS)
 
     def send_3pc_batch(self) -> int:
         """Primary: create and send batches if triggers fire. Called every
@@ -313,6 +313,11 @@ class OrderingService:
         if self.is_master and pp.ppSeqNo > self._last_applied_seq + 1:
             # must apply in sequence or state roots diverge
             return (STASH_WAITING_PREDECESSOR, "out-of-order PRE-PREPARE")
+        if self.is_master and not all(
+                self._executor.is_request_known(d) for d in pp.reqIdr):
+            # normal reordering: our PROPAGATE quorum for one of the
+            # requests hasn't completed yet — wait, don't crash/discard
+            return (STASH_WAITING_REQUESTS, "unknown requests in batch")
         if key in self.prePrepares:
             if self.prePrepares[key].digest != pp.digest:
                 self._raise_suspicion(frm, Suspicions.DUPLICATE_PPR_SENT,
@@ -330,6 +335,13 @@ class OrderingService:
             self._raise_suspicion(frm, Suspicions.PPR_TIME_WRONG,
                                   "pp time too far off", pp)
             return (DISCARD, "bad ppTime")
+        if self.is_master and (pp.stateRootHash is None
+                               or pp.txnRootHash is None):
+            # a PRE-PREPARE without roots would bypass the apply-and-
+            # compare defense (e.g. one forged through a MESSAGE_RESPONSE)
+            self._raise_suspicion(frm, Suspicions.PPR_STATE_WRONG,
+                                  "PRE-PREPARE without root hashes", pp)
+            return (DISCARD, "missing root hashes")
         if self._bls is not None:
             err = self._bls.validate_pre_prepare(pp, frm)
             if err:
@@ -364,6 +376,14 @@ class OrderingService:
             self._last_applied_seq = pp.ppSeqNo
         self._consume_from_queue(pp)
         self._add_to_preprepared(pp)
+        # drop any PREPAREs that arrived before this PRE-PREPARE and do
+        # not match it — they must not count toward the prepared quorum
+        stale = {s: p for s, p in self.prepares[key].items()
+                 if p.digest != pp.digest}
+        for sender, prep in stale.items():
+            del self.prepares[key][sender]
+            self._raise_suspicion(sender, Suspicions.PR_DIGEST_WRONG,
+                                  "PREPARE digest mismatch", prep)
         if self._bls is not None:
             self._bls.process_pre_prepare(pp, frm)
         self._send_prepare(pp)

@@ -264,19 +264,20 @@ class ViewChangeService:
 
     # ------------------------------------------------------------- finish
 
-    def _confirmed_view_changes(self, view_no: int) -> List[ViewChange]:
-        """VIEW_CHANGEs usable as NEW_VIEW evidence. The new primary only
-        uses a VIEW_CHANGE once a quorum (n-f-1) of OTHER nodes has acked
-        the same digest — so a byzantine node cannot feed the primary a
-        VIEW_CHANGE nobody else saw (reference view_change_service
-        ack handling). Non-primaries recompute from direct receipts."""
+    def _confirmed_view_changes(self, view_no: int
+                                ) -> List[Tuple[str, ViewChange]]:
+        """(sender, VIEW_CHANGE) pairs usable as NEW_VIEW evidence. The
+        new primary only uses a VIEW_CHANGE once a quorum (n-f-1) of
+        nodes confirms the same digest (acks from others + its own direct
+        receipt) — a byzantine node cannot feed the primary a VIEW_CHANGE
+        nobody else saw. Non-primaries recompute from direct receipts."""
         vcs = self._view_changes[view_no]
         if self._data.primary_name != self._data.name:
-            return list(vcs.values())
+            return list(vcs.items())
         confirmed = []
         for frm, vc in vcs.items():
             if frm == self._data.name:
-                confirmed.append(vc)
+                confirmed.append((frm, vc))
                 continue
             ackers = self._acks[view_no][(frm, view_change_digest(vc))]
             ackers = ackers - {frm, self._data.name}
@@ -284,24 +285,30 @@ class ViewChangeService:
             # (otherwise a single dead node makes the quorum unreachable)
             if self._data.quorums.view_change_ack.is_reached(
                     len(ackers) + 1):
-                confirmed.append(vc)
+                confirmed.append((frm, vc))
         return confirmed
 
     def _try_finish(self):
         if not self._data.waiting_for_new_view:
             return
         view_no = self._data.view_no
-        vcs = self._confirmed_view_changes(view_no)
-        if not self._data.quorums.view_change.is_reached(len(vcs)):
+        confirmed = self._confirmed_view_changes(view_no)
+        if not self._data.quorums.view_change.is_reached(len(confirmed)):
             return
         i_am_primary = self._data.primary_name == self._data.name
         if i_am_primary and self._new_view is None:
-            self._send_new_view(view_no, vcs)
+            self._send_new_view(view_no, confirmed)
         if self._new_view is None:
             return
         self._finish_view_change(self._new_view)
 
-    def _send_new_view(self, view_no: int, vcs: List[ViewChange]):
+    def _send_new_view(self, view_no: int,
+                       confirmed: List[Tuple[str, ViewChange]]):
+        """NEW_VIEW references EXACTLY the set it was computed from —
+        validators recompute over the referenced set, so any mismatch
+        between reference and computation would make honest nodes reject
+        our own NEW_VIEW."""
+        vcs = [vc for _, vc in confirmed]
         checkpoint = self._builder.calc_checkpoint(vcs)
         batches = self._builder.calc_batches(checkpoint, vcs)
         if batches is None:
@@ -309,8 +316,7 @@ class ViewChangeService:
         nv = NewView(
             viewNo=view_no,
             viewChanges=sorted(
-                [[frm, view_change_digest(vc)]
-                 for frm, vc in self._view_changes[view_no].items()]),
+                [[frm, view_change_digest(vc)] for frm, vc in confirmed]),
             checkpoint=checkpoint,
             batches=[list(b) for b in batches],
         )

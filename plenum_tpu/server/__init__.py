@@ -1,0 +1,3 @@
+"""Server layer: request execution pipeline, propagation, authentication,
+node orchestration (reference: plenum/server/).
+"""

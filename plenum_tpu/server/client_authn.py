@@ -1,0 +1,169 @@
+"""Client request authentication — ed25519 over the signing serialization.
+
+Reference: plenum/server/client_authn.py (`ClientAuthNr` :21, `NaclAuthNr`
+:82 authenticate_multi :84, `CoreAuthNr`) + req_authenticator.py
+(`ReqAuthenticator` :11).
+
+TPU seam: `CoreAuthNr.authenticate_batch` hands the whole queue of
+pending requests to the pluggable batch verifier
+(plenum_tpu.crypto.batch_verifier) — thousands of signature checks become
+one device dispatch, the north-star path. Single requests fall through
+the same provider's scalar floor.
+"""
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional, Sequence
+
+from plenum_tpu.common.exceptions import (
+    CouldNotAuthenticate, InsufficientCorrectSignatures,
+    InsufficientSignatures, InvalidSignature)
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.serializers.base58 import b58decode
+from plenum_tpu.common.serializers.serialization import serialize_msg_for_signing
+from plenum_tpu.crypto.batch_verifier import create_verifier
+from plenum_tpu.crypto.signer import verkey_from_identifier
+
+logger = logging.getLogger(__name__)
+
+
+class ClientAuthNr(ABC):
+    @abstractmethod
+    def authenticate(self, req: Request) -> List[str]:
+        """→ identifiers whose signatures verified; raises on failure."""
+
+    @abstractmethod
+    def addIdr(self, identifier: str, verkey: str, role=None): ...
+
+    @abstractmethod
+    def getVerkey(self, identifier: str) -> Optional[str]: ...
+
+
+class CoreAuthNr(ClientAuthNr):
+    def __init__(self, verkey_provider=None, verifier=None):
+        """verkey_provider(identifier) → verkey str or None (state-backed
+        in the node; local dict fallback for tests)."""
+        self._verkey_provider = verkey_provider
+        self._local: Dict[str, str] = {}
+        self._verifier = verifier or create_verifier("adaptive")
+
+    # ------------------------------------------------------------- keys
+
+    def addIdr(self, identifier: str, verkey: str, role=None):
+        self._local[identifier] = verkey
+
+    def getVerkey(self, identifier: str) -> Optional[str]:
+        if identifier in self._local:
+            return self._local[identifier]
+        if self._verkey_provider is not None:
+            return self._verkey_provider(identifier)
+        return None
+
+    def _raw_verkey(self, identifier: str) -> bytes:
+        verkey = self.getVerkey(identifier)
+        return verkey_from_identifier(identifier, verkey)
+
+    # ----------------------------------------------------------- single
+
+    def authenticate(self, req: Request) -> List[str]:
+        items, idrs = self._verify_items(req)
+        results = self._verifier.verify_batch(items)
+        return self._conclude(req, idrs, results)
+
+    # ------------------------------------------------------------ batch
+
+    def authenticate_batch(self, reqs: Sequence[Request]
+                           ) -> List[Optional[List[str]]]:
+        """Authenticate many requests in ONE device dispatch. Returns, per
+        request, the verified identifier list or None if auth failed."""
+        all_items, spans, idrs_per_req = [], [], []
+        prep_errors: List[Optional[Exception]] = []
+        for req in reqs:
+            try:
+                items, idrs = self._verify_items(req)
+                prep_errors.append(None)
+            except Exception as e:
+                items, idrs = [], []
+                prep_errors.append(e)
+            spans.append((len(all_items), len(items)))
+            idrs_per_req.append(idrs)
+            all_items.extend(items)
+        results = self._verifier.verify_batch(all_items) if all_items else []
+        out: List[Optional[List[str]]] = []
+        for req, (start, count), idrs, err in zip(reqs, spans, idrs_per_req,
+                                                  prep_errors):
+            if err is not None:
+                out.append(None)
+                continue
+            try:
+                out.append(self._conclude(
+                    req, idrs, results[start:start + count]))
+            except Exception:
+                out.append(None)
+        return out
+
+    # ---------------------------------------------------------- internal
+
+    def _verify_items(self, req: Request):
+        """→ ([(msg_bytes, sig64, vk32)], [identifier]) for every
+        signature on the request."""
+        sigs: Dict[str, str] = {}
+        if req.signatures:
+            sigs.update(req.signatures)
+        if req.signature:
+            if req.identifier is None:
+                raise CouldNotAuthenticate(
+                    None, req.reqId, "signature without identifier")
+            sigs[req.identifier] = req.signature
+        if not sigs:
+            raise InsufficientSignatures(0, 1)
+        items, idrs = [], []
+        for idr, sig in sorted(sigs.items()):
+            try:
+                sig_raw = b58decode(sig)
+            except Exception:
+                raise InvalidSignature(
+                    idr, req.reqId, "malformed signature from {}".format(idr))
+            try:
+                vk = self._raw_verkey(idr)
+            except Exception:
+                vk = None
+            if vk is None:
+                raise CouldNotAuthenticate(
+                    idr, req.reqId, "no verkey for {}".format(idr))
+            ser = serialize_msg_for_signing(req.signingPayloadState(idr))
+            items.append((ser, sig_raw, vk))
+            idrs.append(idr)
+        return items, idrs
+
+    @staticmethod
+    def _conclude(req: Request, idrs: List[str],
+                  results: Sequence[bool]) -> List[str]:
+        ok = [i for i, good in zip(idrs, results) if good]
+        if len(ok) != len(idrs):
+            raise InsufficientCorrectSignatures(len(ok), len(idrs))
+        return ok
+
+
+class ReqAuthenticator:
+    """Registry of authenticators (reference req_authenticator.py:11)."""
+
+    def __init__(self):
+        self._authenticators: List[ClientAuthNr] = []
+
+    def register_authenticator(self, authnr: ClientAuthNr):
+        self._authenticators.append(authnr)
+
+    def authenticate(self, req: Request) -> List[str]:
+        identifiers = []
+        for a in self._authenticators:
+            identifiers.extend(a.authenticate(req))
+        return identifiers
+
+    @property
+    def core_authenticator(self) -> Optional[CoreAuthNr]:
+        for a in self._authenticators:
+            if isinstance(a, CoreAuthNr):
+                return a
+        return None

@@ -1,0 +1,56 @@
+"""DatabaseManager — ledger_id → (ledger, state) registry + named stores.
+
+Reference: plenum/server/database_manager.py:11 (register_new_database :23).
+"""
+from typing import Dict, Optional
+
+from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.state.pruning_state import State
+
+
+class Database:
+    def __init__(self, ledger: Ledger, state: Optional[State],
+                 taa_acceptance_required: bool = True):
+        self.ledger = ledger
+        self.state = state
+        self.taa_acceptance_required = taa_acceptance_required
+
+
+class DatabaseManager:
+    def __init__(self):
+        self.databases: Dict[int, Database] = {}
+        self.stores: Dict[str, object] = {}
+        self._init_hooks = []
+
+    def register_new_database(self, lid: int, ledger: Ledger,
+                              state: Optional[State] = None,
+                              taa_acceptance_required: bool = True):
+        if lid in self.databases:
+            raise ValueError("ledger {} already registered".format(lid))
+        self.databases[lid] = Database(ledger, state,
+                                       taa_acceptance_required)
+
+    def get_database(self, lid) -> Optional[Database]:
+        return self.databases.get(lid)
+
+    def get_ledger(self, lid) -> Optional[Ledger]:
+        db = self.databases.get(lid)
+        return db.ledger if db else None
+
+    def get_state(self, lid) -> Optional[State]:
+        db = self.databases.get(lid)
+        return db.state if db else None
+
+    def register_new_store(self, label: str, store):
+        self.stores[label] = store
+
+    def get_store(self, label: str):
+        return self.stores.get(label)
+
+    @property
+    def ledger_ids(self):
+        return list(self.databases.keys())
+
+    def is_taa_acceptance_required(self, lid: int) -> bool:
+        db = self.databases.get(lid)
+        return db.taa_acceptance_required if db else False

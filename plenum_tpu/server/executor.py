@@ -1,0 +1,115 @@
+"""NodeBatchExecutor — the real BatchExecutor over ledgers + MPT state.
+
+Bridges OrderingService (which speaks request digests and roots) to the
+WriteRequestManager pipeline (reference: the Node.executeBatch /
+apply_reqs glue, plenum/server/node.py:2661 + ordering_service
+create_3pc_batch). Replaces SimExecutor in full-node pools.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from plenum_tpu.common.constants import AUDIT_LEDGER_ID
+from plenum_tpu.common.messages.node_messages import Ordered
+from plenum_tpu.common.request import Request
+from plenum_tpu.consensus.ordering_service import BatchExecutor
+from plenum_tpu.server.three_pc_batch import ThreePcBatch
+from plenum_tpu.server.write_request_manager import WriteRequestManager
+
+logger = logging.getLogger(__name__)
+
+
+class NodeBatchExecutor(BatchExecutor):
+    def __init__(self, write_manager: WriteRequestManager,
+                 requests_source: Callable[[str], Optional[Request]],
+                 get_view_no: Callable[[], int] = None,
+                 get_primaries: Callable[[], List[str]] = None,
+                 on_batch_committed: Callable = None):
+        """requests_source(digest) → Request (the propagator's store)."""
+        self.write_manager = write_manager
+        self._requests_source = requests_source
+        self._get_view_no = get_view_no or (lambda: 0)
+        self._get_primaries = get_primaries or (lambda: [])
+        self._on_batch_committed = on_batch_committed
+        self._pp_seq_no = 0
+        # staged batches by apply order (mirrors write manager staging)
+        self._staged: List[ThreePcBatch] = []
+
+    @property
+    def db(self):
+        return self.write_manager.database_manager
+
+    # -------------------------------------------------------------- apply
+
+    def apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
+                    pp_time: int) -> Tuple[str, str, str]:
+        ledger = self.db.get_ledger(ledger_id)
+        state = self.db.get_state(ledger_id)
+        valid = []
+        for digest in pre_prepare_digests:
+            request = self._requests_source(digest)
+            if request is None:
+                raise KeyError(
+                    "request {} not available for apply".format(digest))
+            try:
+                self.write_manager.dynamic_validation(request, pp_time)
+            except Exception as e:
+                logger.info("request %s failed dynamic validation: %s",
+                            digest, e)
+                continue
+            self.write_manager.apply_request(request, pp_time)
+            valid.append(digest)
+        self._pp_seq_no += 1
+        state_root = ledger.hashToStr(state.headHash) if state else ""
+        txn_root = ledger.hashToStr(ledger.uncommitted_root_hash)
+        batch = ThreePcBatch(
+            ledger_id=ledger_id,
+            inst_id=0,
+            view_no=self._get_view_no(),
+            pp_seq_no=self._pp_seq_no,
+            pp_time=pp_time,
+            state_root=state_root,
+            txn_root=txn_root,
+            valid_digests=valid,
+            pp_digest="",
+            primaries=self._get_primaries(),
+        )
+        self.write_manager.post_apply_batch(batch)
+        self._staged.append(batch)
+        audit = self.db.get_ledger(AUDIT_LEDGER_ID)
+        audit_root = audit.hashToStr(audit.uncommitted_root_hash)
+        return state_root, txn_root, audit_root
+
+    # ------------------------------------------------------------- revert
+
+    def revert_unordered_batches(self) -> int:
+        n = self.write_manager.revert_all_uncommitted()
+        self._staged = []
+        self._pp_seq_no -= n
+        return n
+
+    def revert_last_batch(self):
+        if self._staged:
+            self._staged.pop()
+            self.write_manager.post_batch_rejected()
+            self._pp_seq_no -= 1
+
+    # ------------------------------------------------------------- commit
+
+    def commit_batch(self, ordered: Ordered):
+        if not self._staged:
+            logger.warning("commit with no staged batch at %s",
+                           (ordered.viewNo, ordered.ppSeqNo))
+            return
+        batch = self._staged.pop(0)
+        batch.pp_digest = ordered.digest or ""
+        committed = self.write_manager.commit_batch(batch)
+        # free ordered requests from the in-flight store
+        if self._on_batch_committed is not None:
+            self._on_batch_committed(ordered, committed)
+
+    # -------------------------------------------------------------- reads
+
+    def is_request_known(self, digest: str) -> bool:
+        return self._requests_source(digest) is not None

@@ -1,0 +1,343 @@
+"""Node — the consensus-node orchestrator.
+
+Reference: plenum/server/node.py:129 (3,242 LoC god object) — rebuilt lean:
+storage bootstrap (NodeBootstrap, node_bootstrap.py:17), client request
+intake (processRequest :2000), propagation (processPropagate :2099),
+execution (executeBatch :2661 via NodeBatchExecutor), and replies.
+
+The node speaks to peers through ONE ExternalBus (SimNetwork in tests, a
+socket transport in deployment) and to clients through a reply callback —
+no sockets in this class, so the whole node is deterministic under
+MockTimer (SURVEY.md §4 rung 3 without processes).
+
+Client write path (SURVEY.md §3.3): REQUEST → authenticate (TPU-batched
+ed25519 via CoreAuthNr) → PROPAGATE → quorum f+1 finalise → ordering
+queues → 3PC → Ordered → commit (ledger merkle append + MPT commit +
+audit txn) → Reply{txn + audit path} to client.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    AUDIT_LEDGER_ID, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID, GET_TXN, NYM,
+    POOL_LEDGER_ID, VERKEY)
+from plenum_tpu.common.exceptions import InvalidClientMessageException
+from plenum_tpu.common.messages.client_request import ClientMessageValidator
+from plenum_tpu.common.messages.node_messages import (
+    Ordered, Propagate, Reject, Reply, RequestAck, RequestNack)
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.txn_util import get_payload_data, get_seq_no
+from plenum_tpu.consensus.replica_service import ReplicaService
+from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.runtime.timer import TimerService
+from plenum_tpu.server.batch_handlers import (
+    AuditBatchHandler, ConfigBatchHandler, DomainBatchHandler,
+    PoolBatchHandler)
+from plenum_tpu.server.client_authn import CoreAuthNr, ReqAuthenticator
+from plenum_tpu.server.database_manager import DatabaseManager
+from plenum_tpu.server.executor import NodeBatchExecutor
+from plenum_tpu.server.propagator import Propagator
+from plenum_tpu.server.request_handlers import (
+    GetNymHandler, GetTxnHandler, NodeHandler, NymHandler,
+    decode_state_value, nym_to_state_key)
+from plenum_tpu.server.write_request_manager import (
+    ReadRequestManager, WriteRequestManager)
+from plenum_tpu.state.pruning_state import PruningState
+from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+
+logger = logging.getLogger(__name__)
+
+
+class NodeBootstrap:
+    """Storage + handler registry init (reference node_bootstrap.py:17)."""
+
+    @staticmethod
+    def init_storage(storage_factory=None) -> DatabaseManager:
+        make_kv = storage_factory or (lambda name: KeyValueStorageInMemory())
+        dm = DatabaseManager()
+        for lid, name in ((POOL_LEDGER_ID, "pool"),
+                          (DOMAIN_LEDGER_ID, "domain"),
+                          (CONFIG_LEDGER_ID, "config"),
+                          (AUDIT_LEDGER_ID, "audit")):
+            ledger = Ledger(txn_store=make_kv(name + "_ledger"))
+            state = None
+            if lid != AUDIT_LEDGER_ID:
+                state = PruningState(make_kv(name + "_state"))
+            dm.register_new_database(lid, ledger, state,
+                                     taa_acceptance_required=(
+                                         lid == DOMAIN_LEDGER_ID))
+        return dm
+
+    @staticmethod
+    def init_managers(dm: DatabaseManager
+                      ) -> Tuple[WriteRequestManager, ReadRequestManager]:
+        wm = WriteRequestManager(dm)
+        wm.register_req_handler(NymHandler(dm))
+        wm.register_req_handler(NodeHandler(dm))
+        wm.register_batch_handler(PoolBatchHandler(dm))
+        wm.register_batch_handler(DomainBatchHandler(dm))
+        wm.register_batch_handler(ConfigBatchHandler(dm))
+        wm.register_batch_handler(AuditBatchHandler(dm))
+        rm = ReadRequestManager()
+        rm.register_req_handler(GetTxnHandler(dm))
+        rm.register_req_handler(GetNymHandler(dm))
+        return wm, rm
+
+
+class Node:
+    def __init__(self, name: str, validators: List[str],
+                 timer: TimerService, network,
+                 config: Optional[Config] = None,
+                 storage_factory=None,
+                 client_reply_handler: Callable[[str, object], None] = None,
+                 bls_bft_replica=None,
+                 genesis_txns: Optional[List[dict]] = None):
+        """network: ExternalBus to peers; client_reply_handler(client_id,
+        msg) delivers Acks/Nacks/Replies back to clients."""
+        self.name = name
+        self.config = config or Config()
+        self.timer = timer
+        self.network = network
+        self._reply_to_client = client_reply_handler or (lambda c, m: None)
+
+        # ---- storage + execution pipeline
+        self.db_manager = NodeBootstrap.init_storage(storage_factory)
+        self.write_manager, self.read_manager = \
+            NodeBootstrap.init_managers(self.db_manager)
+
+        # ---- client authentication (TPU-batched seam)
+        self.authnr = CoreAuthNr(
+            verkey_provider=self._verkey_from_domain_state)
+        self.req_authenticator = ReqAuthenticator()
+        self.req_authenticator.register_authenticator(self.authnr)
+
+        # ---- dedup index: payload_digest → (ledger_id, seqNo)
+        self.seq_no_db = KeyValueStorageInMemory()
+        # digest → client id awaiting reply
+        self._req_clients: Dict[str, str] = {}
+
+        # ---- consensus replica (master instance)
+        self.executor = NodeBatchExecutor(
+            self.write_manager,
+            requests_source=self._get_finalised_request,
+            get_view_no=lambda: self.replica.view_no,
+            get_primaries=lambda: [self.replica.data.primary_name or ""],
+            on_batch_committed=self._on_batch_committed)
+        self.replica = ReplicaService(
+            name, validators, timer, network, executor=self.executor,
+            config=self.config, bls_bft_replica=bls_bft_replica,
+            checkpoint_digest_source=self._audit_root_at)
+
+        # ---- propagation
+        self.propagator = Propagator(
+            name, self.replica.data.quorums, network,
+            forward_handler=self._forward_finalised)
+        network.subscribe(Propagate, self.propagator.process_propagate)
+
+        self._validator = ClientMessageValidator()
+
+        # ---- genesis
+        if genesis_txns:
+            self._load_genesis(genesis_txns)
+
+    # ========================================================== genesis
+
+    def _load_genesis(self, txns: List[dict]):
+        """Seed ledgers/state from genesis transactions (reference
+        ledger/genesis_txn/ + upload_states)."""
+        from plenum_tpu.common.txn_util import get_type
+        for txn in txns:
+            txn_type = get_type(txn)
+            handler = self.write_manager.request_handlers.get(txn_type)
+            if handler is None:
+                continue
+            ledger = handler.ledger
+            ledger.add(dict(txn))
+            handler.update_state(txn, None, None, is_committed=True)
+            if handler.state is not None:
+                handler.state.commit()
+
+    # ===================================================== client intake
+
+    def process_client_request(self, msg: dict, client_id: str):
+        """Entry for one client REQUEST (reference processRequest :2000)."""
+        try:
+            self._validator.validate(msg)
+            request = Request.from_dict(msg)
+        except InvalidClientMessageException as e:
+            self._reply_to_client(client_id, RequestNack(
+                identifier=msg.get("identifier") or "unknown",
+                reqId=msg.get("reqId") or 0, reason=str(e)))
+            return
+        if self.read_manager.is_valid_type(request.txn_type):
+            self._process_read(request, client_id)
+            return
+        self._process_write(request, client_id)
+
+    def process_client_batch(self, msgs: List[Tuple[dict, str]]):
+        """Batched intake: ONE device dispatch authenticates every pending
+        request (the north-star path)."""
+        parsed = []
+        for msg, client_id in msgs:
+            try:
+                self._validator.validate(msg)
+                request = Request.from_dict(msg)
+            except InvalidClientMessageException as e:
+                self._reply_to_client(client_id, RequestNack(
+                    identifier=msg.get("identifier") or "unknown",
+                    reqId=msg.get("reqId") or 0, reason=str(e)))
+                continue
+            if self.read_manager.is_valid_type(request.txn_type):
+                self._process_read(request, client_id)
+                continue
+            parsed.append((request, client_id))
+        if not parsed:
+            return
+        results = self.authnr.authenticate_batch([r for r, _ in parsed])
+        for (request, client_id), idrs in zip(parsed, results):
+            if idrs is None:
+                self._reply_to_client(client_id, RequestNack(
+                    identifier=request.identifier or "unknown",
+                    reqId=request.reqId or 0,
+                    reason="signature verification failed"))
+                continue
+            self._accept_write(request, client_id)
+
+    def _process_write(self, request: Request, client_id: str):
+        try:
+            self.req_authenticator.authenticate(request)
+        except Exception as e:
+            self._reply_to_client(client_id, RequestNack(
+                identifier=request.identifier or "unknown",
+                reqId=request.reqId or 0, reason=str(e)))
+            return
+        self._accept_write(request, client_id)
+
+    def _accept_write(self, request: Request, client_id: str):
+        try:
+            self.write_manager.static_validation(request)
+        except InvalidClientMessageException as e:
+            self._reply_to_client(client_id, RequestNack(
+                identifier=request.identifier or "unknown",
+                reqId=request.reqId or 0, reason=str(e)))
+            return
+        # dedup: already committed?
+        existing = self._committed_reply(request)
+        if existing is not None:
+            self._reply_to_client(client_id, existing)
+            return
+        self._req_clients[request.key] = client_id
+        self._reply_to_client(client_id, RequestAck(
+            identifier=request.identifier or "unknown",
+            reqId=request.reqId or 0))
+        self.propagator.propagate(request, client_id)
+
+    def _process_read(self, request: Request, client_id: str):
+        try:
+            result = self.read_manager.get_result(request)
+            self._reply_to_client(client_id, Reply(result=result))
+        except InvalidClientMessageException as e:
+            self._reply_to_client(client_id, RequestNack(
+                identifier=request.identifier or "unknown",
+                reqId=request.reqId or 0, reason=str(e)))
+        except Exception:  # a read must never crash the intake loop
+            logger.exception("%s failed processing read %s", self.name,
+                             request)
+            self._reply_to_client(client_id, RequestNack(
+                identifier=request.identifier or "unknown",
+                reqId=request.reqId or 0, reason="internal error"))
+
+    # ================================================ propagation → 3PC
+
+    def _forward_finalised(self, request: Request):
+        lid = self.write_manager.type_to_ledger_id(request.txn_type) \
+            or DOMAIN_LEDGER_ID
+        self.replica.ordering.add_finalized_request(request.key, lid)
+
+    def _get_finalised_request(self, digest: str) -> Optional[Request]:
+        state = self.propagator.requests.get(digest)
+        return state.request if state else None
+
+    # ===================================================== commit hooks
+
+    def _on_batch_committed(self, ordered: Ordered, committed_txns):
+        """Send Replies with audit paths; update dedup index; free reqs."""
+        ledger = self.db_manager.get_ledger(ordered.ledgerId)
+        for txn in committed_txns or []:
+            seq_no = get_seq_no(txn)
+            from plenum_tpu.common.txn_util import get_payload_digest, get_digest
+            payload_digest = get_payload_digest(txn)
+            if payload_digest:
+                self.seq_no_db.put(
+                    payload_digest.encode(),
+                    "{}:{}".format(ordered.ledgerId, seq_no).encode())
+            digest = get_digest(txn)
+            client_id = self._req_clients.pop(digest, None)
+            if client_id is not None:
+                result = dict(txn)
+                try:
+                    result.update(ledger.merkleInfo(seq_no))
+                except Exception:
+                    pass
+                self._reply_to_client(client_id, Reply(result=result))
+            if digest:
+                self.propagator.requests.free(digest)
+
+    def _committed_reply(self, request: Request) -> Optional[Reply]:
+        try:
+            raw = self.seq_no_db.get(request.payload_digest.encode())
+        except KeyError:
+            return None
+        lid, seq_no = bytes(raw).decode().split(":")
+        ledger = self.db_manager.get_ledger(int(lid))
+        txn = ledger.getBySeqNo(int(seq_no))
+        if txn is None:
+            return None
+        result = dict(txn)
+        result.update(ledger.merkleInfo(int(seq_no)))
+        return Reply(result=result)
+
+    # ========================================================== helpers
+
+    def _verkey_from_domain_state(self, identifier: str) -> Optional[str]:
+        handler = self.write_manager.request_handlers.get(NYM)
+        if handler is None or handler.state is None:
+            return None
+        val, _, _ = decode_state_value(handler.state.get(
+            nym_to_state_key(identifier), isCommitted=False))
+        return (val or {}).get(VERKEY)
+
+    def _audit_root_at(self, pp_seq_no: int) -> str:
+        """Checkpoint digest: committed audit-ledger root (all honest
+        nodes have identical audit ledgers at the same pp_seq_no)."""
+        audit = self.db_manager.get_ledger(AUDIT_LEDGER_ID)
+        return audit.root_hash
+
+    def service(self):
+        """One prod tick."""
+        return self.replica.service()
+
+    # ------------------------------------------------------- inspection
+
+    @property
+    def domain_ledger(self):
+        return self.db_manager.get_ledger(DOMAIN_LEDGER_ID)
+
+    @property
+    def audit_ledger(self):
+        return self.db_manager.get_ledger(AUDIT_LEDGER_ID)
+
+    @property
+    def last_ordered(self):
+        return self.replica.last_ordered
+
+    @property
+    def view_no(self):
+        return self.replica.view_no
+
+    @property
+    def master_primary_name(self):
+        return self.replica.data.primary_name

@@ -1,0 +1,104 @@
+"""Propagator — client-request propagation and finalization.
+
+Reference: plenum/server/propagator.py — `Requests` (:62, digest →
+request + votes), `Propagator` (:195): on a new client request, broadcast
+PROPAGATE; once f+1 nodes propagated identical requests the request is
+"finalised" and forwarded to the ordering queues.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional, Set
+
+from plenum_tpu.common.messages.node_messages import Propagate
+from plenum_tpu.common.request import Request
+from plenum_tpu.consensus.quorums import Quorums
+
+logger = logging.getLogger(__name__)
+
+
+class ReqState:
+    def __init__(self, request: Request):
+        self.request = request
+        self.propagates: Set[str] = set()
+        self.finalised = False
+        self.forwarded = False
+        self.executed = False
+
+
+class Requests(dict):
+    """digest → ReqState (reference propagator.py:62)."""
+
+    def add(self, req: Request) -> ReqState:
+        if req.key not in self:
+            self[req.key] = ReqState(req)
+        return self[req.key]
+
+    def add_propagate(self, req: Request, sender: str):
+        state = self.add(req)
+        state.propagates.add(sender)
+
+    def votes(self, req_key: str) -> int:
+        state = self.get(req_key)
+        return len(state.propagates) if state else 0
+
+    def is_finalised(self, req_key: str) -> bool:
+        state = self.get(req_key)
+        return state.finalised if state else False
+
+    def set_finalised(self, req_key: str):
+        if req_key in self:
+            self[req_key].finalised = True
+
+    def free(self, req_key: str):
+        self.pop(req_key, None)
+
+
+class Propagator:
+    def __init__(self, name: str, quorums: Quorums, network,
+                 forward_handler: Callable[[Request], None]):
+        """network: ExternalBus; forward_handler: called exactly once per
+        finalised request (feeds ordering queues)."""
+        self.name = name
+        self.quorums = quorums
+        self._network = network
+        self._forward = forward_handler
+        self.requests = Requests()
+
+    def update_quorums(self, quorums: Quorums):
+        self.quorums = quorums
+
+    # ----------------------------------------------------------- sending
+
+    def propagate(self, request: Request, client_name: Optional[str]):
+        """Broadcast our PROPAGATE for this request (reference :204)."""
+        state = self.requests.add(request)
+        if self.name in state.propagates:
+            return
+        state.propagates.add(self.name)
+        self._network.send(Propagate(request=request.as_dict(),
+                                     senderClient=client_name))
+        self._try_finalise(request.key)
+
+    # ---------------------------------------------------------- receiving
+
+    def process_propagate(self, msg: Propagate, frm: str):
+        request = Request.from_dict(msg.request)
+        self.requests.add_propagate(request, frm)
+        # echo our own propagate if we haven't yet (so slow clients still
+        # reach quorum via node-to-node gossip)
+        state = self.requests[request.key]
+        if self.name not in state.propagates:
+            state.propagates.add(self.name)
+            self._network.send(Propagate(request=msg.request,
+                                         senderClient=msg.senderClient))
+        self._try_finalise(request.key)
+
+    def _try_finalise(self, req_key: str):
+        state = self.requests.get(req_key)
+        if state is None or state.forwarded:
+            return
+        if self.quorums.propagate.is_reached(len(state.propagates)):
+            state.finalised = True
+            state.forwarded = True
+            self._forward(state.request)

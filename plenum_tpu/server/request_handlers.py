@@ -1,0 +1,271 @@
+"""Request handlers — per-txn-type validation/apply logic.
+
+Reference: plenum/server/request_handlers/ — `WriteRequestHandler`,
+`ReadRequestHandler` interfaces (handler_interfaces/*.py), concrete NYM
+(nym_handler.py), NODE (node_handler.py), GET_TXN (get_txn_handler.py),
+audit (audit_handler.py — its batch-level logic lives in
+batch_handlers.py here).
+
+A write handler implements:
+  static_validation(request)    — schema-level, no state
+  dynamic_validation(request)   — against uncommitted state
+  update_state(txn, prev, req)  — apply to the head (uncommitted) state
+"""
+from __future__ import annotations
+
+import json
+from abc import ABC, abstractmethod
+from typing import Optional
+
+from plenum_tpu.common.constants import (
+    DATA, DOMAIN_LEDGER_ID, GET_TXN, NODE, NYM, POOL_LEDGER_ID, ROLE,
+    STEWARD, TARGET_NYM, TRUSTEE, TXN_TYPE, VERKEY)
+from plenum_tpu.common.exceptions import (
+    InvalidClientRequest, UnauthorizedClientRequest)
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.txn_util import (
+    get_from, get_payload_data, get_seq_no, get_txn_time)
+from plenum_tpu.server.database_manager import DatabaseManager
+
+
+class RequestHandler(ABC):
+    def __init__(self, database_manager: DatabaseManager, txn_type: str,
+                 ledger_id: Optional[int]):
+        self.database_manager = database_manager
+        self.txn_type = txn_type
+        self.ledger_id = ledger_id
+
+    @property
+    def ledger(self):
+        return self.database_manager.get_ledger(self.ledger_id)
+
+    @property
+    def state(self):
+        return self.database_manager.get_state(self.ledger_id)
+
+
+class WriteRequestHandler(RequestHandler):
+    @abstractmethod
+    def static_validation(self, request: Request): ...
+
+    @abstractmethod
+    def dynamic_validation(self, request: Request, req_pp_time=None): ...
+
+    @abstractmethod
+    def update_state(self, txn: dict, prev_result, request: Request,
+                     is_committed: bool = False): ...
+
+    def apply_request(self, request: Request, batch_ts: int):
+        """Default apply: reqToTxn + update_state; returns (start, txn)."""
+        from plenum_tpu.common.txn_util import (append_txn_metadata, reqToTxn)
+        txn = append_txn_metadata(reqToTxn(request), txn_time=batch_ts)
+        self.update_state(txn, None, request)
+        return txn
+
+
+class ReadRequestHandler(RequestHandler):
+    @abstractmethod
+    def get_result(self, request: Request) -> dict: ...
+
+
+# --------------------------------------------------------------- helpers
+
+def nym_to_state_key(nym: str) -> bytes:
+    return nym.encode()
+
+
+def encode_state_value(value: dict, seq_no, txn_time) -> bytes:
+    return json.dumps({"val": value, "lsn": seq_no, "lut": txn_time},
+                      sort_keys=True, separators=(",", ":")).encode()
+
+
+def decode_state_value(data: bytes):
+    if data is None:
+        return None, None, None
+    parsed = json.loads(bytes(data).decode())
+    return parsed.get("val"), parsed.get("lsn"), parsed.get("lut")
+
+
+# ------------------------------------------------------------------- NYM
+
+class NymHandler(WriteRequestHandler):
+    """Reference: plenum/server/request_handlers/nym_handler.py — identity
+    registration/rotation on the domain ledger."""
+
+    def __init__(self, database_manager: DatabaseManager):
+        super().__init__(database_manager, NYM, DOMAIN_LEDGER_ID)
+
+    def static_validation(self, request: Request):
+        op = request.operation
+        if not op.get(TARGET_NYM):
+            raise InvalidClientRequest(request.identifier, request.reqId,
+                                       "NYM must have a dest")
+        role = op.get(ROLE)
+        if role not in (None, STEWARD, TRUSTEE):
+            raise InvalidClientRequest(
+                request.identifier, request.reqId,
+                "invalid role {}".format(role))
+
+    def dynamic_validation(self, request: Request, req_pp_time=None):
+        op = request.operation
+        existing, _, _ = decode_state_value(self.state.get(
+            nym_to_state_key(op[TARGET_NYM]), isCommitted=False))
+        is_creation = existing is None
+        if is_creation:
+            # new nym with a privileged role needs a privileged author
+            if op.get(ROLE) in (STEWARD, TRUSTEE):
+                author = self._author_role(request)
+                if author != TRUSTEE:
+                    raise UnauthorizedClientRequest(
+                        request.identifier, request.reqId,
+                        "only TRUSTEE can create {}".format(op.get(ROLE)))
+        else:
+            # key rotation: only the nym owner may change its verkey
+            if VERKEY in op and request.identifier != op[TARGET_NYM]:
+                raise UnauthorizedClientRequest(
+                    request.identifier, request.reqId,
+                    "only the owner can rotate a verkey")
+
+    def _author_role(self, request: Request):
+        if request.identifier is None:
+            return None
+        val, _, _ = decode_state_value(self.state.get(
+            nym_to_state_key(request.identifier), isCommitted=False))
+        return (val or {}).get(ROLE)
+
+    def update_state(self, txn: dict, prev_result, request: Request,
+                     is_committed: bool = False):
+        data = get_payload_data(txn)
+        nym = data[TARGET_NYM]
+        existing, _, _ = decode_state_value(
+            self.state.get(nym_to_state_key(nym), isCommitted=False))
+        value = dict(existing or {})
+        value["identifier"] = get_from(txn)
+        if ROLE in data:
+            value[ROLE] = data[ROLE]
+        if VERKEY in data:
+            value[VERKEY] = data[VERKEY]
+        value.setdefault("seqNo", get_seq_no(txn))
+        self.state.set(nym_to_state_key(nym),
+                       encode_state_value(value, get_seq_no(txn),
+                                          get_txn_time(txn)))
+        return value
+
+    def get_nym_details(self, nym: str, is_committed=True):
+        return decode_state_value(self.state.get(nym_to_state_key(nym),
+                                                 isCommitted=is_committed))
+
+
+# ------------------------------------------------------------------ NODE
+
+class NodeHandler(WriteRequestHandler):
+    """Pool membership: NODE txns add nodes / update services & keys.
+    Reference: plenum/server/request_handlers/node_handler.py +
+    pool_manager semantics."""
+
+    def __init__(self, database_manager: DatabaseManager,
+                 steward_provider=None):
+        super().__init__(database_manager, NODE, POOL_LEDGER_ID)
+        self._steward_provider = steward_provider
+
+    def static_validation(self, request: Request):
+        op = request.operation
+        if not op.get(TARGET_NYM):
+            raise InvalidClientRequest(request.identifier, request.reqId,
+                                       "NODE must have a dest")
+        data = op.get(DATA)
+        if not isinstance(data, dict) or not data.get("alias"):
+            raise InvalidClientRequest(request.identifier, request.reqId,
+                                       "NODE data must include alias")
+
+    def dynamic_validation(self, request: Request, req_pp_time=None):
+        op = request.operation
+        existing, _, _ = decode_state_value(self.state.get(
+            nym_to_state_key(op[TARGET_NYM]), isCommitted=False))
+        data = op.get(DATA, {})
+        if existing is None:
+            # new node: alias must be unique
+            aliases = self._committed_aliases()
+            if data.get("alias") in aliases:
+                raise InvalidClientRequest(
+                    request.identifier, request.reqId,
+                    "node alias {} already taken".format(data.get("alias")))
+        else:
+            if data.get("alias") and \
+                    data["alias"] != existing.get("alias"):
+                raise InvalidClientRequest(
+                    request.identifier, request.reqId,
+                    "node alias cannot change")
+
+    def _committed_aliases(self):
+        aliases = set()
+        for key, value in self.state.head.items():
+            val, _, _ = decode_state_value(value)
+            if isinstance(val, dict) and "alias" in val:
+                aliases.add(val["alias"])
+        return aliases
+
+    def update_state(self, txn: dict, prev_result, request: Request,
+                     is_committed: bool = False):
+        data = get_payload_data(txn)
+        nym = data[TARGET_NYM]
+        existing, _, _ = decode_state_value(
+            self.state.get(nym_to_state_key(nym), isCommitted=False))
+        value = dict(existing or {})
+        value.update(data.get(DATA, {}))
+        self.state.set(nym_to_state_key(nym),
+                       encode_state_value(value, get_seq_no(txn),
+                                          get_txn_time(txn)))
+        return value
+
+
+# ---------------------------------------------------------------- GET_TXN
+
+class GetTxnHandler(ReadRequestHandler):
+    """Reference: plenum/server/request_handlers/get_txn_handler.py."""
+
+    def __init__(self, database_manager: DatabaseManager):
+        super().__init__(database_manager, GET_TXN, None)
+
+    def get_result(self, request: Request) -> dict:
+        op = request.operation
+        lid = op.get("ledgerId", DOMAIN_LEDGER_ID)
+        seq_no = op.get(DATA)
+        ledger = self.database_manager.get_ledger(lid)
+        if ledger is None:
+            raise InvalidClientRequest(request.identifier, request.reqId,
+                                       "unknown ledger {}".format(lid))
+        txn = ledger.getBySeqNo(seq_no) if isinstance(seq_no, int) else None
+        return {
+            TXN_TYPE: GET_TXN,
+            "identifier": request.identifier,
+            "reqId": request.reqId,
+            "seqNo": seq_no,
+            "data": txn,
+        }
+
+
+# ------------------------------------------------------------------- NYM read
+
+class GetNymHandler(ReadRequestHandler):
+    def __init__(self, database_manager: DatabaseManager):
+        super().__init__(database_manager, "105", DOMAIN_LEDGER_ID)
+
+    def get_result(self, request: Request) -> dict:
+        nym = request.operation.get(TARGET_NYM)
+        if not isinstance(nym, str) or not nym:
+            raise InvalidClientRequest(request.identifier, request.reqId,
+                                       "GET_NYM must have a dest")
+        data, seq_no, update_time = decode_state_value(
+            self.state.get(nym_to_state_key(nym), isCommitted=True))
+        proof = self.state.generate_state_proof(nym_to_state_key(nym),
+                                                serialize=True)
+        return {
+            TXN_TYPE: "105",
+            "identifier": request.identifier,
+            "reqId": request.reqId,
+            "dest": nym,
+            "data": data,
+            "seqNo": seq_no,
+            "state_proof": proof,
+        }

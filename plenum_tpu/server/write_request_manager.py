@@ -1,0 +1,183 @@
+"""Write/Read request managers — the handler registry + batch pipeline.
+
+Reference: plenum/server/request_managers/write_request_manager.py:33
+(apply_request :148, commit_batch :178, update_state :128) and
+read_request_manager.py. The write manager stages request batches onto
+ledgers + MPT state (uncommitted), creates the audit txn via the batch
+handler chain, and commits or reverts whole batches as 3PC decides.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from plenum_tpu.common.constants import AUDIT_LEDGER_ID
+from plenum_tpu.common.exceptions import InvalidClientRequest
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.txn_util import append_txn_metadata, reqToTxn
+from plenum_tpu.server.batch_handlers import (
+    AuditBatchHandler, BatchRequestHandler)
+from plenum_tpu.server.database_manager import DatabaseManager
+from plenum_tpu.server.request_handlers import (
+    ReadRequestHandler, WriteRequestHandler)
+from plenum_tpu.server.three_pc_batch import ThreePcBatch
+
+logger = logging.getLogger(__name__)
+
+
+class WriteRequestManager:
+    def __init__(self, database_manager: DatabaseManager):
+        self.database_manager = database_manager
+        self.request_handlers: Dict[str, WriteRequestHandler] = {}
+        self.batch_handlers: Dict[int, List[BatchRequestHandler]] = {}
+        self.audit_b_handler: Optional[AuditBatchHandler] = None
+        # staged batches in apply order: (ledger_id, txn_count)
+        self._applied_batches: List[Tuple[int, int]] = []
+
+    # -------------------------------------------------------- registration
+
+    def register_req_handler(self, handler: WriteRequestHandler):
+        self.request_handlers[handler.txn_type] = handler
+
+    def register_batch_handler(self, handler: BatchRequestHandler,
+                               ledger_id: Optional[int] = None):
+        lid = ledger_id if ledger_id is not None else handler.ledger_id
+        chain = self.batch_handlers.setdefault(lid, [])
+        chain.append(handler)
+        if isinstance(handler, AuditBatchHandler):
+            self.audit_b_handler = handler
+
+    def is_valid_type(self, txn_type: str) -> bool:
+        return txn_type in self.request_handlers
+
+    def type_to_ledger_id(self, txn_type: str) -> Optional[int]:
+        h = self.request_handlers.get(txn_type)
+        return h.ledger_id if h else None
+
+    # --------------------------------------------------------- validation
+
+    def static_validation(self, request: Request):
+        handler = self.request_handlers.get(request.txn_type)
+        if handler is None:
+            raise InvalidClientRequest(
+                request.identifier, request.reqId,
+                "unknown txn type {}".format(request.txn_type))
+        handler.static_validation(request)
+
+    def dynamic_validation(self, request: Request, req_pp_time=None):
+        handler = self.request_handlers.get(request.txn_type)
+        if handler is None:
+            raise InvalidClientRequest(
+                request.identifier, request.reqId,
+                "unknown txn type {}".format(request.txn_type))
+        handler.dynamic_validation(request, req_pp_time)
+
+    # -------------------------------------------------------------- apply
+
+    def apply_request(self, request: Request, batch_ts: int) -> dict:
+        """Stage one request: reqToTxn, update uncommitted state, stage
+        ledger txn. Returns the txn."""
+        handler = self.request_handlers[request.txn_type]
+        txn = append_txn_metadata(reqToTxn(request), txn_time=batch_ts)
+        ledger = handler.ledger
+        ledger.append_txns_metadata([txn], batch_ts)
+        ledger.appendTxns([txn])
+        handler.update_state(txn, None, request)
+        return txn
+
+    def post_apply_batch(self, three_pc_batch: ThreePcBatch):
+        """Run the batch-handler chain after a batch's requests applied
+        (audit txn creation happens here)."""
+        for handler in self.batch_handlers.get(three_pc_batch.ledger_id, []):
+            handler.post_batch_applied(three_pc_batch)
+        for handler in self.batch_handlers.get(AUDIT_LEDGER_ID, []):
+            handler.post_batch_applied(three_pc_batch)
+        self._applied_batches.append(
+            (three_pc_batch.ledger_id, len(three_pc_batch.valid_digests)))
+
+    # ------------------------------------------------------------- commit
+
+    def commit_batch(self, three_pc_batch: ThreePcBatch):
+        committed = []
+        for handler in self.batch_handlers.get(three_pc_batch.ledger_id, []):
+            result = handler.commit_batch(three_pc_batch)
+            if result:
+                committed = result
+        for handler in self.batch_handlers.get(AUDIT_LEDGER_ID, []):
+            handler.commit_batch(three_pc_batch)
+        if self._applied_batches:
+            self._applied_batches.pop(0)
+        return committed
+
+    # ------------------------------------------------------------- revert
+
+    def post_batch_rejected(self, ledger_id: Optional[int] = None):
+        """Revert the NEWEST applied batch."""
+        if not self._applied_batches:
+            return
+        lid, count = self._applied_batches.pop()
+        ledger = self.database_manager.get_ledger(lid)
+        state = self.database_manager.get_state(lid)
+        audit = self.database_manager.get_ledger(AUDIT_LEDGER_ID)
+        if ledger is not None and count:
+            ledger.discardTxns(count)
+        if audit is not None and audit.uncommittedTxns:
+            audit.discardTxns(1)
+        self._rewind_states()
+
+    def revert_all_uncommitted(self) -> int:
+        """Revert every staged batch (view change start)."""
+        n = 0
+        while self._applied_batches:
+            self.post_batch_rejected()
+            n += 1
+        return n
+
+    def _rewind_states(self):
+        """Reset every state head to match the last remaining staged batch
+        (or the committed root if none): heads are recomputed from the
+        audit ledger's staged entries."""
+        audit = self.database_manager.get_ledger(AUDIT_LEDGER_ID)
+        last_roots = None
+        if audit is not None and audit.uncommittedTxns:
+            from plenum_tpu.common.txn_util import get_payload_data
+            from plenum_tpu.server.batch_handlers import AUDIT_TXN_STATE_ROOT
+            last_roots = get_payload_data(
+                audit.uncommittedTxns[-1]).get(AUDIT_TXN_STATE_ROOT, {})
+        for lid in self.database_manager.ledger_ids:
+            if lid == AUDIT_LEDGER_ID:
+                continue
+            state = self.database_manager.get_state(lid)
+            ledger = self.database_manager.get_ledger(lid)
+            if state is None:
+                continue
+            if last_roots is not None and str(lid) in last_roots:
+                state.revertToHead(ledger.strToHash(last_roots[str(lid)]))
+            else:
+                state.revertToHead(state.committedHeadHash)
+
+    @property
+    def applied_batch_count(self) -> int:
+        return len(self._applied_batches)
+
+
+class ReadRequestManager:
+    def __init__(self):
+        self.request_handlers: Dict[str, ReadRequestHandler] = {}
+
+    def register_req_handler(self, handler: ReadRequestHandler):
+        self.request_handlers[handler.txn_type] = handler
+
+    def is_valid_type(self, txn_type: str) -> bool:
+        return txn_type in self.request_handlers
+
+    def static_validation(self, request: Request):
+        pass
+
+    def get_result(self, request: Request) -> dict:
+        handler = self.request_handlers.get(request.txn_type)
+        if handler is None:
+            raise InvalidClientRequest(
+                request.identifier, request.reqId,
+                "unknown read type {}".format(request.txn_type))
+        return handler.get_result(request)

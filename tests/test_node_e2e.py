@@ -1,0 +1,196 @@
+"""End-to-end node tests (SURVEY.md §7 minimum slice): a 4-node pool of
+full Nodes — real ledgers, MPT state, audit ledger, authentication,
+propagation, 3PC — ordering signed NYM writes and serving reads with
+state proofs. No sockets: SimNetwork + MockTimer.
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    AUDIT_LEDGER_ID, DOMAIN_LEDGER_ID, NYM, TARGET_NYM, VERKEY)
+from plenum_tpu.common.messages.node_messages import (
+    Reply, RequestAck, RequestNack)
+from plenum_tpu.crypto.signer import DidSigner, SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.mock_timer import MockTimer
+from plenum_tpu.testing.sim_network import SimNetwork
+
+SIM_EPOCH = 1600000000
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+
+
+class ClientSink:
+    """Collects per-client replies from every node."""
+
+    def __init__(self):
+        self.messages = []
+
+    def __call__(self, client_id, msg):
+        self.messages.append((client_id, msg))
+
+    def of_type(self, tp):
+        return [m for _, m in self.messages if isinstance(m, tp)]
+
+
+@pytest.fixture
+def pool(mock_timer):
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(77))
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15)
+    sinks = {}
+    nodes = []
+    for name in NAMES:
+        sink = ClientSink()
+        sinks[name] = sink
+        nodes.append(Node(name, NAMES, mock_timer, net.create_peer(name),
+                          config=conf, client_reply_handler=sink))
+    return nodes, sinks, net, mock_timer
+
+
+def pump(timer, nodes, seconds=5.0, step=0.05):
+    end = timer.get_current_time() + seconds
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(step)
+
+
+def signed_nym_request(signer, dest_signer=None, req_id=1):
+    dest = dest_signer or signer
+    req = {
+        "identifier": signer.identifier,
+        "reqId": req_id,
+        "protocolVersion": 2,
+        "operation": {"type": NYM, TARGET_NYM: dest.identifier,
+                      VERKEY: dest.verkey},
+    }
+    req["signature"] = signer.sign(
+        {k: v for k, v in req.items()})
+    return req
+
+
+def submit_to_all(nodes, req, client_id="client1"):
+    for n in nodes:
+        n.process_client_request(dict(req), client_id)
+
+
+def test_signed_nym_write_end_to_end(pool):
+    nodes, sinks, net, timer = pool
+    client = SimpleSigner(seed=b"\x21" * 32)
+    req = signed_nym_request(client)
+    submit_to_all(nodes, req)
+    pump(timer, nodes, 8)
+    # ordered everywhere
+    assert all(n.last_ordered[1] == 1 for n in nodes)
+    # domain ledgers identical, contain the txn
+    roots = {n.domain_ledger.root_hash for n in nodes}
+    assert len(roots) == 1
+    assert all(n.domain_ledger.size == 1 for n in nodes)
+    # audit ledger recorded the batch
+    assert all(n.audit_ledger.size == 1 for n in nodes)
+    # every node acked, and every node replied with the committed txn
+    for name in NAMES:
+        acks = sinks[name].of_type(RequestAck)
+        replies = sinks[name].of_type(Reply)
+        assert len(acks) == 1
+        assert len(replies) == 1
+        result = replies[0].result
+        assert result["txn"]["data"][TARGET_NYM] == client.identifier
+        assert "auditPath" in result and "rootHash" in result
+
+
+def test_unsigned_write_nacked(pool):
+    nodes, sinks, _, timer = pool
+    client = SimpleSigner(seed=b"\x22" * 32)
+    req = signed_nym_request(client)
+    req["signature"] = SimpleSigner(seed=b"\x23" * 32).sign(
+        {k: v for k, v in req.items() if k != "signature"})  # wrong signer
+    nodes[0].process_client_request(req, "client1")
+    nacks = sinks["Alpha"].of_type(RequestNack)
+    assert len(nacks) == 1
+    assert "signature" in nacks[0].reason.lower() or \
+        "sufficient" in nacks[0].reason.lower()
+
+
+def test_state_readable_with_proof_after_write(pool):
+    nodes, sinks, net, timer = pool
+    client = SimpleSigner(seed=b"\x24" * 32)
+    submit_to_all(nodes, signed_nym_request(client))
+    pump(timer, nodes, 8)
+    # read back via GET_NYM (type 105) with a state proof
+    read_req = {
+        "identifier": client.identifier,
+        "reqId": 99,
+        "operation": {"type": "105", TARGET_NYM: client.identifier},
+    }
+    nodes[1].process_client_request(read_req, "reader")
+    reply = sinks["Beta"].of_type(Reply)[-1]
+    data = reply.result["data"]
+    assert data is not None and data[VERKEY] == client.verkey
+    proof = reply.result["state_proof"]
+    # verify the proof against the node's committed state root
+    from plenum_tpu.server.request_handlers import (
+        encode_state_value, nym_to_state_key)
+    from plenum_tpu.state.pruning_state import PruningState
+    nym_handler = nodes[1].write_manager.request_handlers[NYM]
+    root = nym_handler.state.committedHeadHash
+    nodes_list = PruningState.deserialize_proof(proof)
+    expected_value = encode_state_value(
+        data, reply.result["seqNo"], None)
+    # value encodes (val, lsn, lut); reconstruct exactly as stored
+    raw = nym_handler.state.get(
+        nym_to_state_key(client.identifier), isCommitted=True)
+    assert PruningState.verify_state_proof(
+        root, nym_to_state_key(client.identifier), bytes(raw), nodes_list)
+
+
+def test_duplicate_request_replied_from_ledger(pool):
+    nodes, sinks, net, timer = pool
+    client = SimpleSigner(seed=b"\x25" * 32)
+    req = signed_nym_request(client)
+    submit_to_all(nodes, req)
+    pump(timer, nodes, 8)
+    replies_before = len(sinks["Alpha"].of_type(Reply))
+    # resubmit the same request: immediate reply from the dedup index
+    nodes[0].process_client_request(dict(req), "client1")
+    replies_after = sinks["Alpha"].of_type(Reply)
+    assert len(replies_after) == replies_before + 1
+    assert replies_after[-1].result["txnMetadata"]["seqNo"] == 1
+    # and nothing new gets ordered
+    pump(timer, nodes, 5)
+    assert all(n.last_ordered[1] == 1 for n in nodes)
+
+
+def test_many_clients_batched_auth(pool):
+    """The batched intake path: many requests authenticated in one
+    dispatch, then ordered together."""
+    nodes, sinks, net, timer = pool
+    clients = [SimpleSigner(seed=bytes([40 + i]) * 32) for i in range(8)]
+    batch = []
+    for i, c in enumerate(clients):
+        batch.append((signed_nym_request(c, req_id=100 + i),
+                      "client-%d" % i))
+    for n in nodes:
+        n.process_client_batch(list(batch))
+    pump(timer, nodes, 10)
+    assert all(n.last_ordered[1] >= 1 for n in nodes)
+    assert all(n.domain_ledger.size == 8 for n in nodes)
+    roots = {n.domain_ledger.root_hash for n in nodes}
+    assert len(roots) == 1
+    # every client got a reply from every node
+    for name in NAMES:
+        assert len(sinks[name].of_type(Reply)) == 8
+
+
+def test_checkpointing_with_real_audit_roots(pool):
+    nodes, sinks, net, timer = pool
+    clients = [SimpleSigner(seed=bytes([60 + i]) * 32) for i in range(12)]
+    for i, c in enumerate(clients):
+        submit_to_all(nodes, signed_nym_request(c, req_id=200 + i))
+        pump(timer, nodes, 1.2)
+    pump(timer, nodes, 5)
+    assert all(n.last_ordered[1] >= 10 for n in nodes)
+    # checkpoints stabilized with audit-root digests
+    assert all(n.replica.data.stable_checkpoint >= 5 for n in nodes)
