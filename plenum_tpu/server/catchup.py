@@ -1,0 +1,374 @@
+"""Catchup — ledger synchronization (leecher + seeder).
+
+Reference: plenum/server/catchup/ — SeederService (seeder_service.py:14,
+answers LedgerStatus/CatchupReq with txns + consistency proofs),
+ConsProofService (cons_proof_service.py:24, agrees on a target size+root
+from peer evidence), CatchupRepService (catchup_rep_service.py:18,
+fetches txn ranges split across peers and verifies them against the
+agreed root), NodeLeecherService (node_leecher_service.py:21, the state
+machine ordering ledgers: audit → pool → config → domain,
+docs/source/catchup.md:14).
+
+Verification model: the target (size, root) is fixed by a quorum of
+ConsistencyProofs; fetched txns are replayed into a shadow merkle tree
+and accepted only if the resulting root matches the agreed target root —
+the root binds every byte, so a lying seeder can delay but never corrupt
+(a failed range is re-requested from other peers).
+"""
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from enum import Enum, auto
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    AUDIT_LEDGER_ID, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID, POOL_LEDGER_ID)
+from plenum_tpu.common.messages.internal_messages import CatchupFinished
+from plenum_tpu.common.messages.node_messages import (
+    CatchupRep, CatchupReq, ConsistencyProof, LedgerStatus)
+from plenum_tpu.consensus.quorums import Quorums
+from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.ledger.tree_hasher import TreeHasher
+from plenum_tpu.runtime.timer import RepeatingTimer, TimerService
+
+logger = logging.getLogger(__name__)
+
+CATCHUP_LEDGER_ORDER = [AUDIT_LEDGER_ID, POOL_LEDGER_ID, CONFIG_LEDGER_ID,
+                        DOMAIN_LEDGER_ID]
+
+
+class SeederService:
+    """Answers peers' catchup questions from our committed ledgers."""
+
+    def __init__(self, db_manager, network, name: str = "?"):
+        self._db = db_manager
+        self._network = network
+        self.name = name
+        network.subscribe(LedgerStatus, self.process_ledger_status)
+        network.subscribe(CatchupReq, self.process_catchup_req)
+
+    def _own_status(self, lid: int) -> LedgerStatus:
+        # viewNo=0 marks this as a RESPONSE: seeders only answer
+        # solicitations (viewNo None), so two up-to-date peers can never
+        # ping-pong statuses at each other forever
+        ledger = self._db.get_ledger(lid)
+        return LedgerStatus(ledgerId=lid, txnSeqNo=ledger.size,
+                            viewNo=0, ppSeqNo=None,
+                            merkleRoot=ledger.root_hash,
+                            protocolVersion=2)
+
+    def process_ledger_status(self, status: LedgerStatus, frm: str):
+        if status.viewNo is not None:
+            return  # a response to someone's solicitation, not for us
+        ledger = self._db.get_ledger(status.ledgerId)
+        if ledger is None:
+            return
+        if status.txnSeqNo < ledger.size:
+            # requester is behind: prove our extension over their prefix
+            proof = self._build_consistency_proof(
+                status.ledgerId, status.txnSeqNo, ledger.size)
+            if proof is not None:
+                self._network.send(proof, [frm])
+        else:
+            # same or ahead: echo our status so they can count the quorum
+            self._network.send(self._own_status(status.ledgerId), [frm])
+
+    def _build_consistency_proof(self, lid: int, start: int, end: int
+                                 ) -> Optional[ConsistencyProof]:
+        ledger = self._db.get_ledger(lid)
+        try:
+            if start == 0:
+                # a proof from the empty prefix is trivially empty
+                # (RFC 6962: PROOF(0, D[n]) = {}); the new root alone
+                # carries the commitment
+                hashes = []
+                old_root = Ledger.hashToStr(ledger.hasher.hash_empty())
+            else:
+                hashes = [Ledger.hashToStr(h) for h in
+                          ledger.tree.consistency_proof(start, end)]
+                old_root = Ledger.hashToStr(
+                    ledger.tree.merkle_tree_hash(0, start))
+        except Exception:
+            logger.warning("%s cannot build consistency proof %s..%s",
+                           self.name, start, end)
+            return None
+        return ConsistencyProof(
+            ledgerId=lid, seqNoStart=start, seqNoEnd=end,
+            viewNo=None, ppSeqNo=None,
+            oldMerkleRoot=old_root, newMerkleRoot=ledger.root_hash,
+            hashes=hashes)
+
+    def process_catchup_req(self, req: CatchupReq, frm: str):
+        ledger = self._db.get_ledger(req.ledgerId)
+        if ledger is None:
+            return
+        end = min(req.seqNoEnd, ledger.size)
+        if end < req.seqNoStart:
+            return
+        txns = {}
+        for seq in range(req.seqNoStart, end + 1):
+            txn = ledger.getBySeqNo(seq)
+            if txn is None:
+                return
+            txns[str(seq)] = txn
+        self._network.send(CatchupRep(ledgerId=req.ledgerId, txns=txns,
+                                      consProof=[]), [frm])
+
+
+class LeecherState(Enum):
+    IDLE = auto()
+    SYNCING = auto()
+    DONE = auto()
+
+
+class LedgerLeecher:
+    """Catchup driver for ONE ledger: cons-proof phase then rep phase."""
+
+    def __init__(self, lid: int, db_manager, network, timer: TimerService,
+                 quorums_source: Callable[[], Quorums],
+                 on_txn: Callable[[int, dict], None],
+                 on_done: Callable[[int], None],
+                 config: Optional[Config] = None):
+        self.lid = lid
+        self._db = db_manager
+        self._network = network
+        self._timer = timer
+        self._quorums = quorums_source
+        self._on_txn = on_txn
+        self._on_done = on_done
+        self._config = config or Config()
+        self.state = LeecherState.IDLE
+        self._statuses_same: Set[str] = set()
+        self._cons_proofs: Dict[Tuple, Set[str]] = defaultdict(set)
+        self.target_size: Optional[int] = None
+        self.target_root: Optional[str] = None
+        self._buffer: Dict[int, dict] = {}
+        self._retry_timer: Optional[RepeatingTimer] = None
+
+    @property
+    def ledger(self) -> Ledger:
+        return self._db.get_ledger(self.lid)
+
+    # ------------------------------------------------------------- start
+
+    def start(self):
+        self.state = LeecherState.SYNCING
+        self._statuses_same = set()
+        self._cons_proofs.clear()
+        self._buffer.clear()
+        self.target_size = None
+        self.target_root = None
+        self._broadcast_status()
+        self._retry_timer = RepeatingTimer(
+            self._timer, self._config.CATCHUP_TXN_TIMEOUT, self._retry)
+
+    def _broadcast_status(self):
+        ledger = self.ledger
+        self._network.send(LedgerStatus(
+            ledgerId=self.lid, txnSeqNo=ledger.size, viewNo=None,
+            ppSeqNo=None, merkleRoot=ledger.root_hash, protocolVersion=2))
+
+    def stop(self):
+        if self._retry_timer is not None:
+            self._retry_timer.stop()
+            self._retry_timer = None
+        self.state = LeecherState.DONE
+
+    def _finish(self):
+        self.stop()
+        self._on_done(self.lid)
+
+    def _retry(self):
+        if self.state != LeecherState.SYNCING:
+            return
+        if self.target_size is None:
+            self._broadcast_status()
+        else:
+            self._request_missing()
+
+    # ----------------------------------------------------- status phase
+
+    def process_ledger_status(self, status: LedgerStatus, frm: str):
+        if self.state != LeecherState.SYNCING or status.ledgerId != self.lid:
+            return
+        ledger = self.ledger
+        # "same" means same size AND same root — an equal-size peer with a
+        # different root is divergence, not agreement
+        if status.txnSeqNo == ledger.size \
+                and status.merkleRoot == ledger.root_hash:
+            self._statuses_same.add(frm)
+            if self._quorums().ledger_status.is_reached(
+                    len(self._statuses_same)) and self.target_size is None:
+                self._finish()
+
+    def process_consistency_proof(self, proof: ConsistencyProof, frm: str):
+        if self.state != LeecherState.SYNCING or proof.ledgerId != self.lid:
+            return
+        if proof.seqNoStart != self.ledger.size:
+            return
+        key = (proof.seqNoStart, proof.seqNoEnd, proof.newMerkleRoot)
+        self._cons_proofs[key].add(frm)
+        quorum = self._quorums().consistency_proof
+        agreed = [k for k, votes in self._cons_proofs.items()
+                  if quorum.is_reached(len(votes))]
+        if not agreed:
+            return
+        # go for the largest agreed extension
+        start, end, root = max(agreed, key=lambda k: k[1])
+        if self.target_size is None or end > self.target_size:
+            self.target_size = end
+            self.target_root = root
+            self._request_missing()
+
+    # -------------------------------------------------------- rep phase
+
+    def _request_missing(self):
+        if self.target_size is None:
+            return
+        start = self.ledger.size + 1
+        missing = [s for s in range(start, self.target_size + 1)
+                   if s not in self._buffer]
+        if not missing:
+            self._try_apply()
+            return
+        peers = sorted(self._network.connecteds) or [None]
+        # split contiguous chunks across peers
+        chunk = max(1, (len(missing) + len(peers) - 1) // len(peers))
+        for i, peer in enumerate(peers):
+            lo = i * chunk
+            if lo >= len(missing):
+                break
+            hi = min(lo + chunk, len(missing)) - 1
+            req = CatchupReq(ledgerId=self.lid,
+                             seqNoStart=missing[lo],
+                             seqNoEnd=missing[hi],
+                             catchupTill=self.target_size)
+            self._network.send(req, [peer] if peer else None)
+
+    def process_catchup_rep(self, rep: CatchupRep, frm: str):
+        if self.state != LeecherState.SYNCING or rep.ledgerId != self.lid:
+            return
+        if self.target_size is None:
+            return
+        for seq_str, txn in rep.txns.items():
+            seq = int(seq_str)
+            if self.ledger.size < seq <= self.target_size:
+                self._buffer[seq] = txn
+        self._try_apply()
+
+    def _try_apply(self):
+        """All txns present → replay into a shadow tree, accept only if
+        the root matches the quorum-agreed target root."""
+        ledger = self.ledger
+        start = ledger.size + 1
+        if self.target_size is None or self.target_size < start:
+            self._finish()
+            return
+        if any(s not in self._buffer
+               for s in range(start, self.target_size + 1)):
+            return
+        shadow = ledger.tree.copy_shadow()
+        txns = [self._buffer[s] for s in range(start, self.target_size + 1)]
+        for txn in txns:
+            shadow._append_hash(ledger.hasher.hash_leaf(
+                ledger.serialize_for_tree(txn)))
+        got_root = Ledger.hashToStr(shadow.root_hash)
+        if got_root != self.target_root:
+            logger.warning("catchup root mismatch on ledger %s: got %s "
+                           "expected %s — discarding buffer and retrying",
+                           self.lid, got_root, self.target_root)
+            self._buffer.clear()
+            self._request_missing()
+            return
+        for seq, txn in zip(range(start, self.target_size + 1), txns):
+            self._on_txn(self.lid, txn)
+        self._buffer.clear()
+        self._finish()
+
+
+class NodeLeecherService:
+    """State machine over all ledgers: audit → pool → config → domain
+    (reference node_leecher_service.py:21-27; audit first — it drives
+    consistency of the rest, catchup.md:14-23)."""
+
+    def __init__(self, db_manager, network, timer: TimerService,
+                 quorums_source: Callable[[], Quorums],
+                 on_catchup_txn: Callable[[int, dict], None],
+                 on_finished: Callable[[], None],
+                 config: Optional[Config] = None,
+                 name: str = "?"):
+        self._db = db_manager
+        self._network = network
+        self._timer = timer
+        self._on_finished = on_finished
+        self.name = name
+        self.in_progress = False
+        self._leechers: Dict[int, LedgerLeecher] = {}
+        for lid in CATCHUP_LEDGER_ORDER:
+            if self._db.get_ledger(lid) is None:
+                continue
+            self._leechers[lid] = LedgerLeecher(
+                lid, db_manager, network, timer, quorums_source,
+                on_txn=on_catchup_txn, on_done=self._on_ledger_done,
+                config=config)
+        self._order = [lid for lid in CATCHUP_LEDGER_ORDER
+                       if lid in self._leechers]
+        self._current = 0
+        network.subscribe(LedgerStatus, self._route_status)
+        network.subscribe(ConsistencyProof, self._route_proof)
+        network.subscribe(CatchupRep, self._route_rep)
+
+    # ------------------------------------------------------------ routing
+
+    def _active(self) -> Optional[LedgerLeecher]:
+        if not self.in_progress or self._current >= len(self._order):
+            return None
+        return self._leechers[self._order[self._current]]
+
+    def _route_status(self, msg: LedgerStatus, frm: str):
+        leecher = self._leechers.get(msg.ledgerId)
+        if leecher is not None:
+            leecher.process_ledger_status(msg, frm)
+
+    def _route_proof(self, msg: ConsistencyProof, frm: str):
+        leecher = self._leechers.get(msg.ledgerId)
+        if leecher is not None:
+            leecher.process_consistency_proof(msg, frm)
+
+    def _route_rep(self, msg: CatchupRep, frm: str):
+        leecher = self._leechers.get(msg.ledgerId)
+        if leecher is not None:
+            leecher.process_catchup_rep(msg, frm)
+
+    # ------------------------------------------------------------- drive
+
+    def start(self):
+        if self.in_progress:
+            return
+        self.in_progress = True
+        self._current = 0
+        self._start_current()
+
+    def _start_current(self):
+        active = self._active()
+        if active is None:
+            self._finish()
+            return
+        active.start()
+
+    def _on_ledger_done(self, lid: int):
+        if not self.in_progress:
+            return
+        self._current += 1
+        if self._current >= len(self._order):
+            self._finish()
+        else:
+            self._start_current()
+
+    def _finish(self):
+        self.in_progress = False
+        for leecher in self._leechers.values():
+            leecher.stop()
+        self._on_finished()

@@ -25,14 +25,19 @@ class NodeBatchExecutor(BatchExecutor):
                  requests_source: Callable[[str], Optional[Request]],
                  get_view_no: Callable[[], int] = None,
                  get_primaries: Callable[[], List[str]] = None,
+                 get_pp_seq_no: Callable[[], int] = None,
                  on_batch_committed: Callable = None):
-        """requests_source(digest) → Request (the propagator's store)."""
+        """requests_source(digest) → Request (the propagator's store).
+        get_pp_seq_no() → seq of the batch being applied NOW (the
+        ordering service's apply position + 1) — must survive catchup
+        fast-forwards and view changes, so it cannot be a local counter."""
         self.write_manager = write_manager
         self._requests_source = requests_source
         self._get_view_no = get_view_no or (lambda: 0)
         self._get_primaries = get_primaries or (lambda: [])
-        self._on_batch_committed = on_batch_committed
+        self._get_pp_seq_no = get_pp_seq_no
         self._pp_seq_no = 0
+        self._on_batch_committed = on_batch_committed
         # staged batches by apply order (mirrors write manager staging)
         self._staged: List[ThreePcBatch] = []
 
@@ -60,7 +65,10 @@ class NodeBatchExecutor(BatchExecutor):
                 continue
             self.write_manager.apply_request(request, pp_time)
             valid.append(digest)
-        self._pp_seq_no += 1
+        if self._get_pp_seq_no is not None:
+            self._pp_seq_no = self._get_pp_seq_no()
+        else:
+            self._pp_seq_no += 1
         state_root = ledger.hashToStr(state.headHash) if state else ""
         txn_root = ledger.hashToStr(ledger.uncommitted_root_hash)
         batch = ThreePcBatch(
@@ -86,14 +94,16 @@ class NodeBatchExecutor(BatchExecutor):
     def revert_unordered_batches(self) -> int:
         n = self.write_manager.revert_all_uncommitted()
         self._staged = []
-        self._pp_seq_no -= n
+        if self._get_pp_seq_no is None:
+            self._pp_seq_no -= n
         return n
 
     def revert_last_batch(self):
         if self._staged:
             self._staged.pop()
             self.write_manager.post_batch_rejected()
-            self._pp_seq_no -= 1
+            if self._get_pp_seq_no is None:
+                self._pp_seq_no -= 1
 
     # ------------------------------------------------------------- commit
 

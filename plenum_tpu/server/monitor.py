@@ -1,0 +1,232 @@
+"""Monitor — performance watchdog and primary-failure detection.
+
+Reference: plenum/server/monitor.py (Monitor :136, RequestTimeTracker :30,
+isMasterDegraded :425, instance_throughput_ratio :456), pluggable
+throughput strategies (plenum/common/throughput_measurements.py: EMA
+:25, revival-spike-resistant :99), and
+plenum/server/consensus/monitoring/primary_connection_monitor_service.py
+(primary disconnected > ToleratePrimaryDisconnection → vote view change).
+
+RBFT's core idea: backup protocol instances exist only to benchmark the
+master — if the master's throughput ratio vs the best backup drops below
+Δ, the master primary is assumed malicious/slow and a view change fires.
+With a single instance (this round), degradation falls back to latency:
+requests ordered too slowly (> Λ) trigger the same vote.
+"""
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.messages.internal_messages import (
+    PrimaryDisconnected, VoteForViewChange)
+from plenum_tpu.runtime.bus import ExternalBus
+from plenum_tpu.runtime.timer import RepeatingTimer, TimerService
+
+logger = logging.getLogger(__name__)
+
+
+class EMAThroughputMeasurement:
+    """Exponential-moving-average req/s (reference
+    throughput_measurements.py:25)."""
+
+    def __init__(self, window_size: int = 15, min_cnt: int = 16,
+                 first_ts: float = 0.0):
+        self.window_size = window_size
+        self.alpha = 2 / (min_cnt + 1)
+        self.throughput = 0.0
+        self.reqs_in_window = 0
+        self.window_start_ts = first_ts
+
+    def add_request(self, ts: float):
+        self._update_time(ts)
+        self.reqs_in_window += 1
+
+    def get_throughput(self, ts: float) -> Optional[float]:
+        self._update_time(ts)
+        return self.throughput
+
+    def _update_time(self, ts: float):
+        while ts >= self.window_start_ts + self.window_size:
+            rate = self.reqs_in_window / self.window_size
+            self.throughput = (self.alpha * rate
+                               + (1 - self.alpha) * self.throughput)
+            self.window_start_ts += self.window_size
+            self.reqs_in_window = 0
+
+
+class RevivalSpikeResistantEMAThroughputMeasurement(EMAThroughputMeasurement):
+    """Ignores the throughput spike right after an idle period (reference
+    :99 — a revived node bursts through its backlog and would look
+    artificially fast)."""
+
+    def __init__(self, window_size: int = 15, min_cnt: int = 16,
+                 first_ts: float = 0.0):
+        super().__init__(window_size, min_cnt, first_ts)
+        self._idle_windows = 0
+        self._suppress_windows = 0
+
+    def _update_time(self, ts: float):
+        while ts >= self.window_start_ts + self.window_size:
+            rate = self.reqs_in_window / self.window_size
+            if self.reqs_in_window == 0:
+                self._idle_windows += 1
+            else:
+                if self._idle_windows >= 2:
+                    # first active windows after idling: don't learn the
+                    # spike
+                    self._suppress_windows = 2
+                self._idle_windows = 0
+            if self._suppress_windows > 0:
+                self._suppress_windows -= 1
+            else:
+                self.throughput = (self.alpha * rate
+                                   + (1 - self.alpha) * self.throughput)
+            self.window_start_ts += self.window_size
+            self.reqs_in_window = 0
+
+
+class RequestTimeTracker:
+    """digest → submission time of requests awaiting ordering (reference
+    monitor.py:30)."""
+
+    def __init__(self):
+        self._started: Dict[str, float] = {}
+
+    def start(self, digest: str, ts: float):
+        self._started.setdefault(digest, ts)
+
+    def order(self, digest: str, ts: float) -> Optional[float]:
+        t0 = self._started.pop(digest, None)
+        return None if t0 is None else ts - t0
+
+    def unordered(self, now: float) -> List[float]:
+        return [now - t0 for t0 in self._started.values()]
+
+    def reset(self):
+        self._started.clear()
+
+
+class Monitor:
+    def __init__(self, name: str, timer: TimerService, bus,
+                 config: Optional[Config] = None,
+                 num_instances_source: Callable[[], int] = lambda: 1):
+        self.name = name
+        self._timer = timer
+        self._bus = bus
+        self.config = config or Config()
+        self._num_instances = num_instances_source
+        # per-instance throughput, instance 0 = master
+        self.throughputs: Dict[int, EMAThroughputMeasurement] = {}
+        self.request_tracker = RequestTimeTracker()
+        self.latencies = deque(maxlen=50)
+        self.total_ordered = 0
+        self._warm = False
+
+    def _throughput(self, inst_id: int) -> EMAThroughputMeasurement:
+        if inst_id not in self.throughputs:
+            self.throughputs[inst_id] = \
+                RevivalSpikeResistantEMAThroughputMeasurement(
+                    window_size=self.config.ThroughputWindowSize,
+                    first_ts=self._timer.get_current_time())
+        return self.throughputs[inst_id]
+
+    # ------------------------------------------------------------ inputs
+
+    def request_received(self, digest: str):
+        self.request_tracker.start(digest,
+                                   self._timer.get_current_time())
+
+    def request_ordered(self, digest: str, inst_id: int = 0):
+        now = self._timer.get_current_time()
+        self._throughput(inst_id).add_request(now)
+        latency = self.request_tracker.order(digest, now)
+        if latency is not None and inst_id == 0:
+            self.latencies.append(latency)
+            self.total_ordered += 1
+            self._warm = self._warm or \
+                self.total_ordered >= self.config.MIN_LATENCY_COUNT
+
+    def reset(self):
+        """View change happened: measurements restart."""
+        self.throughputs.clear()
+        self.request_tracker.reset()
+        self.latencies.clear()
+
+    # --------------------------------------------------------- judgments
+
+    def instance_throughput_ratio(self, inst_id: int = 0) -> Optional[float]:
+        """master throughput / best backup throughput (reference :456)."""
+        now = self._timer.get_current_time()
+        others = [t.get_throughput(now)
+                  for i, t in self.throughputs.items() if i != inst_id]
+        others = [t for t in others if t]
+        if not others:
+            return None
+        mine = self._throughput(inst_id).get_throughput(now) or 0.0
+        return mine / max(others)
+
+    def is_master_degraded(self) -> bool:
+        """RBFT check (reference isMasterDegraded :425): throughput ratio
+        below Δ, or (single-instance fallback) requests stuck unordered
+        beyond Λ."""
+        ratio = self.instance_throughput_ratio(0)
+        if ratio is not None and ratio < self.config.DELTA:
+            return True
+        now = self._timer.get_current_time()
+        stuck = [age for age in self.request_tracker.unordered(now)
+                 if age > self.config.LAMBDA]
+        return bool(stuck)
+
+    def avg_latency(self) -> Optional[float]:
+        if not self.latencies:
+            return None
+        return sum(self.latencies) / len(self.latencies)
+
+
+class PrimaryConnectionMonitorService:
+    """Votes for a view change when the master primary stays disconnected
+    longer than ToleratePrimaryDisconnection (reference
+    primary_connection_monitor_service.py)."""
+
+    def __init__(self, data, timer: TimerService, bus,
+                 network: ExternalBus, config: Optional[Config] = None):
+        self._data = data
+        self._timer = timer
+        self._bus = bus
+        self._config = config or Config()
+        self._primary_disconnected_at: Optional[float] = None
+        network.subscribe(ExternalBus.Connected, self._connection_changed)
+        network.subscribe(ExternalBus.Disconnected, self._connection_changed)
+        self._check_timer = RepeatingTimer(
+            timer, max(1.0, self._config.ToleratePrimaryDisconnection / 4),
+            self._check)
+
+    def stop(self):
+        self._check_timer.stop()
+
+    def _connection_changed(self, msg, frm: str):
+        if frm != self._data.primary_name:
+            return
+        if isinstance(msg, ExternalBus.Disconnected):
+            self._primary_disconnected_at = self._timer.get_current_time()
+            self._bus.send(PrimaryDisconnected(inst_id=self._data.inst_id))
+        else:
+            self._primary_disconnected_at = None
+
+    def _check(self):
+        if self._primary_disconnected_at is None:
+            return
+        if self._data.is_primary:
+            return
+        elapsed = self._timer.get_current_time() \
+            - self._primary_disconnected_at
+        if elapsed >= self._config.ToleratePrimaryDisconnection:
+            logger.info("%s primary %s disconnected for %.0fs — voting "
+                        "view change", self._data.name,
+                        self._data.primary_name, elapsed)
+            self._primary_disconnected_at = self._timer.get_current_time()
+            self._bus.send(VoteForViewChange(
+                suspicion="PRIMARY_DISCONNECTED"))

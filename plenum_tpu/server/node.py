@@ -125,6 +125,8 @@ class Node:
             requests_source=self._get_finalised_request,
             get_view_no=lambda: self.replica.view_no,
             get_primaries=lambda: [self.replica.data.primary_name or ""],
+            get_pp_seq_no=lambda:
+                self.replica.ordering._last_applied_seq + 1,
             on_batch_committed=self._on_batch_committed)
         self.replica = ReplicaService(
             name, validators, timer, network, executor=self.executor,
@@ -138,6 +140,45 @@ class Node:
         network.subscribe(Propagate, self.propagator.process_propagate)
 
         self._validator = ClientMessageValidator()
+
+        # ---- performance + primary-connection monitoring
+        from plenum_tpu.common.messages.internal_messages import (
+            NewViewAccepted, VoteForViewChange)
+        from plenum_tpu.runtime.timer import RepeatingTimer
+        from plenum_tpu.server.monitor import (
+            Monitor, PrimaryConnectionMonitorService)
+        self.monitor = Monitor(name, timer, self.replica.internal_bus,
+                               config=self.config)
+        self.primary_connection_monitor = PrimaryConnectionMonitorService(
+            self.replica.data, timer, self.replica.internal_bus, network,
+            config=self.config)
+        self.replica.internal_bus.subscribe(
+            NewViewAccepted, lambda msg: self.monitor.reset())
+
+        def _check_master_degraded():
+            if self.mode_participating and self.monitor.is_master_degraded():
+                self.monitor.reset()
+                self.replica.internal_bus.send(
+                    VoteForViewChange(suspicion="MASTER_DEGRADED"))
+        self._degradation_timer = RepeatingTimer(
+            timer, self.config.ThroughputWindowSize,
+            _check_master_degraded)
+
+        # ---- catchup (leecher + seeder)
+        from plenum_tpu.common.messages.internal_messages import (
+            NeedMasterCatchup)
+        from plenum_tpu.server.catchup import (
+            NodeLeecherService, SeederService)
+        self.seeder = SeederService(self.db_manager, network, name=name)
+        self.leecher = NodeLeecherService(
+            self.db_manager, network, timer,
+            quorums_source=lambda: self.replica.data.quorums,
+            on_catchup_txn=self._on_catchup_txn,
+            on_finished=self._on_catchup_finished,
+            config=self.config, name=name)
+        self.replica.internal_bus.subscribe(
+            NeedMasterCatchup, lambda msg: self.start_catchup())
+        self.mode_participating = True
 
         # ---- genesis
         if genesis_txns:
@@ -233,6 +274,7 @@ class Node:
         self._reply_to_client(client_id, RequestAck(
             identifier=request.identifier or "unknown",
             reqId=request.reqId or 0))
+        self.monitor.request_received(request.key)
         self.propagator.propagate(request, client_id)
 
     def _process_read(self, request: Request, client_id: str):
@@ -275,6 +317,8 @@ class Node:
                     payload_digest.encode(),
                     "{}:{}".format(ordered.ledgerId, seq_no).encode())
             digest = get_digest(txn)
+            if digest:
+                self.monitor.request_ordered(digest, ordered.instId)
             client_id = self._req_clients.pop(digest, None)
             if client_id is not None:
                 result = dict(txn)
@@ -299,6 +343,66 @@ class Node:
         result = dict(txn)
         result.update(ledger.merkleInfo(int(seq_no)))
         return Reply(result=result)
+
+    # ========================================================== catchup
+
+    def start_catchup(self):
+        """Stop participating, sync every ledger from peers, then resume
+        (reference node.py:2610 start_catchup + §3.4)."""
+        if self.leecher.in_progress:
+            return
+        logger.info("%s starting catchup", self.name)
+        self.mode_participating = False
+        self.replica.data.node_mode_participating = False
+        self.leecher.start()
+
+    def _on_catchup_txn(self, ledger_id: int, txn: dict):
+        """Apply one caught-up txn: ledger append + state update
+        (reference postTxnFromCatchupAddedToLedger node.py:1748)."""
+        from plenum_tpu.common.txn_util import get_payload_digest, get_type
+        ledger = self.db_manager.get_ledger(ledger_id)
+        ledger.add(dict(txn))
+        txn_type = get_type(txn)
+        handler = self.write_manager.request_handlers.get(txn_type)
+        if handler is not None and handler.state is not None \
+                and handler.ledger_id == ledger_id:
+            handler.update_state(txn, None, None, is_committed=True)
+            handler.state.commit()
+        payload_digest = get_payload_digest(txn)
+        if payload_digest:
+            seq_no = get_seq_no(txn)
+            self.seq_no_db.put(payload_digest.encode(),
+                               "{}:{}".format(ledger_id, seq_no).encode())
+
+    def _on_catchup_finished(self):
+        """Adopt 3PC position from the audit ledger, resume participating
+        (reference allLedgersCaughtUp node.py:1790)."""
+        audit = self.db_manager.get_ledger(AUDIT_LEDGER_ID)
+        last_audit = audit.get_last_txn()
+        if last_audit is not None:
+            data = get_payload_data(last_audit)
+            view_no = data.get("viewNo", 0)
+            pp_seq_no = data.get("ppSeqNo", 0)
+            current = self.replica.data.last_ordered_3pc
+            if (view_no, pp_seq_no) > current:
+                self.replica.data.last_ordered_3pc = (view_no, pp_seq_no)
+                self.replica.data.view_no = view_no
+                self.replica.ordering.lastPrePrepareSeqNo = pp_seq_no
+                self.replica.ordering._last_applied_seq = pp_seq_no
+                self.replica.checkpointer.caught_up_till_3pc(
+                    (view_no, pp_seq_no))
+                # primary for the adopted view
+                from plenum_tpu.consensus.primary_selector import (
+                    RoundRobinConstantNodesPrimariesSelector)
+                selector = RoundRobinConstantNodesPrimariesSelector(
+                    self.replica.data.validators)
+                self.replica.data.primary_name = \
+                    selector.select_master_primary(view_no)
+        self.mode_participating = True
+        self.replica.data.node_mode_participating = True
+        self.replica.ordering.on_catchup_finished()
+        logger.info("%s catchup finished; last_ordered=%s", self.name,
+                    self.replica.data.last_ordered_3pc)
 
     # ========================================================== helpers
 

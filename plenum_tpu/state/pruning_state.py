@@ -83,10 +83,12 @@ class PruningState(State):
 
     def commit(self, rootHash: Optional[bytes] = None):
         """Advance the committed head (to `rootHash` if given — must be a
-        root previously produced by apply — else to the current head)."""
+        root previously produced by apply — else to the current head).
+        The working head is NOT moved: later uncommitted batches may
+        already be staged on top of the committed prefix (3PC pipelines
+        several batches in flight)."""
         root = rootHash if rootHash is not None else self._trie.root_hash
         self._committed_root = root
-        self._trie.root_hash = root
         self._kv.put(self.rootHashKey, root)
 
     def revertToHead(self, headHash: bytes):

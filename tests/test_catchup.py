@@ -1,0 +1,146 @@
+"""Catchup tests: a lagging/new node syncs every ledger from peers and
+resumes ordering at the pool's 3PC position (SURVEY.md §3.4).
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.mock_timer import MockTimer
+from plenum_tpu.testing.sim_network import Discard, SimNetwork
+
+SIM_EPOCH = 1600000000
+NAMES = ["A1", "B2", "C3", "D4"]
+
+
+def make_pool(timer, net, conf):
+    return [Node(n, NAMES, timer, net.create_peer(n), config=conf)
+            for n in NAMES]
+
+
+def pump(timer, nodes, seconds=5.0, step=0.05):
+    end = timer.get_current_time() + seconds
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(step)
+
+
+def nym_req(i):
+    signer = SimpleSigner(seed=bytes([i + 1]) * 32)
+    req = {"identifier": signer.identifier, "reqId": i, "protocolVersion": 2,
+           "operation": {"type": NYM, TARGET_NYM: signer.identifier,
+                         VERKEY: signer.verkey}}
+    req["signature"] = signer.sign(dict(req))
+    return req
+
+
+def test_lagging_node_catches_up(mock_timer):
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(55))
+    conf = Config(Max3PCBatchSize=1, Max3PCBatchWait=0.05, CHK_FREQ=100,
+                  LOG_SIZE=300, CATCHUP_TXN_TIMEOUT=2)
+    nodes = make_pool(mock_timer, net, conf)
+    laggard = nodes[3]
+    # cut D4 off entirely
+    cut_in = Discard(DefaultSimRandom(0), probability=1.1, dst=["D4"])
+    cut_out = Discard(DefaultSimRandom(0), probability=1.1, frm=["D4"])
+    net.add_processor(cut_in)
+    net.add_processor(cut_out)
+    for i in range(5):
+        for n in nodes[:3]:
+            n.process_client_request(nym_req(i), "cli")
+    pump(mock_timer, nodes, 25)
+    assert all(n.last_ordered[1] == 5 for n in nodes[:3])
+    assert laggard.last_ordered[1] == 0
+    assert laggard.domain_ledger.size == 0
+    # reconnect and catch up
+    net.remove_processor(cut_in)
+    net.remove_processor(cut_out)
+    laggard.start_catchup()
+    pump(mock_timer, nodes, 25)
+    assert not laggard.leecher.in_progress
+    assert laggard.domain_ledger.size == 5
+    assert laggard.domain_ledger.root_hash == nodes[0].domain_ledger.root_hash
+    assert laggard.audit_ledger.size == 5
+    # 3PC position adopted from the audit ledger
+    assert laggard.last_ordered == nodes[0].last_ordered
+    # state rebuilt: verkeys present
+    from plenum_tpu.server.request_handlers import (
+        decode_state_value, nym_to_state_key)
+    handler = laggard.write_manager.request_handlers[NYM]
+    signer = SimpleSigner(seed=bytes([1]) * 32)
+    val, _, _ = decode_state_value(handler.state.get(
+        nym_to_state_key(signer.identifier), isCommitted=True))
+    assert val is not None and val[VERKEY] == signer.verkey
+    # state root matches the pool
+    peer_handler = nodes[0].write_manager.request_handlers[NYM]
+    assert handler.state.committedHeadHash == \
+        peer_handler.state.committedHeadHash
+
+
+def test_caught_up_node_resumes_ordering(mock_timer):
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(56))
+    conf = Config(Max3PCBatchSize=1, Max3PCBatchWait=0.05, CHK_FREQ=100,
+                  LOG_SIZE=300, CATCHUP_TXN_TIMEOUT=2)
+    nodes = make_pool(mock_timer, net, conf)
+    cut_in = Discard(DefaultSimRandom(0), probability=1.1, dst=["D4"])
+    cut_out = Discard(DefaultSimRandom(0), probability=1.1, frm=["D4"])
+    net.add_processor(cut_in)
+    net.add_processor(cut_out)
+    for i in range(3):
+        for n in nodes[:3]:
+            n.process_client_request(nym_req(i), "cli")
+    pump(mock_timer, nodes, 20)
+    net.remove_processor(cut_in)
+    net.remove_processor(cut_out)
+    nodes[3].start_catchup()
+    pump(mock_timer, nodes, 25)
+    assert nodes[3].last_ordered[1] == 3
+    # new traffic after catchup: the recovered node orders it too
+    for i in range(3, 6):
+        for n in nodes:
+            n.process_client_request(nym_req(i), "cli")
+    pump(mock_timer, nodes, 25)
+    assert all(n.last_ordered[1] == 6 for n in nodes), \
+        [(n.name, n.last_ordered) for n in nodes]
+    assert len({n.domain_ledger.root_hash for n in nodes}) == 1
+
+
+def test_catchup_rejects_corrupt_reps(mock_timer):
+    """A byzantine seeder feeding wrong txns cannot corrupt the ledger —
+    the quorum-agreed root check rejects the whole range."""
+    from plenum_tpu.common.messages.node_messages import CatchupRep
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(57))
+    conf = Config(Max3PCBatchSize=1, Max3PCBatchWait=0.05,
+                  CATCHUP_TXN_TIMEOUT=2)
+    nodes = make_pool(mock_timer, net, conf)
+    cut_in = Discard(DefaultSimRandom(0), probability=1.1, dst=["D4"])
+    cut_out = Discard(DefaultSimRandom(0), probability=1.1, frm=["D4"])
+    net.add_processor(cut_in)
+    net.add_processor(cut_out)
+    for i in range(3):
+        for n in nodes[:3]:
+            n.process_client_request(nym_req(i), "cli")
+    pump(mock_timer, nodes, 20)
+    net.remove_processor(cut_in)
+    net.remove_processor(cut_out)
+    laggard = nodes[3]
+    laggard.start_catchup()
+    pump(mock_timer, nodes, 3)
+    # inject a corrupt rep claiming different txns for the domain ledger
+    fake_txns = {str(i): {"txn": {"type": NYM, "data": {"dest": "evil"},
+                                  "metadata": {}},
+                          "txnMetadata": {"seqNo": i}, "reqSignature": {},
+                          "ver": "1"}
+                 for i in range(1, 4)}
+    laggard.network.process_incoming(
+        CatchupRep(ledgerId=1, txns=fake_txns, consProof=[]), "B2")
+    pump(mock_timer, nodes, 25)
+    # catchup still completes correctly despite the poison
+    assert laggard.domain_ledger.size == 3
+    assert laggard.domain_ledger.root_hash == nodes[0].domain_ledger.root_hash

@@ -1,0 +1,81 @@
+"""Monitor + primary-connection failure detection tests."""
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.messages.internal_messages import VoteForViewChange
+from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
+from plenum_tpu.runtime.bus import ExternalBus, InternalBus
+from plenum_tpu.server.monitor import (
+    EMAThroughputMeasurement, Monitor, PrimaryConnectionMonitorService,
+    RevivalSpikeResistantEMAThroughputMeasurement)
+from plenum_tpu.testing.mock_timer import MockTimer
+
+
+def test_ema_throughput_converges():
+    ema = EMAThroughputMeasurement(window_size=10, first_ts=0)
+    for ts in range(0, 1000):
+        ema.add_request(ts)  # 1 req/sec steady
+    t = ema.get_throughput(1000)
+    assert 0.8 < t <= 1.01
+
+
+def test_revival_spike_suppressed():
+    normal = EMAThroughputMeasurement(window_size=10, first_ts=0)
+    resistant = RevivalSpikeResistantEMAThroughputMeasurement(
+        window_size=10, first_ts=0)
+    # steady load, long idle gap, then a burst
+    for ts in range(0, 300):
+        normal.add_request(ts)
+        resistant.add_request(ts)
+    for ts in range(600, 620):
+        for _ in range(50):  # backlog burst
+            normal.add_request(ts)
+            resistant.add_request(ts)
+    assert resistant.get_throughput(640) < normal.get_throughput(640)
+
+
+def test_monitor_latency_degradation():
+    timer = MockTimer(1000)
+    conf = Config(LAMBDA=60)
+    m = Monitor("N1", timer, InternalBus(), config=conf)
+    m.request_received("d1")
+    assert not m.is_master_degraded()
+    timer.set_time(1070)  # d1 stuck for 70s > Λ
+    assert m.is_master_degraded()
+    m.request_ordered("d1")
+    assert not m.is_master_degraded()
+
+
+def test_monitor_throughput_ratio():
+    timer = MockTimer(0)
+    m = Monitor("N1", timer, InternalBus(),
+                config=Config(ThroughputWindowSize=10, DELTA=0.5))
+    # backup instance 1 orders fast; master slow
+    for ts in range(0, 500):
+        timer.set_time(ts)
+        m.request_ordered("b%d" % ts, inst_id=1)
+        if ts % 10 == 0:
+            m.request_received("m%d" % ts)
+            m.request_ordered("m%d" % ts, inst_id=0)
+    timer.set_time(500)
+    ratio = m.instance_throughput_ratio(0)
+    assert ratio is not None and ratio < 0.5
+    assert m.is_master_degraded()
+
+
+def test_primary_disconnection_votes_view_change():
+    timer = MockTimer(0)
+    bus = InternalBus()
+    votes = []
+    bus.subscribe(VoteForViewChange, lambda msg: votes.append(msg))
+    network = ExternalBus(send_handler=lambda m, d=None: None)
+    data = ConsensusSharedData("N2", ["N1", "N2", "N3", "N4"], 0)
+    data.primary_name = "N1"
+    conf = Config(ToleratePrimaryDisconnection=10)
+    svc = PrimaryConnectionMonitorService(data, timer, bus, network,
+                                          config=conf)
+    network.update_connecteds({"N1", "N3", "N4"})
+    network.update_connecteds({"N3", "N4"})  # primary drops
+    timer.run_for(5)
+    assert not votes
+    timer.run_for(10)
+    assert votes, "expected a view-change vote after tolerance elapsed"
+    svc.stop()
