@@ -137,10 +137,13 @@ class CheckpointService:
             self.set_watermarks(stable_checkpoint)
 
     def caught_up_till_3pc(self, last_3pc: Tuple[int, int]):
-        """Catchup completed: fast-forward watermarks to the caught-up
-        position (reference checkpoint_service caught_up_till_3pc)."""
+        """Catchup completed: fast-forward watermarks to the EXACT
+        caught-up position (reference checkpoint_service
+        caught_up_till_3pc / update_watermark_from_3pc).  Rounding down
+        to a CHK_FREQ multiple would leave a window of already-ordered
+        seq nos in which replayed PrePrepares re-apply, fail root
+        comparison, and raise spurious suspicions against the primary."""
         seq = last_3pc[1]
-        stable = (seq // self._chk_freq) * self._chk_freq
-        self._data.stable_checkpoint = stable
-        self.set_watermarks(stable)
+        self._data.stable_checkpoint = seq
+        self.set_watermarks(seq)
         self._own.clear()

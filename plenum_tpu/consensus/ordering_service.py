@@ -76,8 +76,11 @@ class BatchExecutor(ABC):
 
     @abstractmethod
     def apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
-                    pp_time: int) -> Tuple[str, str, str]:
+                    pp_time: int, pp_digest: str = "") -> Tuple[str, str, str]:
         """Apply finalized requests (by digest) as one uncommitted batch.
+        ``pp_digest`` is the PrePrepare digest binding the batch content —
+        known to the ordering service at apply time, recorded in the audit
+        txn for recovery/audit provenance.
         → (state_root_b58, txn_root_b58, audit_root_b58)."""
 
     @abstractmethod
@@ -107,7 +110,7 @@ class SimExecutor(BatchExecutor):
         self.applied: List[Tuple] = []
         self.committed: List[Ordered] = []
 
-    def apply_batch(self, digests, ledger_id, pp_time):
+    def apply_batch(self, digests, ledger_id, pp_time, pp_digest=""):
         from plenum_tpu.common.serializers.base58 import b58encode
         base = self.applied[-1][0] if self.applied else self.committed_root
         h = hashlib.sha256(
@@ -252,8 +255,9 @@ class OrderingService:
             digests.append(d)
         pp_seq_no = self.lastPrePrepareSeqNo + 1
         pp_time = self._get_time()
+        pp_digest = self.generate_pp_digest(digests, self.view_no, pp_time)
         state_root, txn_root, audit_root = self._executor.apply_batch(
-            digests, ledger_id, pp_time)
+            digests, ledger_id, pp_time, pp_digest)
         params = dict(
             instId=self._data.inst_id,
             viewNo=self.view_no,
@@ -261,7 +265,7 @@ class OrderingService:
             ppTime=pp_time,
             reqIdr=digests,
             discarded="0",
-            digest=self.generate_pp_digest(digests, self.view_no, pp_time),
+            digest=pp_digest,
             ledgerId=ledger_id,
             stateRootHash=state_root,
             txnRootHash=txn_root,
@@ -351,7 +355,7 @@ class OrderingService:
         # apply and compare roots (only the master executes batches)
         if self.is_master:
             state_root, txn_root, audit_root = self._executor.apply_batch(
-                list(pp.reqIdr), pp.ledgerId, pp.ppTime)
+                list(pp.reqIdr), pp.ledgerId, pp.ppTime, pp.digest)
             if pp.stateRootHash is not None and state_root != pp.stateRootHash:
                 self._executor.revert_last_batch()
                 self._raise_suspicion(frm, Suspicions.PPR_STATE_WRONG,
@@ -635,7 +639,8 @@ class OrderingService:
 
     def _reapply_ready_batches(self):
         """Re-apply pending new-view batches in sequence, stopping at the
-        first one whose old-view PrePrepare we still lack."""
+        first one whose old-view PrePrepare we still lack (or that fails
+        validation and must be re-fetched from another node)."""
         for bid in sorted(self._new_view_bids_to_reorder,
                           key=lambda b: b.pp_seq_no):
             if (self.view_no, bid.pp_seq_no) in self.prePrepares:
@@ -644,21 +649,46 @@ class OrderingService:
                 (bid.pp_view_no, bid.pp_seq_no, bid.pp_digest))
             if pp is None:
                 break  # wait for OldViewPrePrepareReply
-            self._reapply_old_view_preprepare(bid, pp)
+            if not self._reapply_old_view_preprepare(bid, pp):
+                break  # bad stored PP dropped; wait for a fresh reply
 
-    def _reapply_old_view_preprepare(self, bid: BatchID, old_pp: PrePrepare):
+    def _reapply_old_view_preprepare(self, bid: BatchID,
+                                     old_pp: PrePrepare) -> bool:
+        """Re-apply one old-view PrePrepare chosen by the NEW_VIEW.
+
+        Replies to OldViewPrePrepareRequest come from untrusted peers, so
+        the PP gets the same content defenses as process_preprepare
+        (reference routes these through the full processing path): the
+        digest must be recomputable from the content, and on the master
+        the apply result must reproduce the PP's claimed roots.  A forged
+        PP whose digest field merely matches the NEW_VIEW BatchID is
+        dropped and re-requested from the other nodes."""
+        if old_pp.digest != self.generate_pp_digest(
+                list(old_pp.reqIdr), bid.pp_view_no, old_pp.ppTime):
+            self._discard_bad_old_view_pp(bid, "digest mismatch")
+            return False
         params = dict(old_pp.as_dict())
         params["viewNo"] = self.view_no
         params["originalViewNo"] = bid.pp_view_no
         pp = PrePrepare(**params)
         key = (pp.viewNo, pp.ppSeqNo)
+        if self.is_master:
+            if pp.stateRootHash is None or pp.txnRootHash is None:
+                self._discard_bad_old_view_pp(bid, "missing root hashes")
+                return False
+            state_root, txn_root, audit_root = self._executor.apply_batch(
+                list(pp.reqIdr), pp.ledgerId, pp.ppTime, pp.digest)
+            if (state_root != pp.stateRootHash
+                    or txn_root != pp.txnRootHash
+                    or (pp.auditTxnRootHash is not None
+                        and audit_root != pp.auditTxnRootHash)):
+                self._executor.revert_last_batch()
+                self._discard_bad_old_view_pp(bid, "root mismatch")
+                return False
+            self._last_applied_seq = pp.ppSeqNo
         self.prePrepares[key] = pp
         self.batches[key] = pp
         self.lastPrePrepareSeqNo = max(self.lastPrePrepareSeqNo, pp.ppSeqNo)
-        if self.is_master:
-            self._executor.apply_batch(list(pp.reqIdr), pp.ledgerId,
-                                       pp.ppTime)
-            self._last_applied_seq = pp.ppSeqNo
         self._consume_from_queue(pp)
         self._add_to_preprepared(pp)
         if self._is_primary():
@@ -667,6 +697,16 @@ class OrderingService:
             self._try_prepared(pp)
         else:
             self._send_prepare(pp)
+        return True
+
+    def _discard_bad_old_view_pp(self, bid: BatchID, reason: str):
+        """Drop a stored old-view PP that failed re-validation and ask the
+        rest of the pool for the real one."""
+        self.old_view_preprepares.pop(
+            (bid.pp_view_no, bid.pp_seq_no, bid.pp_digest), None)
+        req = OldViewPrePrepareRequest(
+            instId=self._data.inst_id, batch_ids=[list(bid)])
+        self._network.send(req)
 
     def process_old_view_preprepare_request(
             self, msg: OldViewPrePrepareRequest, frm: str):

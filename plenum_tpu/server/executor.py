@@ -48,7 +48,7 @@ class NodeBatchExecutor(BatchExecutor):
     # -------------------------------------------------------------- apply
 
     def apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
-                    pp_time: int) -> Tuple[str, str, str]:
+                    pp_time: int, pp_digest: str = "") -> Tuple[str, str, str]:
         ledger = self.db.get_ledger(ledger_id)
         state = self.db.get_state(ledger_id)
         valid = []
@@ -80,7 +80,7 @@ class NodeBatchExecutor(BatchExecutor):
             state_root=state_root,
             txn_root=txn_root,
             valid_digests=valid,
-            pp_digest="",
+            pp_digest=pp_digest,
             primaries=self._get_primaries(),
         )
         self.write_manager.post_apply_batch(batch)
@@ -113,7 +113,11 @@ class NodeBatchExecutor(BatchExecutor):
                            (ordered.viewNo, ordered.ppSeqNo))
             return
         batch = self._staged.pop(0)
-        batch.pp_digest = ordered.digest or ""
+        if batch.pp_digest and ordered.digest and \
+                batch.pp_digest != ordered.digest:
+            logger.warning("ordered digest %s != staged batch digest %s at %s",
+                           ordered.digest, batch.pp_digest,
+                           (ordered.viewNo, ordered.ppSeqNo))
         committed = self.write_manager.commit_batch(batch)
         # free ordered requests from the in-flight store
         if self._on_batch_committed is not None:

@@ -125,6 +125,14 @@ class NymHandler(WriteRequestHandler):
                 raise UnauthorizedClientRequest(
                     request.identifier, request.reqId,
                     "only the owner can rotate a verkey")
+            # role edits (promotion AND demotion) need a TRUSTEE author —
+            # otherwise any authenticated client could grant itself
+            # TRUSTEE (reference nym_handler dynamic auth rules)
+            if ROLE in op and op.get(ROLE) != existing.get(ROLE):
+                if self._author_role(request) != TRUSTEE:
+                    raise UnauthorizedClientRequest(
+                        request.identifier, request.reqId,
+                        "only TRUSTEE can change a nym's role")
 
     def _author_role(self, request: Request):
         if request.identifier is None:
@@ -183,19 +191,56 @@ class NodeHandler(WriteRequestHandler):
         existing, _, _ = decode_state_value(self.state.get(
             nym_to_state_key(op[TARGET_NYM]), isCommitted=False))
         data = op.get(DATA, {})
+        author_role = self._author_role(request)
         if existing is None:
-            # new node: alias must be unique
+            # new node: author must be a steward (reference node_handler
+            # auth: pool membership writes are steward-gated), one node
+            # per steward, alias must be unique
+            if author_role not in (STEWARD, TRUSTEE):
+                raise UnauthorizedClientRequest(
+                    request.identifier, request.reqId,
+                    "only a STEWARD or TRUSTEE may add a node")
+            if author_role == STEWARD and self._steward_owns_node(
+                    request.identifier):
+                raise UnauthorizedClientRequest(
+                    request.identifier, request.reqId,
+                    "steward already has a node")
             aliases = self._committed_aliases()
             if data.get("alias") in aliases:
                 raise InvalidClientRequest(
                     request.identifier, request.reqId,
                     "node alias {} already taken".format(data.get("alias")))
         else:
+            # edits: only the owning steward or a TRUSTEE
+            if author_role != TRUSTEE and \
+                    request.identifier != existing.get("identifier"):
+                raise UnauthorizedClientRequest(
+                    request.identifier, request.reqId,
+                    "only the node's steward or a TRUSTEE may edit it")
             if data.get("alias") and \
                     data["alias"] != existing.get("alias"):
                 raise InvalidClientRequest(
                     request.identifier, request.reqId,
                     "node alias cannot change")
+
+    def _author_role(self, request: Request):
+        """Author roles live in the DOMAIN state (nym registry)."""
+        if request.identifier is None:
+            return None
+        domain_state = self.database_manager.get_state(DOMAIN_LEDGER_ID)
+        if domain_state is None:
+            return None
+        val, _, _ = decode_state_value(domain_state.get(
+            nym_to_state_key(request.identifier), isCommitted=False))
+        return (val or {}).get(ROLE)
+
+    def _steward_owns_node(self, steward_nym: str) -> bool:
+        for key, value in self.state.head.items():
+            val, _, _ = decode_state_value(value)
+            if isinstance(val, dict) and \
+                    val.get("identifier") == steward_nym:
+                return True
+        return False
 
     def _committed_aliases(self):
         aliases = set()
@@ -213,6 +258,8 @@ class NodeHandler(WriteRequestHandler):
             self.state.get(nym_to_state_key(nym), isCommitted=False))
         value = dict(existing or {})
         value.update(data.get(DATA, {}))
+        # record the owning steward on creation (edit authorization key)
+        value.setdefault("identifier", get_from(txn))
         self.state.set(nym_to_state_key(nym),
                        encode_state_value(value, get_seq_no(txn),
                                           get_txn_time(txn)))

@@ -1,0 +1,233 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. old-view PrePrepares fetched after a view change get full content
+   validation (digest recompute + root comparison) before re-apply;
+2. NYM role edits are TRUSTEE-gated and NODE txns steward-gated;
+3. caught_up_till_3pc sets the watermark to the exact caught-up seq;
+4. the audit txn records the PrePrepare digest (not "").
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    AUDIT_LEDGER_ID, DATA, DOMAIN_LEDGER_ID, NODE, NYM, ROLE, STEWARD,
+    TARGET_NYM, TRUSTEE, TXN_TYPE, VERKEY)
+from plenum_tpu.common.exceptions import UnauthorizedClientRequest
+from plenum_tpu.common.request import Request
+from plenum_tpu.common.txn_util import get_payload_data
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.testing.sim_network import SimNetwork
+
+SIM_EPOCH = 1600000000
+
+
+def _fake_root():
+    from plenum_tpu.common.serializers.base58 import b58encode
+    return b58encode(b"\x01" * 32)
+
+
+# ------------------------------------------------ old-view PP validation
+
+def _reorder_fixture(mock_timer):
+    from tests.test_consensus import make_pool
+    net = SimNetwork(mock_timer, DefaultSimRandom(41))
+    pool = make_pool(4, mock_timer, net,
+                     Config(Max3PCBatchSize=1, Max3PCBatchWait=0.01,
+                            CHK_FREQ=10, LOG_SIZE=30))
+    return pool
+
+
+def test_forged_old_view_pp_digest_rejected(mock_timer):
+    """A stored old-view PP whose digest is not recomputable from its
+    content is dropped and re-requested, never applied."""
+    from plenum_tpu.common.messages.node_messages import PrePrepare
+    from plenum_tpu.consensus.batch_id import BatchID
+    from plenum_tpu.consensus.ordering_service import OrderingService
+    pool = _reorder_fixture(mock_timer)
+    r = pool[1]
+    svc = r.ordering
+    now = int(mock_timer.get_current_time())
+    good_digest = OrderingService.generate_pp_digest(["real-req"], 0, now)
+    # forged: digest field matches the NEW_VIEW BatchID but reqIdr differs
+    forged = PrePrepare(
+        instId=0, viewNo=0, ppSeqNo=1, ppTime=now,
+        reqIdr=["evil-req"], discarded="0", digest=good_digest,
+        ledgerId=DOMAIN_LEDGER_ID, stateRootHash=None, txnRootHash=None,
+        sub_seq_no=0, final=False)
+    bid = BatchID(1, 0, 1, good_digest)
+    svc.old_view_preprepares[(0, 1, good_digest)] = forged
+    ok = svc._reapply_old_view_preprepare(bid, forged)
+    assert ok is False
+    assert (0, 1, good_digest) not in svc.old_view_preprepares
+    assert (svc.view_no, 1) not in svc.prePrepares
+
+
+def test_forged_old_view_pp_roots_rejected(mock_timer):
+    """A content-consistent old-view PP whose claimed roots don't match
+    the apply result is reverted and dropped on the master."""
+    from plenum_tpu.common.messages.node_messages import PrePrepare
+    from plenum_tpu.consensus.batch_id import BatchID
+    from plenum_tpu.consensus.ordering_service import OrderingService
+    pool = _reorder_fixture(mock_timer)
+    r = pool[1]
+    svc = r.ordering
+    for rep in pool:
+        rep.submit_request("real-req")
+    now = int(mock_timer.get_current_time())
+    digest = OrderingService.generate_pp_digest(["real-req"], 0, now)
+    forged = PrePrepare(
+        instId=0, viewNo=0, ppSeqNo=1, ppTime=now,
+        reqIdr=["real-req"], discarded="0", digest=digest,
+        ledgerId=DOMAIN_LEDGER_ID,
+        stateRootHash=_fake_root(), txnRootHash=_fake_root(),
+        sub_seq_no=0, final=False)
+    bid = BatchID(1, 0, 1, digest)
+    svc.old_view_preprepares[(0, 1, digest)] = forged
+    applied_before = len(svc._executor.applied)
+    ok = svc._reapply_old_view_preprepare(bid, forged)
+    assert ok is False
+    assert len(svc._executor.applied) == applied_before  # reverted
+    assert (0, 1, digest) not in svc.old_view_preprepares
+
+
+# -------------------------------------------------------- handler authz
+
+@pytest.fixture
+def managers():
+    from plenum_tpu.server.node import NodeBootstrap
+    dm = NodeBootstrap.init_storage()
+    wm, rm = NodeBootstrap.init_managers(dm)
+    return dm, wm
+
+
+def _write_nym(dm, nym, role=None, identifier=None):
+    """Seed a nym directly into domain state (genesis-style)."""
+    from plenum_tpu.server.request_handlers import (
+        encode_state_value, nym_to_state_key)
+    state = dm.get_state(DOMAIN_LEDGER_ID)
+    value = {"identifier": identifier or nym}
+    if role is not None:
+        value[ROLE] = role
+    state.set(nym_to_state_key(nym), encode_state_value(value, 1, SIM_EPOCH))
+
+
+def _nym_req(author, target, role=None, verkey=None):
+    op = {TXN_TYPE: NYM, TARGET_NYM: target}
+    if role is not None:
+        op[ROLE] = role
+    if verkey is not None:
+        op[VERKEY] = verkey
+    return Request(identifier=author, reqId=1, operation=op)
+
+
+def _node_req(author, target, alias):
+    return Request(identifier=author, reqId=1, operation={
+        TXN_TYPE: NODE, TARGET_NYM: target, DATA: {"alias": alias}})
+
+
+def test_role_change_requires_trustee(managers):
+    dm, wm = managers
+    _write_nym(dm, "trustee1", role=TRUSTEE)
+    _write_nym(dm, "plainuser")
+    _write_nym(dm, "victim")
+    nym_handler = wm.request_handlers[NYM]
+    # any authenticated client promoting an existing nym must be rejected
+    with pytest.raises(UnauthorizedClientRequest):
+        nym_handler.dynamic_validation(
+            _nym_req("plainuser", "victim", role=TRUSTEE))
+    # self-promotion too
+    with pytest.raises(UnauthorizedClientRequest):
+        nym_handler.dynamic_validation(
+            _nym_req("plainuser", "plainuser", role=TRUSTEE))
+    # a TRUSTEE may promote and demote
+    nym_handler.dynamic_validation(_nym_req("trustee1", "victim",
+                                            role=STEWARD))
+    _write_nym(dm, "steward1", role=STEWARD)
+    nym_handler.dynamic_validation(_nym_req("trustee1", "steward1",
+                                            role=None))
+
+
+def test_verkey_rotation_still_owner_only(managers):
+    dm, wm = managers
+    _write_nym(dm, "owner")
+    _write_nym(dm, "other")
+    nym_handler = wm.request_handlers[NYM]
+    with pytest.raises(UnauthorizedClientRequest):
+        nym_handler.dynamic_validation(
+            _nym_req("other", "owner", verkey="X" * 32))
+    nym_handler.dynamic_validation(_nym_req("owner", "owner",
+                                            verkey="X" * 32))
+
+
+def test_node_txn_requires_steward(managers):
+    dm, wm = managers
+    _write_nym(dm, "steward1", role=STEWARD)
+    _write_nym(dm, "plainuser")
+    node_handler = wm.request_handlers[NODE]
+    with pytest.raises(UnauthorizedClientRequest):
+        node_handler.dynamic_validation(
+            _node_req("plainuser", "nodedest1", "NewNode"))
+    node_handler.dynamic_validation(
+        _node_req("steward1", "nodedest1", "NewNode"))
+
+
+def test_one_node_per_steward_and_owner_gated_edits(managers):
+    dm, wm = managers
+    _write_nym(dm, "steward1", role=STEWARD)
+    _write_nym(dm, "steward2", role=STEWARD)
+    node_handler = wm.request_handlers[NODE]
+    # steward1 registers a node (apply via update_state, genesis-style)
+    req = _node_req("steward1", "nodedest1", "NodeA")
+    from plenum_tpu.common.txn_util import append_txn_metadata, reqToTxn
+    txn = append_txn_metadata(reqToTxn(req), txn_time=SIM_EPOCH)
+    node_handler.update_state(txn, None, req)
+    # a second node from the same steward is rejected
+    with pytest.raises(UnauthorizedClientRequest):
+        node_handler.dynamic_validation(
+            _node_req("steward1", "nodedest2", "NodeB"))
+    # edits by a different steward are rejected; by the owner accepted
+    with pytest.raises(UnauthorizedClientRequest):
+        node_handler.dynamic_validation(
+            _node_req("steward2", "nodedest1", "NodeA"))
+    node_handler.dynamic_validation(_node_req("steward1", "nodedest1",
+                                              "NodeA"))
+
+
+# ------------------------------------------------- checkpoint watermark
+
+def test_caught_up_till_3pc_exact_watermark(mock_timer):
+    from tests.test_consensus import make_pool
+    net = SimNetwork(mock_timer, DefaultSimRandom(43))
+    pool = make_pool(4, mock_timer, net,
+                     Config(CHK_FREQ=10, LOG_SIZE=30))
+    r = pool[0]
+    r.checkpointer.caught_up_till_3pc((0, 7))
+    assert r.data.stable_checkpoint == 7
+    assert r.data.low_watermark == 7
+
+
+# ----------------------------------------------------- audit txn digest
+
+def test_audit_txn_records_pp_digest(mock_timer):
+    from tests.test_node_e2e import (
+        NAMES, ClientSink, pump, signed_nym_request, submit_to_all)
+    from plenum_tpu.crypto.signer import SimpleSigner
+    from plenum_tpu.server.node import Node
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(77))
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15)
+    nodes = [Node(name, NAMES, mock_timer, net.create_peer(name),
+                  config=conf, client_reply_handler=ClientSink())
+             for name in NAMES]
+    client = SimpleSigner(seed=b"\x31" * 32)
+    submit_to_all(nodes, signed_nym_request(client))
+    pump(mock_timer, nodes, 8)
+    for n in nodes:
+        assert n.audit_ledger.size == 1
+        audit_txn = n.audit_ledger.getBySeqNo(1)
+        digest = get_payload_data(audit_txn)["digest"]
+        assert digest != ""
+        pp = n.replica.ordering.prePrepares.get((0, 1)) or \
+            n.replica.ordering.sent_preprepares.get((0, 1))
+        assert digest == pp.digest
