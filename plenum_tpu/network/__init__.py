@@ -1,0 +1,2 @@
+from plenum_tpu.network.keys import NodeKeys
+from plenum_tpu.network.stack import NodeStack, ClientStack, HA, RemoteInfo

@@ -1,0 +1,606 @@
+"""Authenticated TCP mesh transport — the ZStack equivalent.
+
+Reference: stp_zmq/zstack.py:52 (ZStack: ROUTER listener + per-remote
+DEALER sockets, CurveCP, ping/pong :750-794, quota-bounded service
+:481-605, 128KB limit), kit_zstack.py:28 (KITZStack registry-driven
+reconnects), plenum/common/batched.py:20,91 (per-remote outbox
+coalescing into signed Batch messages), plenum/common/stacks.py:30,167
+(NodeZStack / ClientZStack with client connection limits).
+
+Design (TPU-native build): asyncio TCP instead of libzmq. Each node runs
+one listener; for every registry peer it also dials an outgoing
+connection (the "DEALER"): application data is sent ONLY on the dialed
+connection, received ONLY on accepted ones — same directionality as the
+reference's DEALER→ROUTER flow, so either side can restart and the
+dialer's keep-in-touch loop re-establishes the link. Every connection is
+encrypted+authenticated by the SIGMA handshake in crypto_channel (the
+CurveZMQ stand-in); node listeners only accept registry verkeys, the
+client listener accepts anonymous initiators (request signatures still
+authenticate writes). Wire frames are 4-byte length-prefixed msgpack;
+outboxes coalesce per tick into Ed25519-signed BATCH envelopes; receive
+side is quota-bounded per service() call (backpressure for the
+single-threaded prod loop).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Set
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import OP_FIELD_NAME
+from plenum_tpu.common.serializers.base58 import b58decode, b58encode
+from plenum_tpu.common.serializers.serializers import MsgPackSerializer
+from plenum_tpu.network.crypto_channel import (
+    HandshakeError, Initiator, Responder, Session)
+from plenum_tpu.network.keys import NodeKeys
+
+logger = logging.getLogger(__name__)
+
+serializer = MsgPackSerializer()
+
+PING_OP = "ping_"
+PONG_OP = "pong_"
+BATCH_OP = "BATCH"
+
+
+class HA(NamedTuple):
+    host: str
+    port: int
+
+
+class RemoteInfo(NamedTuple):
+    name: str
+    ha: HA
+    verkey: bytes  # raw 32-byte ed25519 verkey
+
+
+class Connection:
+    """One established (handshaken) stream + its read loop."""
+
+    def __init__(self, reader, writer, session: Session, label: str):
+        self.reader = reader
+        self.writer = writer
+        self.session = session
+        self.label = label
+        self.last_seen = time.monotonic()
+        self.alive = True
+        self.bytes_in = 0
+        self.bytes_out = 0
+
+    def send_frame(self, payload: bytes):
+        data = self.session.encrypt(payload)
+        self.writer.write(len(data).to_bytes(4, "big") + data)
+        self.bytes_out += len(data) + 4
+
+    async def read_frame(self, limit: int) -> Optional[bytes]:
+        try:
+            hdr = await self.reader.readexactly(4)
+            n = int.from_bytes(hdr, "big")
+            if n > limit + 64:  # AEAD tag + slack
+                raise HandshakeError("oversized frame {}".format(n))
+            data = await self.reader.readexactly(n)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            return None
+        self.bytes_in += n + 4
+        self.last_seen = time.monotonic()
+        return self.session.decrypt(data)
+
+    def close(self):
+        self.alive = False
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+async def _handshake_frames(reader, writer, step_in: bool, payload=None,
+                            timeout: float = 10.0):
+    """Length-prefixed plaintext handshake frame IO."""
+    if payload is not None:
+        writer.write(len(payload).to_bytes(4, "big") + payload)
+        await writer.drain()
+    if step_in:
+        hdr = await asyncio.wait_for(reader.readexactly(4), timeout)
+        n = int.from_bytes(hdr, "big")
+        if n > 4096:
+            raise HandshakeError("oversized handshake frame")
+        return await asyncio.wait_for(reader.readexactly(n), timeout)
+    return None
+
+
+class Remote:
+    """Peer handle: registry entry + outgoing connection + outbox
+    (reference stp_zmq/remote.py)."""
+
+    def __init__(self, info: RemoteInfo):
+        self.info = info
+        self.conn: Optional[Connection] = None
+        self.outbox: deque = deque()
+        self.connecting = False
+        self.next_retry = 0.0
+        self.retry_count = 0
+        self.ping_sent_at = 0.0
+
+    @property
+    def name(self):
+        return self.info.name
+
+    @property
+    def is_connected(self) -> bool:
+        return self.conn is not None and self.conn.alive
+
+    def disconnect(self):
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+
+class StackBase:
+    """Shared listener + rx-queue machinery."""
+
+    def __init__(self, name: str, ha: HA, keys: NodeKeys,
+                 config: Optional[Config] = None):
+        self.name = name
+        self.ha = ha
+        self.keys = keys
+        self.config = config or Config()
+        self._server: Optional[asyncio.AbstractServer] = None
+        # decoded inbound messages: (msg_dict, frm_name)
+        self.rx: deque = deque()
+        self._tasks: Set[asyncio.Task] = set()
+        self.msg_len_limit = self.config.MSG_LEN_LIMIT
+
+    # ------------------------------------------------------------ server
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._on_accept, self.ha.host, self.ha.port)
+        if self.ha.port == 0:  # ephemeral: record the real port
+            self.ha = HA(self.ha.host,
+                         self._server.sockets[0].getsockname()[1])
+        logger.info("%s listening on %s:%d", self.name, *self.ha)
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        for t in list(self._tasks):
+            t.cancel()
+        self._tasks.clear()
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.get_event_loop().create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def _on_accept(self, reader, writer):
+        raise NotImplementedError
+
+    # --------------------------------------------------------- rx path
+
+    def _enqueue_wire(self, payload: bytes, frm: str):
+        """Decode one wire frame (possibly a BATCH) into rx entries."""
+        try:
+            msg = serializer.deserialize(payload)
+        except Exception:
+            logger.warning("%s: undecodable frame from %s", self.name, frm)
+            return
+        if not isinstance(msg, dict):
+            logger.warning("%s: non-dict frame from %s", self.name, frm)
+            return
+        self.rx.append((msg, frm))
+
+    def service(self, on_message: Callable[[dict, str], None],
+                quota: Optional[int] = None,
+                size_quota: Optional[int] = None) -> int:
+        """Drain up to quota inbound messages (reference zstack.py:481
+        quota-bounded service)."""
+        count = 0
+        size = 0
+        quota = quota if quota is not None else len(self.rx)
+        while self.rx and count < quota:
+            msg, frm = self.rx.popleft()
+            count += 1
+            size += len(str(msg))
+            try:
+                on_message(msg, frm)
+            except Exception:
+                logger.exception("%s: handler failed for msg from %s",
+                                 self.name, frm)
+            if size_quota is not None and size >= size_quota:
+                break
+        return count
+
+
+class NodeStack(StackBase):
+    """Inter-validator mesh: KIT reconnects + signed batching + liveness."""
+
+    def __init__(self, name: str, ha: HA, keys: NodeKeys,
+                 registry: Dict[str, RemoteInfo],
+                 config: Optional[Config] = None,
+                 on_connections_changed: Callable[[Set[str]], None] = None):
+        super().__init__(name, ha, keys, config)
+        self.remotes: Dict[str, Remote] = {}
+        self._vk_to_name: Dict[bytes, str] = {}
+        self._incoming: Dict[str, Connection] = {}
+        self._on_conns_changed = on_connections_changed or (lambda s: None)
+        self._last_connecteds: Set[str] = set()
+        for info in registry.values():
+            if info.name != self.name:
+                self.add_remote(info)
+
+    # ------------------------------------------------------- membership
+
+    def add_remote(self, info: RemoteInfo):
+        self.remotes[info.name] = Remote(info)
+        self._vk_to_name[info.verkey] = info.name
+
+    def remove_remote(self, name: str):
+        remote = self.remotes.pop(name, None)
+        if remote is not None:
+            self._vk_to_name.pop(remote.info.verkey, None)
+            remote.disconnect()
+        conn = self._incoming.pop(name, None)
+        if conn is not None:
+            conn.close()
+        self._emit_connecteds()
+
+    def update_remote(self, info: RemoteInfo):
+        """HA or key change from a pool NODE txn → reconnect."""
+        old = self.remotes.get(info.name)
+        if old is not None and old.info == info:
+            return
+        self.remove_remote(info.name)
+        self.add_remote(info)
+
+    @property
+    def connecteds(self) -> Set[str]:
+        return {n for n, r in self.remotes.items() if r.is_connected}
+
+    def _emit_connecteds(self):
+        conns = self.connecteds
+        if conns != self._last_connecteds:
+            self._last_connecteds = set(conns)
+            self._on_conns_changed(conns)
+
+    # -------------------------------------------------------- listener
+
+    async def _on_accept(self, reader, writer):
+        try:
+            responder = Responder(self.keys.sk,
+                                  allowed_vks=set(self._vk_to_name),
+                                  allow_anonymous=False)
+            m1 = await _handshake_frames(reader, writer, True)
+            m2 = responder.consume_message1(m1)
+            m3 = await _handshake_frames(reader, writer, True, payload=m2)
+            responder.consume_message3(m3)
+        except (HandshakeError, asyncio.TimeoutError, ConnectionError,
+                OSError, asyncio.IncompleteReadError) as e:
+            logger.info("%s: inbound handshake failed: %s", self.name, e)
+            writer.close()
+            return
+        frm = self._vk_to_name[responder.peer_verkey]
+        conn = Connection(reader, writer, responder.session(),
+                          "{}<-{}".format(self.name, frm))
+        old = self._incoming.get(frm)
+        if old is not None:
+            old.close()
+        self._incoming[frm] = conn
+        await self._read_loop(conn, frm)
+
+    async def _read_loop(self, conn: Connection, frm: str):
+        while conn.alive:
+            payload = await conn.read_frame(self.msg_len_limit)
+            if payload is None:
+                conn.close()
+                break
+            self._dispatch_frame(payload, frm, conn)
+        if self._incoming.get(frm) is conn:
+            del self._incoming[frm]
+
+    def _dispatch_frame(self, payload: bytes, frm: str, conn: Connection):
+        if payload == b"pi":
+            # liveness probe: answer on the same (incoming) stream
+            try:
+                conn.send_frame(b"po")
+            except Exception:
+                conn.close()
+            return
+        if payload == b"po":
+            remote = self.remotes.get(frm)
+            if remote is not None:
+                remote.ping_sent_at = 0.0
+            return
+        self._unpack_wire(payload, frm)
+
+    def _unpack_wire(self, payload: bytes, frm: str):
+        try:
+            msg = serializer.deserialize(payload)
+        except Exception:
+            logger.warning("%s: undecodable frame from %s", self.name, frm)
+            return
+        if not isinstance(msg, dict):
+            return
+        if msg.get(OP_FIELD_NAME) == BATCH_OP:
+            if not self._verify_batch_sig(msg, frm):
+                logger.warning("%s: bad batch signature from %s",
+                               self.name, frm)
+                return
+            for raw in msg.get("messages", []):
+                self._enqueue_wire(raw if isinstance(raw, bytes)
+                                   else bytes(raw), frm)
+            return
+        self.rx.append((msg, frm))
+
+    def _verify_batch_sig(self, batch: dict, frm: str) -> bool:
+        remote = self.remotes.get(frm)
+        if remote is None:
+            return False
+        sig = batch.get("signature")
+        if not sig:
+            return False
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey)
+        from cryptography.exceptions import InvalidSignature
+        content = b"".join(bytes(m) for m in batch.get("messages", []))
+        try:
+            Ed25519PublicKey.from_public_bytes(
+                remote.info.verkey).verify(b58decode(sig), content)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    # ---------------------------------------------------- KIT lifecycle
+
+    def service_lifecycle(self):
+        """Reconnects + heartbeats; call every prod tick (reference
+        keep_in_touch.py:36 serviceLifecycle)."""
+        now = time.monotonic()
+        for remote in self.remotes.values():
+            if remote.is_connected:
+                self._maybe_ping(remote, now)
+            elif not remote.connecting and now >= remote.next_retry:
+                remote.connecting = True
+                self._spawn(self._connect(remote))
+        self._emit_connecteds()
+
+    def _maybe_ping(self, remote: Remote, now: float):
+        if not self.config.ENABLE_HEARTBEATS:
+            return
+        conn = remote.conn
+        idle = now - conn.last_seen
+        if remote.ping_sent_at and \
+                now - remote.ping_sent_at > 2 * self.config.HEARTBEAT_FREQ:
+            logger.info("%s: %s unresponsive, dropping link",
+                        self.name, remote.name)
+            remote.disconnect()
+            remote.ping_sent_at = 0.0
+            return
+        if idle > self.config.HEARTBEAT_FREQ and not remote.ping_sent_at:
+            try:
+                conn.send_frame(b"pi")
+                remote.ping_sent_at = now
+            except Exception:
+                remote.disconnect()
+
+    async def _connect(self, remote: Remote):
+        try:
+            reader, writer = await asyncio.open_connection(
+                remote.info.ha.host, remote.info.ha.port)
+            initiator = Initiator(self.keys.sk,
+                                  expected_peer_vk=remote.info.verkey)
+            m2 = await _handshake_frames(reader, writer, True,
+                                         payload=initiator.message1())
+            m3 = initiator.consume_message2(m2)
+            await _handshake_frames(reader, writer, False, payload=m3)
+            conn = Connection(reader, writer, initiator.session(),
+                              "{}->{}".format(self.name, remote.name))
+            remote.conn = conn
+            remote.retry_count = 0
+            remote.ping_sent_at = 0.0
+            self._spawn(self._outgoing_read_loop(remote, conn))
+            logger.info("%s connected to %s", self.name, remote.name)
+            self._emit_connecteds()
+        except (HandshakeError, asyncio.TimeoutError, ConnectionError,
+                OSError, asyncio.IncompleteReadError) as e:
+            logger.debug("%s: connect to %s failed: %s",
+                         self.name, remote.name, e)
+            remote.retry_count += 1
+            backoff = min(self.config.RETRY_TIMEOUT_NOT_RESTRICTED,
+                          0.1 * (2 ** min(remote.retry_count, 6)))
+            remote.next_retry = time.monotonic() + backoff
+        finally:
+            remote.connecting = False
+
+    async def _outgoing_read_loop(self, remote: Remote, conn: Connection):
+        """The dialed link mostly carries our sends; inbound on it is
+        control traffic (pongs) or a peer answering on our link."""
+        while conn.alive:
+            payload = await conn.read_frame(self.msg_len_limit)
+            if payload is None:
+                conn.close()
+                break
+            self._dispatch_frame(payload, remote.name, conn)
+        if remote.conn is conn:
+            remote.conn = None
+            self._emit_connecteds()
+
+    # ---------------------------------------------------------- tx path
+
+    def send(self, msg_dict: dict, dst=None):
+        """Enqueue; dst None = broadcast, str or list of names."""
+        raw = serializer.serialize(msg_dict)
+        if len(raw) > self.msg_len_limit:
+            logger.warning("%s: dropping oversized %dB message",
+                           self.name, len(raw))
+            return
+        if dst is None:
+            dsts = list(self.remotes)
+        elif isinstance(dst, str):
+            dsts = [dst]
+        else:
+            dsts = list(dst)
+        for name in dsts:
+            remote = self.remotes.get(name)
+            if remote is None:
+                logger.info("%s: no remote %s", self.name, name)
+                continue
+            remote.outbox.append(raw)
+
+    def flush_outboxes(self):
+        """Coalesce each remote's outbox into signed BATCH frames
+        (reference batched.py:91 flushOutBoxes)."""
+        for remote in self.remotes.values():
+            if not remote.outbox:
+                continue
+            if not remote.is_connected:
+                # bound memory while disconnected
+                while len(remote.outbox) > 10000:
+                    remote.outbox.popleft()
+                continue
+            msgs = list(remote.outbox)
+            remote.outbox.clear()
+            try:
+                if len(msgs) == 1:
+                    remote.conn.send_frame(msgs[0])
+                else:
+                    for frame in self._make_batches(msgs):
+                        remote.conn.send_frame(frame)
+            except Exception:
+                logger.info("%s: send to %s failed; dropping link",
+                            self.name, remote.name)
+                remote.disconnect()
+                remote.outbox.extendleft(reversed(msgs))
+        self._emit_connecteds()
+
+    def _make_batches(self, msgs: List[bytes]) -> List[bytes]:
+        """Pack serialized messages into signed batches under the size
+        limit (reference prepare_batch.py splitting)."""
+        frames = []
+        group: List[bytes] = []
+        group_size = 0
+        budget = self.msg_len_limit - 512  # envelope overhead
+        for m in msgs:
+            if group and group_size + len(m) > budget:
+                frames.append(self._seal_batch(group))
+                group, group_size = [], 0
+            group.append(m)
+            group_size += len(m)
+        if group:
+            frames.append(self._seal_batch(group))
+        return frames
+
+    def _seal_batch(self, group: List[bytes]) -> bytes:
+        if len(group) == 1:
+            return group[0]
+        sig = b58encode(self.keys.sign(b"".join(group)))
+        return serializer.serialize({
+            OP_FIELD_NAME: BATCH_OP, "messages": group, "signature": sig})
+
+
+class ClientStack(StackBase):
+    """Client-facing listener (reference ClientZStack: one listener,
+    anonymous-encrypted clients, connection limit protection)."""
+
+    def __init__(self, name: str, ha: HA, keys: NodeKeys,
+                 config: Optional[Config] = None):
+        super().__init__(name, ha, keys, config)
+        self._clients: Dict[str, Connection] = {}
+        self._order: deque = deque()  # client ids, accept order
+        self._counter = 0
+
+    async def _on_accept(self, reader, writer):
+        try:
+            responder = Responder(self.keys.sk, allowed_vks=None,
+                                  allow_anonymous=True)
+            m1 = await _handshake_frames(reader, writer, True)
+            m2 = responder.consume_message1(m1)
+            m3 = await _handshake_frames(reader, writer, True, payload=m2)
+            responder.consume_message3(m3)
+        except (HandshakeError, asyncio.TimeoutError, ConnectionError,
+                OSError, asyncio.IncompleteReadError) as e:
+            logger.info("%s: client handshake failed: %s", self.name, e)
+            writer.close()
+            return
+        self._counter += 1
+        peer = writer.get_extra_info("peername") or ("?", 0)
+        client_id = "client:{}:{}#{}".format(peer[0], peer[1], self._counter)
+        conn = Connection(reader, writer, responder.session(), client_id)
+        self._clients[client_id] = conn
+        self._order.append(client_id)
+        self._enforce_connection_limit()
+        while conn.alive:
+            payload = await conn.read_frame(self.msg_len_limit)
+            if payload is None:
+                conn.close()
+                break
+            self._enqueue_wire(payload, client_id)
+        self._clients.pop(client_id, None)
+
+    def _enforce_connection_limit(self):
+        limit = self.config.MAX_CONNECTED_CLIENTS_NUM
+        while len(self._clients) > limit and self._order:
+            victim = self._order.popleft()
+            conn = self._clients.pop(victim, None)
+            if conn is not None:
+                logger.info("%s: evicting client %s (connection limit)",
+                            self.name, victim)
+                conn.close()
+
+    def send_to_client(self, client_id: str, msg_dict: dict) -> bool:
+        conn = self._clients.get(client_id)
+        if conn is None or not conn.alive:
+            return False
+        try:
+            conn.send_frame(serializer.serialize(msg_dict))
+            return True
+        except Exception:
+            conn.close()
+            self._clients.pop(client_id, None)
+            return False
+
+
+class ClientConnection:
+    """Dialing side for wallets/tests: anonymous encrypted channel to a
+    node's client listener."""
+
+    def __init__(self, ha: HA, expected_verkey: Optional[bytes] = None):
+        self.ha = ha
+        self._expected_vk = expected_verkey
+        self.conn: Optional[Connection] = None
+        self.rx: deque = deque()
+        self._reader_task = None
+
+    async def connect(self):
+        reader, writer = await asyncio.open_connection(*self.ha)
+        initiator = Initiator(None, expected_peer_vk=self._expected_vk)
+        m2 = await _handshake_frames(reader, writer, True,
+                                     payload=initiator.message1())
+        m3 = initiator.consume_message2(m2)
+        await _handshake_frames(reader, writer, False, payload=m3)
+        self.conn = Connection(reader, writer, initiator.session(), "client")
+        self._reader_task = asyncio.get_event_loop().create_task(
+            self._read_loop())
+
+    async def _read_loop(self):
+        while self.conn is not None and self.conn.alive:
+            payload = await self.conn.read_frame(Config.MSG_LEN_LIMIT)
+            if payload is None:
+                break
+            try:
+                self.rx.append(serializer.deserialize(payload))
+            except Exception:
+                pass
+
+    def send(self, msg_dict: dict):
+        self.conn.send_frame(serializer.serialize(msg_dict))
+
+    def close(self):
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self.conn is not None:
+            self.conn.close()

@@ -1,0 +1,122 @@
+"""NetworkedNode — a consensus Node on real sockets.
+
+Reference: plenum/server/node.py owns NodeZStack + ClientZStack and its
+`prod` (node.py:1037) services stacks, replicas, timer, and flushes
+outboxes every tick (§3.2). Here the same wiring is a thin Prodable
+around the rung-2-tested Node core: inbound wire dicts are deserialized
+through the message factory and fed to the node's ExternalBus; the
+node's sends are serialized onto the NodeStack's per-remote outboxes and
+flushed once per tick; client frames go to process_client_request and
+replies back through the ClientStack.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.messages.message_factory import node_message_factory
+from plenum_tpu.runtime.bus import ExternalBus
+from plenum_tpu.runtime.motor import Prodable
+from plenum_tpu.runtime.timer import QueueTimer
+from plenum_tpu.network.keys import NodeKeys
+from plenum_tpu.network.stack import (
+    HA, ClientStack, NodeStack, RemoteInfo)
+from plenum_tpu.server.node import Node
+
+logger = logging.getLogger(__name__)
+
+
+class NetworkedNode(Prodable):
+    def __init__(self, name: str, registry: Dict[str, RemoteInfo],
+                 keys: NodeKeys, node_ha: HA, client_ha: HA,
+                 config: Optional[Config] = None,
+                 timer: Optional[QueueTimer] = None,
+                 storage_factory=None,
+                 genesis_txns: Optional[List[dict]] = None):
+        import time
+        self._name = name
+        self.config = config or Config()
+        # wall-clock timer: ppTime/TimestampField expect epoch seconds
+        self.timer = timer or QueueTimer(get_current_time=time.time)
+        self.registry = dict(registry)
+
+        self.nodestack = NodeStack(
+            name, node_ha, keys, registry, self.config,
+            on_connections_changed=self._on_conns_changed)
+        self.clientstack = ClientStack(name + ".client", client_ha, keys,
+                                       self.config)
+
+        # the ExternalBus the consensus core sees; its send handler feeds
+        # the stack outboxes
+        self.bus = ExternalBus(send_handler=self._send_to_nodes)
+        validators = sorted(registry)
+        self.node = Node(name, validators, self.timer, self.bus,
+                         config=self.config,
+                         storage_factory=storage_factory,
+                         client_reply_handler=self._reply_to_client,
+                         genesis_txns=genesis_txns)
+
+    # --------------------------------------------------------- tx glue
+
+    def _send_to_nodes(self, message, dst=None):
+        self.nodestack.send(message.to_dict(), dst)
+
+    def _reply_to_client(self, client_id: str, msg):
+        self.clientstack.send_to_client(client_id, msg.to_dict())
+
+    def _on_conns_changed(self, connecteds):
+        self.bus.update_connecteds(set(connecteds))
+
+    # --------------------------------------------------------- rx glue
+
+    def _on_node_wire_msg(self, msg_dict: dict, frm: str):
+        try:
+            msg = node_message_factory.get_instance(**msg_dict)
+        except Exception as e:
+            logger.warning("%s: invalid message from %s: %s",
+                           self._name, frm, e)
+            return
+        self.bus.process_incoming(msg, frm)
+
+    def _on_client_wire_msg(self, msg_dict: dict, client_id: str):
+        self.node.process_client_request(msg_dict, client_id)
+
+    # -------------------------------------------------------- Prodable
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def start(self, loop) -> None:
+        loop.create_task(self.nodestack.start())
+        loop.create_task(self.clientstack.start())
+
+    async def start_async(self):
+        await self.nodestack.start()
+        await self.clientstack.start()
+
+    def stop(self) -> None:
+        import asyncio
+        for stack in (self.nodestack, self.clientstack):
+            try:
+                asyncio.get_event_loop().create_task(stack.stop())
+            except RuntimeError:
+                pass
+
+    async def prod(self, limit: int = None) -> int:
+        """One tick (reference node.py:1037): rx quotas → consensus →
+        timer → lifecycle → flush."""
+        c = self.nodestack.service(
+            self._on_node_wire_msg,
+            quota=self.config.NODE_TO_NODE_STACK_QUOTA,
+            size_quota=self.config.NODE_TO_NODE_STACK_SIZE)
+        c += self.clientstack.service(
+            self._on_client_wire_msg,
+            quota=self.config.CLIENT_TO_NODE_STACK_QUOTA,
+            size_quota=self.config.CLIENT_TO_NODE_STACK_SIZE)
+        c += self.node.service()
+        c += self.timer.service()
+        self.nodestack.service_lifecycle()
+        self.nodestack.flush_outboxes()
+        return c
