@@ -36,8 +36,9 @@ class MessageReqService:
         self._network = network
         self._ordering = ordering
         self._config = config or Config()
-        network.subscribe(MessageReq, self.process_message_req)
-        network.subscribe(MessageRep, self.process_message_rep)
+        self._unsubscribers = [
+            network.subscribe(MessageReq, self.process_message_req),
+            network.subscribe(MessageRep, self.process_message_rep)]
         bus.subscribe(MissingMessage, self.process_missing_message)
         # (msg_type, view_no, pp_seq_no) -> last request time (throttle)
         self._requested: Dict[Tuple, float] = {}
@@ -153,3 +154,12 @@ class MessageReqService:
         except Exception as e:  # malformed reply from a byzantine peer
             logger.warning("%s bad MESSAGE_RESPONSE from %s: %s",
                            self._data.name, frm, e)
+
+    def stop(self):
+        """Detach network subscriptions (backup replica removal)."""
+        for unsub in self._unsubscribers:
+            try:
+                unsub()
+            except ValueError:
+                pass
+        self._unsubscribers = []

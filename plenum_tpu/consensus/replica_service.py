@@ -47,8 +47,9 @@ class ReplicaService:
         self._data = ConsensusSharedData(
             name, validators, inst_id, is_master,
             log_size=self.config.LOG_SIZE)
-        selector = RoundRobinConstantNodesPrimariesSelector(validators)
-        self._data.primary_name = selector.select_master_primary(0)
+        self.selector = RoundRobinConstantNodesPrimariesSelector(validators)
+        self._data.primary_name = self.selector.select_primaries(
+            0, inst_id + 1)[inst_id]
 
         self.stasher = StashingRouter(
             limit=self.config.MAX_REQUEST_QUEUE_SIZE,
@@ -62,13 +63,20 @@ class ReplicaService:
             data=self._data, bus=self.internal_bus, network=network,
             stasher=self.stasher, config=self.config,
             digest_source=checkpoint_digest_source)
-        self.view_changer = ViewChangeService(
-            data=self._data, timer=timer, bus=self.internal_bus,
-            network=network, stasher=self.stasher, config=self.config,
-            primaries_selector=selector)
-        self.vc_trigger = ViewChangeTriggerService(
-            data=self._data, timer=timer, bus=self.internal_bus,
-            network=network, config=self.config)
+        # view change is a node-level protocol driven by the MASTER
+        # instance only (reference: backup replicas follow the master's
+        # NewViewAccepted; they never build/collect VIEW_CHANGE msgs)
+        if is_master:
+            self.view_changer = ViewChangeService(
+                data=self._data, timer=timer, bus=self.internal_bus,
+                network=network, stasher=self.stasher, config=self.config,
+                primaries_selector=self.selector)
+            self.vc_trigger = ViewChangeTriggerService(
+                data=self._data, timer=timer, bus=self.internal_bus,
+                network=network, config=self.config)
+        else:
+            self.view_changer = None
+            self.vc_trigger = None
         from plenum_tpu.consensus.message_req_service import MessageReqService
         self.message_req = MessageReqService(
             data=self._data, timer=timer, bus=self.internal_bus,
@@ -129,3 +137,36 @@ class ReplicaService:
         # route byzantine suspicions into view-change votes (master only)
         if self._data.is_master:
             self.internal_bus.send(VoteForViewChange(suspicion=msg.ex))
+
+    # ------------------------------------------------- backup lifecycle
+
+    def reset_for_view(self, view_no: int):
+        """Backup instances restart clean in the new view chosen by the
+        master (reference: backups get new primaries from
+        select_primaries and begin ordering from (view_no, 0) — their
+        batches carry no execution state to preserve)."""
+        assert not self._data.is_master
+        d = self._data
+        d.view_no = view_no
+        d.waiting_for_new_view = False
+        d.primary_name = self.selector.select_primaries(
+            view_no, d.inst_id + 1)[d.inst_id]
+        d.pp_seq_no = 0
+        d.last_ordered_3pc = (view_no, 0)
+        d.preprepared = []
+        d.prepared = []
+        d.low_watermark = 0
+        d.stable_checkpoint = 0
+        d.checkpoints = [d.initial_checkpoint]
+        o = self.ordering
+        o.sent_preprepares.clear()
+        o.prePrepares.clear()
+        o.prepares.clear()
+        o.commits.clear()
+        o.batches.clear()
+        o.ordered.clear()
+        o.old_view_preprepares.clear()
+        o.lastPrePrepareSeqNo = 0
+        o._last_applied_seq = 0
+        o._new_view_bids_to_reorder = []
+        self.executor.revert_unordered_batches()

@@ -77,11 +77,22 @@ class StashingRouter:
         self._sort_key = sort_key
         self._handlers: Dict[Type, Callable] = {}
         self._stashes: Dict[Tuple[Type, int], Any] = {}
+        self._unsubscribers = []
 
     def subscribe(self, message_type: Type, handler: Callable):
         self._handlers[message_type] = handler
         for bus in self._buses:
-            bus.subscribe(message_type, self._create_bus_handler(handler))
+            self._unsubscribers.append(
+                bus.subscribe(message_type, self._create_bus_handler(handler)))
+
+    def unsubscribe_all(self):
+        """Detach every bus subscription (backup replica removal)."""
+        for unsub in self._unsubscribers:
+            try:
+                unsub()
+            except ValueError:
+                pass
+        self._unsubscribers = []
 
     def _create_bus_handler(self, handler):
         def bus_handler(message, *args):

@@ -142,8 +142,12 @@ class Monitor:
     def request_ordered(self, digest: str, inst_id: int = 0):
         now = self._timer.get_current_time()
         self._throughput(inst_id).add_request(now)
+        if inst_id != 0:
+            # backups only feed the throughput comparison; the latency
+            # tracker entry must survive until the MASTER orders it
+            return
         latency = self.request_tracker.order(digest, now)
-        if latency is not None and inst_id == 0:
+        if latency is not None:
             self.latencies.append(latency)
             self.total_ordered += 1
             self._warm = self._warm or \
@@ -156,6 +160,13 @@ class Monitor:
         self.latencies.clear()
 
     # --------------------------------------------------------- judgments
+
+    def instance_throughput(self, inst_id: int) -> Optional[float]:
+        """Current EMA throughput of one instance (None = no data)."""
+        t = self.throughputs.get(inst_id)
+        if t is None:
+            return None
+        return t.get_throughput(self._timer.get_current_time())
 
     def instance_throughput_ratio(self, inst_id: int = 0) -> Optional[float]:
         """master throughput / best backup throughput (reference :456)."""

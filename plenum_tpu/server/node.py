@@ -133,6 +133,14 @@ class Node:
             config=self.config, bls_bft_replica=bls_bft_replica,
             checkpoint_digest_source=self._audit_root_at)
 
+        # ---- RBFT redundant instances: f backups benchmark the master
+        from plenum_tpu.server.replicas import (
+            BackupInstanceFaultyProcessor, Replicas)
+        self.replicas = Replicas(
+            name, validators, timer, network, master=self.replica,
+            config=self.config,
+            on_backup_ordered=self._on_backup_ordered)
+
         # ---- propagation
         self.propagator = Propagator(
             name, self.replica.data.quorums, network,
@@ -163,6 +171,12 @@ class Node:
         self._degradation_timer = RepeatingTimer(
             timer, self.config.ThroughputWindowSize,
             _check_master_degraded)
+        from plenum_tpu.server.replicas import BackupInstanceFaultyProcessor
+        self.backup_faulty_processor = BackupInstanceFaultyProcessor(
+            self.replicas, self.monitor, self.config)
+        self._backup_faulty_timer = RepeatingTimer(
+            timer, 4 * self.config.ThroughputWindowSize,
+            self.backup_faulty_processor.check)
 
         # ---- catchup (leecher + seeder)
         from plenum_tpu.common.messages.internal_messages import (
@@ -297,13 +311,19 @@ class Node:
     def _forward_finalised(self, request: Request):
         lid = self.write_manager.type_to_ledger_id(request.txn_type) \
             or DOMAIN_LEDGER_ID
-        self.replica.ordering.add_finalized_request(request.key, lid)
+        self.replicas.submit_request(request.key, lid)
 
     def _get_finalised_request(self, digest: str) -> Optional[Request]:
         state = self.propagator.requests.get(digest)
         return state.request if state else None
 
     # ===================================================== commit hooks
+
+    def _on_backup_ordered(self, ordered: Ordered):
+        """Backup instances never execute; they only feed the monitor's
+        master-vs-backup throughput comparison (RBFT ratio path)."""
+        for digest in ordered.valid_reqIdr:
+            self.monitor.request_ordered(digest, ordered.instId)
 
     def _on_batch_committed(self, ordered: Ordered, committed_txns):
         """Send Replies with audit paths; update dedup index; free reqs."""
@@ -421,8 +441,8 @@ class Node:
         return audit.root_hash
 
     def service(self):
-        """One prod tick."""
-        return self.replica.service()
+        """One prod tick: all protocol instances (master + backups)."""
+        return self.replicas.service()
 
     # ------------------------------------------------------- inspection
 

@@ -1,0 +1,170 @@
+"""RBFT redundant-instance tests (VERDICT item 4).
+
+The defining RBFT behavior: f backup protocol instances order the same
+requests under different primaries purely to benchmark the master; a
+throttled master primary is detected by the Monitor's throughput-RATIO
+path (master/backup < Δ) and triggers a view change. Reference:
+plenum/server/replicas.py:32, plenum/server/monitor.py:425,456.
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.common.messages.node_messages import PrePrepare
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.server.replicas import num_instances_for
+from plenum_tpu.testing.sim_network import PendingMessage, Processor, SimNetwork
+
+SIM_EPOCH = 1600000000
+NAMES7 = ["Alpha", "Beta", "Gamma", "Delta", "Epsilon", "Zeta", "Eta"]
+
+
+class DiscardMasterPrePrepares(Processor):
+    """Drop instId-0 PRE-PREPAREs from the master primary (and its
+    MessageRep repair channel): the master instance stalls while backups
+    keep ordering."""
+
+    def __init__(self, primary: str):
+        self.primary = primary
+        self.dropped = 0
+
+    def process(self, msg: PendingMessage) -> bool:
+        from plenum_tpu.common.messages.node_messages import MessageRep
+        if (isinstance(msg.message, PrePrepare)
+                and msg.message.instId == 0 and msg.frm == self.primary):
+            self.dropped += 1
+            return True
+        if isinstance(msg.message, MessageRep) and msg.frm == self.primary:
+            return True
+        return False
+
+
+def signed_nym_request(signer, req_id):
+    req = {
+        "identifier": signer.identifier,
+        "reqId": req_id,
+        "protocolVersion": 2,
+        "operation": {"type": NYM, TARGET_NYM: signer.identifier,
+                      VERKEY: signer.verkey},
+    }
+    req["signature"] = signer.sign(dict(req))
+    return req
+
+
+@pytest.fixture
+def pool7(mock_timer):
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(55))
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=10,
+                  LOG_SIZE=30, ThroughputWindowSize=2,
+                  ThroughputFirstWindowSize=2, LAMBDA=10 ** 6,
+                  ToleratePrimaryDisconnection=10 ** 6)
+    nodes = [Node(n, NAMES7, mock_timer, net.create_peer(n), config=conf)
+             for n in NAMES7]
+    return nodes, net, mock_timer
+
+
+def pump(timer, nodes, seconds, step=0.05):
+    end = timer.get_current_time() + seconds
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(step)
+
+
+def test_f_plus_one_instances_created(pool7):
+    nodes, _, _ = pool7
+    assert num_instances_for(7) == 3
+    for n in nodes:
+        assert n.replicas.num_instances == 3
+        assert n.replicas[0].data.is_master
+        # backup primaries rotate off the master's
+        assert n.replicas[1].data.primary_name == "Beta"
+        assert n.replicas[2].data.primary_name == "Gamma"
+        assert n.replicas[1].view_changer is None  # node-level protocol
+
+
+def test_backups_order_same_requests(pool7):
+    nodes, net, timer = pool7
+    client = SimpleSigner(seed=b"\x51" * 32)
+    for i in range(1, 4):
+        req = signed_nym_request(client, i)
+        for n in nodes:
+            n.process_client_request(dict(req), "c1")
+        pump(timer, nodes, 2)
+    pump(timer, nodes, 4)
+    for n in nodes:
+        assert n.replicas[0].last_ordered[1] >= 1
+        for inst_id in (1, 2):
+            backup = n.replicas[inst_id]
+            assert backup.last_ordered[1] >= 1, (n.name, inst_id)
+            # backups see the same request stream
+            ordered_digests = {d for o in backup.ordered_log
+                               for d in o.valid_reqIdr}
+            master_digests = {d for o in n.replicas[0].ordered_log
+                              for d in o.valid_reqIdr}
+            assert ordered_digests & master_digests
+
+
+def test_throttled_master_triggers_ratio_view_change(pool7):
+    """The MASTER_DEGRADED ratio path: master instance stalled, backups
+    ordering → master/backup throughput < Δ → view change to view 1."""
+    nodes, net, timer = pool7
+    blocker = DiscardMasterPrePrepares(primary="Alpha")
+    net.add_processor(blocker)
+    from plenum_tpu.common.messages.internal_messages import (
+        VoteForViewChange)
+    votes = []
+    for n in nodes:
+        n.replica.internal_bus.subscribe(
+            VoteForViewChange,
+            lambda m, *a: votes.append(m.suspicion))
+    client = SimpleSigner(seed=b"\x52" * 32)
+    # sustained request flow so backup EMA throughput stays positive
+    req_id = 0
+    for round_no in range(30):
+        req_id += 1
+        req = signed_nym_request(client, req_id)
+        for n in nodes:
+            n.process_client_request(dict(req), "c1")
+        pump(timer, nodes, 2)
+        if all(n.view_no >= 1 for n in nodes):
+            break
+    assert blocker.dropped > 0
+    assert "MASTER_DEGRADED" in votes, set(votes)
+    assert all(n.view_no >= 1 for n in nodes), \
+        {n.name: n.view_no for n in nodes}
+    # after the view change the new master primary orders the backlog
+    net.remove_processor(blocker)
+    req = signed_nym_request(client, req_id + 1)
+    for n in nodes:
+        n.process_client_request(dict(req), "c1")
+    pump(timer, nodes, 15)
+    assert all(n.replicas[0].last_ordered[1] >= 1 for n in nodes)
+
+
+def test_faulty_backup_removed_locally(pool7):
+    """BackupInstanceFaultyProcessor: a backup with zero throughput while
+    the master progresses is removed (local strategy)."""
+    nodes, net, timer = pool7
+    node = nodes[0]
+    # strangle backup instance 2 on Alpha: drop all its incoming 3PC
+    class DropInst2(Processor):
+        def process(self, msg: PendingMessage) -> bool:
+            inst = getattr(msg.message, "instId", None)
+            return inst == 2 and msg.dst == "Alpha"
+    net.add_processor(DropInst2())
+    client = SimpleSigner(seed=b"\x53" * 32)
+    for i in range(1, 16):
+        req = signed_nym_request(client, i)
+        for n in nodes:
+            n.process_client_request(dict(req), "c1")
+        pump(timer, nodes, 2)
+        if 2 not in [i for i in node.replicas.backup_ids]:
+            break
+    assert 2 in node.backup_faulty_processor.removed
+    assert node.replicas.backup_ids == [1]
+    # the master keeps ordering fine
+    assert node.replicas[0].last_ordered[1] >= 1
