@@ -4,14 +4,40 @@ common/serializers/base58_serializer.py)."""
 
 ALPHABET = b'123456789ABCDEFGHJKLMNPQRSTUVWXYZabcdefghijkmnopqrstuvwxyz'
 _INDEX = {c: i for i, c in enumerate(ALPHABET)}
+_A = ALPHABET.decode('ascii')
+# chunked conversion: peel 10 digits (58^10 < 2^59) per bigint divmod —
+# ~10x fewer bigint ops than digit-at-a-time (hot path: every merkle /
+# state root crossing a serialization boundary goes through here)
+_B58_10 = 58 ** 10
+_DIGITS10 = {}
+
+
+def _enc10(r: int) -> str:
+    """10-digit base58 block with leading '1' padding, memoized."""
+    got = _DIGITS10.get(r)
+    if got is None:
+        out = []
+        v = r
+        for _ in range(10):
+            v, d = divmod(v, 58)
+            out.append(_A[d])
+        got = ''.join(reversed(out))
+        if len(_DIGITS10) < 1 << 16:
+            _DIGITS10[r] = got
+    return got
 
 
 def b58encode(data: bytes) -> str:
     n = int.from_bytes(data, 'big')
-    out = bytearray()
+    blocks = []
+    while n >= _B58_10:
+        n, r = divmod(n, _B58_10)
+        blocks.append(_enc10(r))
+    head = ''
     while n > 0:
-        n, r = divmod(n, 58)
-        out.append(ALPHABET[r])
+        n, d = divmod(n, 58)
+        head = _A[d] + head
+    body = head + ''.join(reversed(blocks))
     # preserve leading zero bytes
     pad = 0
     for b in data:
@@ -19,7 +45,7 @@ def b58encode(data: bytes) -> str:
             pad += 1
         else:
             break
-    return (ALPHABET[0:1] * pad + bytes(reversed(out))).decode('ascii')
+    return '1' * pad + body
 
 
 def b58decode(s) -> bytes:

@@ -7,7 +7,11 @@ is deep enough; small batches take the scalar floor so a quiet pool never
 regresses (SURVEY.md §7 "hard parts" #3: dispatch policy by queue depth).
 
 Providers:
-  - ScalarVerifier: pure-Python RFC 8032 (crypto/ed25519.py), per item.
+  - ScalarVerifier: pure-Python RFC 8032 (crypto/ed25519.py), per item —
+    the reference implementation used for cross-checking only.
+  - OpenSSLVerifier: per-item verification through OpenSSL's Ed25519
+    (`cryptography`) — the honest CPU floor, equivalent to the
+    reference's libsodium path (~10-20k verifies/s/core).
   - JaxBatchVerifier: one fused TPU dispatch (ops/ed25519_jax.py).
   - AdaptiveVerifier: routes by batch size; default `tpu_batch` provider.
 
@@ -20,6 +24,30 @@ from typing import List, Sequence, Tuple
 VerifyItem = Tuple[bytes, bytes, bytes]  # (message, signature64, verkey32)
 
 
+class _Ready:
+    """Already-materialized result (scalar paths)."""
+
+    def __init__(self, results: List[bool]):
+        self._results = results
+
+    def collect(self) -> List[bool]:
+        return self._results
+
+
+class _PendingDevice:
+    """In-flight device batch: JAX dispatch is async — creating this does
+    not block; collect() materializes (blocks on the device)."""
+
+    def __init__(self, ok_device, valid, n):
+        self._ok = ok_device
+        self._valid = valid
+        self._n = n
+
+    def collect(self) -> List[bool]:
+        import numpy as np
+        return list(np.asarray(self._ok)[:self._n] & self._valid)
+
+
 class ScalarVerifier:
     name = "scalar"
 
@@ -27,16 +55,51 @@ class ScalarVerifier:
         from . import ed25519
         return [ed25519.verify(m, s, vk) for (m, s, vk) in items]
 
+    def dispatch(self, items: Sequence[VerifyItem]) -> _Ready:
+        return _Ready(self.verify_batch(items))
+
+
+class OpenSSLVerifier:
+    """The CPU production floor (libsodium-equivalent): OpenSSL Ed25519
+    via `cryptography`. Reference: stp_core/crypto/nacl_wrappers.py."""
+
+    name = "cpu"
+
+    def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey)
+        out = []
+        for msg, sig, vk in items:
+            try:
+                Ed25519PublicKey.from_public_bytes(bytes(vk)).verify(
+                    bytes(sig), bytes(msg))
+                out.append(True)
+            except (InvalidSignature, ValueError):
+                out.append(False)
+        return out
+
+    def dispatch(self, items: Sequence[VerifyItem]) -> _Ready:
+        return _Ready(self.verify_batch(items))
+
 
 class JaxBatchVerifier:
     name = "tpu_batch"
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        return self.dispatch(items).collect()
+
+    def dispatch(self, items: Sequence[VerifyItem]) -> "_PendingDevice":
+        """Enqueue the device batch WITHOUT blocking on the result —
+        JAX dispatch is asynchronous, so the caller (prod loop) overlaps
+        consensus work / other nodes\' dispatches with the device round
+        trip and harvests later (SURVEY.md §7 backpressure design)."""
         from plenum_tpu.ops import ed25519_jax
         msgs = [m for m, _, _ in items]
         sigs = [s for _, s, _ in items]
         vks = [vk for _, _, vk in items]
-        return list(ed25519_jax.verify_batch(msgs, sigs, vks))
+        ok_dev, valid, n = ed25519_jax.verify_batch_async(msgs, sigs, vks)
+        return _PendingDevice(ok_dev, valid, n)
 
 
 class AdaptiveVerifier:
@@ -46,7 +109,7 @@ class AdaptiveVerifier:
 
     def __init__(self, threshold: int = 32, scalar=None, batch=None):
         self.threshold = threshold
-        self._scalar = scalar or ScalarVerifier()
+        self._scalar = scalar or OpenSSLVerifier()
         self._batch = batch or JaxBatchVerifier()
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
@@ -54,9 +117,15 @@ class AdaptiveVerifier:
             return self._batch.verify_batch(items)
         return self._scalar.verify_batch(items)
 
+    def dispatch(self, items: Sequence[VerifyItem]):
+        if len(items) >= self.threshold:
+            return self._batch.dispatch(items)
+        return self._scalar.dispatch(items)
+
 
 _PROVIDERS = {
     "scalar": ScalarVerifier,
+    "cpu": OpenSSLVerifier,
     "tpu_batch": JaxBatchVerifier,
     "adaptive": AdaptiveVerifier,
 }

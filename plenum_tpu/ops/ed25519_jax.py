@@ -373,7 +373,9 @@ def host_pack(msgs: Sequence[bytes], sigs: Sequence[bytes],
     """Host-side preprocessing: parse/canonicality-check sigs and keys,
     compute k = SHA-512(R||A||M) mod L (hashlib C core), pack limb arrays.
 
-    → ([ay, asign, ry, rsign, s_words, k_words] jnp arrays, valid bool[B])
+    → ([ay, asign, ry, rsign, s_words, k_words] host np arrays — the
+    jit transfers them once; keeping them in numpy lets callers pad the
+    batch axis without device round-trips — and valid bool[B])
     """
     n = len(msgs)
     assert len(sigs) == n and len(verkeys) == n
@@ -404,12 +406,12 @@ def host_pack(msgs: Sequence[bytes], sigs: Sequence[bytes],
         rsign.append(rs_v)
         s_sc.append(s_int)
         k_sc.append(k_int)
-    arrays = [jnp.asarray(_pack_fe(ay)),
-              jnp.asarray(np.asarray(asign, np.int32)),
-              jnp.asarray(_pack_fe(ry)),
-              jnp.asarray(np.asarray(rsign, np.int32)),
-              jnp.asarray(_pack_words(s_sc)),
-              jnp.asarray(_pack_words(k_sc))]
+    arrays = [_pack_fe(ay),
+              np.asarray(asign, np.int32),
+              _pack_fe(ry),
+              np.asarray(rsign, np.int32),
+              _pack_words(s_sc),
+              _pack_words(k_sc)]
     return arrays, valid
 
 
@@ -420,8 +422,31 @@ def verify_batch(msgs: Sequence[bytes], sigs: Sequence[bytes],
     Host does the cheap data-dependent prep (host_pack); the device does
     all elliptic-curve math in one dispatch.
     """
-    if len(msgs) == 0:
+    ok_dev, valid, n = verify_batch_async(msgs, sigs, verkeys)
+    if n == 0:
         return np.zeros(0, dtype=bool)
+    return np.asarray(ok_dev)[:n] & valid
+
+
+def verify_batch_async(msgs: Sequence[bytes], sigs: Sequence[bytes],
+                       verkeys: Sequence[bytes]):
+    """Non-blocking batched verify: enqueues the device computation and
+    returns (ok_device_array, valid_host_bools, n) immediately — JAX
+    dispatch is async, so the caller overlaps host work with the device
+    round trip and materializes later (np.asarray(ok)[:n] & valid)."""
+    n = len(msgs)
+    if n == 0:
+        return None, np.zeros(0, dtype=bool), 0
     arrays, valid = host_pack(msgs, sigs, verkeys)
+    # pad the batch axis to the next power of two (min 8) by repeating
+    # row 0 so every size in [1, 2^k] shares one compiled kernel —
+    # variable pool queue depths must not trigger XLA recompiles
+    padded = 8
+    while padded < n:
+        padded *= 2
+    if padded != n:
+        arrays = [np.concatenate(
+            [a, np.repeat(a[:1], padded - n, axis=0)], axis=0)
+            for a in arrays]
     ok = _verify_kernel(*arrays)
-    return np.asarray(ok) & valid
+    return ok, valid, n

@@ -77,6 +77,14 @@ class CoreAuthNr(ClientAuthNr):
                            ) -> List[Optional[List[str]]]:
         """Authenticate many requests in ONE device dispatch. Returns, per
         request, the verified identifier list or None if auth failed."""
+        return self.conclude_batch(self.dispatch_batch(reqs))
+
+    def dispatch_batch(self, reqs: Sequence[Request]):
+        """Phase 1 (non-blocking): pack every signature on every request
+        into one device dispatch and return a pending handle. The prod
+        loop overlaps consensus work / other nodes\' batches with the
+        device round trip and calls conclude_batch later (SURVEY.md §7
+        async-dispatch backpressure design).."""
         all_items, spans, idrs_per_req = [], [], []
         prep_errors: List[Optional[Exception]] = []
         for req in reqs:
@@ -89,7 +97,13 @@ class CoreAuthNr(ClientAuthNr):
             spans.append((len(all_items), len(items)))
             idrs_per_req.append(idrs)
             all_items.extend(items)
-        results = self._verifier.verify_batch(all_items) if all_items else []
+        pending = self._verifier.dispatch(all_items) if all_items else None
+        return (list(reqs), spans, idrs_per_req, prep_errors, pending)
+
+    def conclude_batch(self, handle) -> List[Optional[List[str]]]:
+        """Phase 2 (blocking): harvest the device results."""
+        reqs, spans, idrs_per_req, prep_errors, pending = handle
+        results = pending.collect() if pending is not None else []
         out: List[Optional[List[str]]] = []
         for req, (start, count), idrs, err in zip(reqs, spans, idrs_per_req,
                                                   prep_errors):

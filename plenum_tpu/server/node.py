@@ -235,6 +235,15 @@ class Node:
     def process_client_batch(self, msgs: List[Tuple[dict, str]]):
         """Batched intake: ONE device dispatch authenticates every pending
         request (the north-star path)."""
+        pending = self.dispatch_client_batch(msgs)
+        self.conclude_client_batch(pending)
+
+    def dispatch_client_batch(self, msgs: List[Tuple[dict, str]]):
+        """Phase 1 of batched intake (non-blocking): validate schemas,
+        serve reads, enqueue ONE async device dispatch for every write
+        signature. The caller overlaps other work (other nodes\' batches,
+        consensus ticks) before conclude_client_batch harvests — this
+        hides the device round-trip latency entirely (SURVEY.md §7)."""
         parsed = []
         for msg, client_id in msgs:
             try:
@@ -250,8 +259,16 @@ class Node:
                 continue
             parsed.append((request, client_id))
         if not parsed:
+            return None
+        handle = self.authnr.dispatch_batch([r for r, _ in parsed])
+        return (parsed, handle)
+
+    def conclude_client_batch(self, pending):
+        """Phase 2: harvest device results, ack/nack, propagate."""
+        if pending is None:
             return
-        results = self.authnr.authenticate_batch([r for r, _ in parsed])
+        parsed, handle = pending
+        results = self.authnr.conclude_batch(handle)
         for (request, client_id), idrs in zip(parsed, results):
             if idrs is None:
                 self._reply_to_client(client_id, RequestNack(

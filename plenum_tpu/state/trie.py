@@ -60,10 +60,16 @@ def hp_decode(data: bytes) -> Tuple[List[int], bool]:
 
 
 class Trie:
+    _DECODE_CACHE_MAX = 4096
+
     def __init__(self, store, root_hash: Optional[bytes] = None):
         """store: KeyValueStorage-like (get/put raising KeyError on miss)."""
         self._store = store
         self.root_hash = root_hash if root_hash is not None else BLANK_ROOT
+        # hash → decoded node. Nodes are content-addressed and immutable,
+        # so the cache never goes stale; it just bounds memory. Kills the
+        # dominant RLP re-decode cost on the hot write path.
+        self._decoded: dict = {}
 
     # ----------------------------------------------------------- store IO
 
@@ -74,11 +80,18 @@ class Trie:
         if ref == BLANK_NODE:
             return BLANK_NODE
         if len(ref) == 32:
-            try:
-                raw = self._store.get(ref)
-            except KeyError:
-                raise KeyError("missing trie node {}".format(ref.hex()))
-            return rlp.decode(raw)
+            cached = self._decoded.get(ref)
+            if cached is None:
+                try:
+                    raw = self._store.get(ref)
+                except KeyError:
+                    raise KeyError("missing trie node {}".format(ref.hex()))
+                cached = rlp.decode(raw)
+                if len(self._decoded) >= self._DECODE_CACHE_MAX:
+                    self._decoded.clear()
+                self._decoded[ref] = cached
+            # shallow copy: _update/_delete overwrite node slots in place
+            return list(cached) if isinstance(cached, list) else cached
         return rlp.decode(ref)
 
     def _ref(self, node) -> rlp.RlpItem:
