@@ -234,6 +234,33 @@ def fpow(x, bits: np.ndarray):
     return lax.fori_loop(0, len(bits), body, one)
 
 
+def _sqn(x, n: int):
+    def body(i, acc):
+        return fsq(acc)
+    return lax.fori_loop(0, n, body, x) if n > 4 else \
+        functools.reduce(lambda a, _: fsq(a), range(n), x)
+
+
+def pow_p58(x):
+    """x^((p-5)/8) via the standard ed25519 addition chain (ref10
+    pow22523 structure): 252 squarings + 11 multiplies instead of
+    square-and-multiply's ~125 extra multiplies — decompress is on the
+    critical path of every verify."""
+    z2 = fsq(x)                       # 2
+    z9 = fmul(_sqn(z2, 2), x)         # 9 = 2^3+1
+    z11 = fmul(z9, z2)                # 11
+    z22 = fsq(z11)                    # 22
+    z_5_0 = fmul(z22, z9)             # 2^5 - 2^0
+    z_10_0 = fmul(_sqn(z_5_0, 5), z_5_0)
+    z_20_0 = fmul(_sqn(z_10_0, 10), z_10_0)
+    z_40_0 = fmul(_sqn(z_20_0, 20), z_20_0)
+    z_50_0 = fmul(_sqn(z_40_0, 10), z_10_0)
+    z_100_0 = fmul(_sqn(z_50_0, 50), z_50_0)
+    z_200_0 = fmul(_sqn(z_100_0, 100), z_100_0)
+    z_250_0 = fmul(_sqn(z_200_0, 50), z_50_0)
+    return fmul(_sqn(z_250_0, 2), x)  # 2^252 - 3
+
+
 # ----------------------------------------------------- point arithmetic
 # Extended twisted-Edwards coordinates (X, Y, Z, T), a = -1.
 
@@ -260,6 +287,20 @@ def pt_add(X1, Y1, Z1, T1, X2, Y2, Z2, T2):
     return fmul(E, F), fmul(G, H), fmul(F, G), fmul(E, H)
 
 
+def _pt_add_prescaled(X1, Y1, Z1, T1, X2, Y2, Z2, T2_2d):
+    """pt_add where the second point's T is pre-multiplied by 2d
+    (runtime window tables): 8 field muls."""
+    A = fmul(fsub(Y1, X1), fsub(Y2, X2))
+    B = fmul(fadd(Y1, X1), fadd(Y2, X2))
+    C = fmul(T1, T2_2d)
+    Dv = fmul(fadd(Z1, Z1), Z2)
+    E = fsub(B, A)
+    F = fsub(Dv, C)
+    G = fadd(Dv, C)
+    H = fadd(B, A)
+    return fmul(E, F), fmul(G, H), fmul(F, G), fmul(E, H)
+
+
 def _select_pt(cond, pa, pb):
     c = cond[..., None]
     return tuple(jnp.where(c, a, b) for a, b in zip(pa, pb))
@@ -274,7 +315,7 @@ def decompress(ylimbs, sign):
     v2 = fsq(v)
     v3 = fmul(v2, v)
     v7 = fmul(fsq(v3), v)
-    x = fmul(fmul(u, v3), fpow(fmul(u, v7), _E58_BITS))
+    x = fmul(fmul(u, v3), pow_p58(fmul(u, v7)))
     vxx = fmul(v, fsq(x))
     is_root = feq(vxx, u)
     is_neg_root = fiszero(fadd(vxx, u))
@@ -288,6 +329,67 @@ def decompress(ylimbs, sign):
     parity = xc[..., 0] & 1
     x = jnp.where((parity != sign)[..., None], fneg(xc), xc)
     return x, ok
+
+
+def pt_add_niels(X1, Y1, Z1, T1, n_sub, n_add, n_t2d):
+    """Mixed addition with a precomputed (Y2-X2, Y2+X2, 2d*T2, Z2=1)
+    "niels" point: 7 field muls instead of pt_add's 9 (the 2d mult and
+    the Z2 mult are folded into the table entry). Complete formulas —
+    the identity entry (1, 1, 0) is handled with no special case."""
+    A = fmul(fsub(Y1, X1), n_sub)
+    B = fmul(fadd(Y1, X1), n_add)
+    C = fmul(T1, n_t2d)
+    Dv = fadd(Z1, Z1)
+    E = fsub(B, A)
+    F = fsub(Dv, C)
+    G = fadd(Dv, C)
+    H = fadd(B, A)
+    return fmul(E, F), fmul(G, H), fmul(F, G), fmul(E, H)
+
+
+# --------------------------------------- host-side integer curve ops
+# (table construction at import time; python ints, exact)
+
+def _ed_add_affine(p1, p2):
+    """Affine Edwards addition over python ints (import-time tables)."""
+    x1, y1 = p1
+    x2, y2 = p2
+    dxy = D_INT * x1 % P * x2 % P * y1 % P * y2 % P
+    x3 = (x1 * y2 + x2 * y1) % P * pow(1 + dxy, P - 2, P) % P
+    y3 = (y1 * y2 + x1 * x2) % P * pow(1 - dxy, P - 2, P) % P
+    return x3, y3
+
+
+def _base_affine():
+    gy = G_Y_INT
+    u = (gy * gy - 1) % P
+    v = (D_INT * gy * gy + 1) % P
+    gx = (u * pow(v, 3, P) * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P)) % P
+    if (v * gx * gx - u) % P != 0:
+        gx = gx * SQRT_M1_INT % P
+    if gx & 1 != 0:
+        gx = P - gx
+    return gx, gy
+
+
+def _niels_from_affine(pt) -> List[np.ndarray]:
+    x, y = pt
+    return [_int_to_limbs((y - x) % P), _int_to_limbs((y + x) % P),
+            _int_to_limbs(2 * D_INT * x % P * y % P)]
+
+
+def _build_base_window_table() -> List[np.ndarray]:
+    """d*B for d=0..15 in niels form → 3 constant arrays [16, 20]."""
+    entries = [[_int_to_limbs(1), _int_to_limbs(1), _int_to_limbs(0)]]
+    acc = None
+    base = _base_affine()
+    for d in range(1, 16):
+        acc = base if acc is None else _ed_add_affine(acc, base)
+        entries.append(_niels_from_affine(acc))
+    return [np.stack([e[c] for e in entries]) for c in range(3)]
+
+
+_NB_SUB, _NB_ADD, _NB_T2D = _build_base_window_table()
 
 
 # ----------------------------------------------------- the verify kernel
@@ -308,6 +410,26 @@ def _base_point_ext() -> List[np.ndarray]:
 _B_EXT = _base_point_ext()
 
 
+def _digits4(words):
+    """[B, 8] uint32 → [B, 64] int32 4-bit digits, least significant
+    digit first."""
+    shifts = jnp.arange(0, 32, 4, dtype=jnp.uint32)        # [8]
+    d = (words[..., :, None] >> shifts[None, None, :]) & 0xF  # [B, 8, 8]
+    return d.reshape(d.shape[:-2] + (64,)).astype(jnp.int32)
+
+
+def _select_const_niels(onehot):
+    """One-hot [B,16] → niels point from the constant base table."""
+    return (onehot @ jnp.asarray(_NB_SUB),
+            onehot @ jnp.asarray(_NB_ADD),
+            onehot @ jnp.asarray(_NB_T2D))
+
+
+def _select_batched(onehot, table):
+    """One-hot [B,16] × per-batch table [B,16,20] → [B,20] per coord."""
+    return tuple(jnp.einsum("bd,bdl->bl", onehot, t) for t in table)
+
+
 @jax.jit
 def _verify_kernel(ay, asign, ry, rsign, s_words, k_words):
     """All inputs batched; returns bool[B].
@@ -315,33 +437,57 @@ def _verify_kernel(ay, asign, ry, rsign, s_words, k_words):
     ay/ry: [B, 20] int32 limbs of the y coordinates (canonical, < p)
     asign/rsign: [B] int32 sign bits
     s_words/k_words: [B, 8] uint32 little-endian scalar words
+
+    Interleaved 4-bit windowed double-scalar multiplication
+    (VERDICT round-1 item 5): per 64 windows, 4 shared doublings + one
+    niels-form add from the CONSTANT d*B table (fixed-base, 7 muls) +
+    one add from the per-signature d*(-A) table (8 muls, 2d*T
+    pre-scaled) — ~2.4x fewer field muls than bitwise double-and-add
+    with two conditional adds per bit. Digit selection is one-hot
+    matmuls (constant-shape, MXU/VPU-friendly, no gathers).
     """
     ax, ok_a = decompress(ay, asign)
     rx, ok_r = decompress(ry, rsign)
 
-    # -A in extended coordinates
-    nax = fneg(ax)
     one = jnp.broadcast_to(jnp.asarray(_ONE_L), ay.shape)
-    na_ext = (nax, ay, one, fmul(nax, ay))
-    b_ext = tuple(jnp.broadcast_to(jnp.asarray(l), ay.shape) for l in _B_EXT)
-
     zero = jnp.zeros_like(ay)
+    twod = jnp.broadcast_to(jnp.asarray(_TWOD_L), ay.shape)
+
+    # ---- per-signature table: d * (-A), d = 0..15, extended coords
+    # with T pre-scaled by 2d (so the loop add costs 8 muls)
+    nax = fneg(ax)
+    na = (nax, ay, one, fmul(nax, ay))
+    tab = [(zero, one, one, zero), na]
+    for d in range(2, 16):
+        if d % 2 == 0:
+            tab.append(pt_double(*tab[d // 2]))
+        else:
+            tab.append(pt_add(*tab[d - 1], *na))
+    tab_x = jnp.stack([t[0] for t in tab], axis=-2)   # [B, 16, 20]
+    tab_y = jnp.stack([t[1] for t in tab], axis=-2)
+    tab_z = jnp.stack([t[2] for t in tab], axis=-2)
+    tab_t2d = jnp.stack([fmul(t[3], twod) for t in tab], axis=-2)
+    a_table = (tab_x, tab_y, tab_z, tab_t2d)
+
+    sd = _digits4(s_words)   # [B, 64]
+    kd = _digits4(k_words)
+
     ident = (zero, one, one, zero)
+    eye16 = jnp.eye(16, dtype=jnp.int32)
 
     def body(i, st):
-        st = pt_double(*st)
-        j = 255 - i
-        word = j // 32
-        shift = j % 32
-        sw = lax.dynamic_index_in_dim(s_words, word, axis=-1, keepdims=False)
-        kw = lax.dynamic_index_in_dim(k_words, word, axis=-1, keepdims=False)
-        sbit = (sw >> shift.astype(sw.dtype)) & 1
-        kbit = (kw >> shift.astype(kw.dtype)) & 1
-        st = _select_pt(sbit == 1, pt_add(*st, *b_ext), st)
-        st = _select_pt(kbit == 1, pt_add(*st, *na_ext), st)
+        w = 63 - i
+        st = pt_double(*pt_double(*pt_double(*pt_double(*st))))
+        s_dig = lax.dynamic_index_in_dim(sd, w, axis=-1, keepdims=False)
+        k_dig = lax.dynamic_index_in_dim(kd, w, axis=-1, keepdims=False)
+        s_oh = eye16[s_dig]                     # [B, 16]
+        k_oh = eye16[k_dig]
+        st = pt_add_niels(*st, *_select_const_niels(s_oh))
+        x2, y2, z2, t2d2 = _select_batched(k_oh, a_table)
+        st = _pt_add_prescaled(*st, x2, y2, z2, t2d2)
         return st
 
-    X, Y, Z, _ = lax.fori_loop(0, 256, body, ident)
+    X, Y, Z, _ = lax.fori_loop(0, 64, body, ident)
 
     ok_x = fiszero(fsub(fmul(rx, Z), X))
     ok_y = fiszero(fsub(fmul(ry, Z), Y))
@@ -368,6 +514,47 @@ def _pack_words(values: Sequence[int]) -> np.ndarray:
     return out
 
 
+def _bit_fold_matrix() -> np.ndarray:
+    """[256, 20] f32: bit j of a little-endian 256-bit value contributes
+    2^(j-13i) to limb i (radix-2^13). Values stay < 2^13 — exact in f32,
+    so limb packing is one numpy matmul instead of a per-item loop."""
+    m = np.zeros((256, NLIMB), dtype=np.float32)
+    for j in range(256):
+        i = j // RADIX
+        if i < NLIMB:
+            m[j, i] = float(1 << (j - RADIX * i))
+    return m
+
+
+_BIT_FOLD = _bit_fold_matrix()
+
+
+def _le_words(a_bytes: np.ndarray) -> np.ndarray:
+    """[B, 32] uint8 → [B, 4] uint64 little-endian words."""
+    return a_bytes.view(np.uint64).reshape(a_bytes.shape[0], 4)
+
+
+def _ge_const(words: np.ndarray, const: int) -> np.ndarray:
+    """Vectorized (value >= const) over [B, 4] LE uint64 words."""
+    cw = np.array([(const >> (64 * i)) & 0xFFFFFFFFFFFFFFFF
+                   for i in range(4)], dtype=np.uint64)
+    ge = np.zeros(words.shape[0], dtype=bool)
+    decided = np.zeros(words.shape[0], dtype=bool)
+    for i in (3, 2, 1, 0):  # most significant first
+        gt = words[:, i] > cw[i]
+        lt = words[:, i] < cw[i]
+        ge |= gt & ~decided
+        decided |= gt | lt
+    ge |= ~decided  # equal ⇒ >=
+    return ge
+
+
+def _limbs_from_bytes(a_bytes: np.ndarray) -> np.ndarray:
+    """[B, 32] uint8 (LE) → [B, 20] int32 radix-2^13 limbs, vectorized."""
+    bits = np.unpackbits(a_bytes, axis=1, bitorder="little")  # [B, 256]
+    return (bits.astype(np.float32) @ _BIT_FOLD).astype(np.int32)
+
+
 def host_pack(msgs: Sequence[bytes], sigs: Sequence[bytes],
               verkeys: Sequence[bytes]):
     """Host-side preprocessing: parse/canonicality-check sigs and keys,
@@ -376,42 +563,72 @@ def host_pack(msgs: Sequence[bytes], sigs: Sequence[bytes],
     → ([ay, asign, ry, rsign, s_words, k_words] host np arrays — the
     jit transfers them once; keeping them in numpy lets callers pad the
     batch axis without device round-trips — and valid bool[B])
+
+    Fully vectorized (VERDICT round-1: the device kernel is ~1ms for 8k
+    sigs — a per-item python loop here would dominate the whole verify):
+    numpy views/unpackbits/matmul do the parsing; the only per-item C
+    calls are SHA-512 and the 512→253-bit modular reduction of k.
     """
     n = len(msgs)
     assert len(sigs) == n and len(verkeys) == n
-    ay, asign, ry, rsign, s_sc, k_sc = [], [], [], [], [], []
     valid = np.ones(n, dtype=bool)
+
+    DUMMY_SIG = b"\x00" * 64
+    DUMMY_VK = b"\x01" + b"\x00" * 31
+    norm_sigs = []
+    norm_vks = []
     for i in range(n):
-        sig, vk = sigs[i], verkeys[i]
-        if len(sig) != 64 or len(vk) != 32:
+        if len(sigs[i]) != 64 or len(verkeys[i]) != 32:
             valid[i] = False
-            sig, vk = b"\x00" * 64, b"\x01" + b"\x00" * 31
-        a_int = int.from_bytes(vk, "little")
-        r_int = int.from_bytes(sig[:32], "little")
-        s_int = int.from_bytes(sig[32:], "little")
-        ay_v, as_v = a_int & ((1 << 255) - 1), a_int >> 255
-        ry_v, rs_v = r_int & ((1 << 255) - 1), r_int >> 255
-        if ay_v >= P or ry_v >= P or s_int >= L:
-            valid[i] = False
-            ay_v = ry_v = 1
-            as_v = rs_v = s_int = 0
+            norm_sigs.append(DUMMY_SIG)
+            norm_vks.append(DUMMY_VK)
+        else:
+            norm_sigs.append(bytes(sigs[i]))
+            norm_vks.append(bytes(verkeys[i]))
+
+    sig_b = np.frombuffer(b"".join(norm_sigs), dtype=np.uint8).reshape(n, 64)
+    vk_b = np.frombuffer(b"".join(norm_vks), dtype=np.uint8).reshape(n, 32)
+    r_b = np.ascontiguousarray(sig_b[:, :32])
+    s_b = np.ascontiguousarray(sig_b[:, 32:])
+
+    asign = (vk_b[:, 31] >> 7).astype(np.int32)
+    rsign = (r_b[:, 31] >> 7).astype(np.int32)
+    ay_b = vk_b.copy()
+    ay_b[:, 31] &= 0x7F
+    ry_b = r_b.copy()
+    ry_b[:, 31] &= 0x7F
+
+    # canonicality: y < p, s < L (vectorized big-int compares)
+    bad = _ge_const(_le_words(ay_b), P) | _ge_const(_le_words(ry_b), P) \
+        | _ge_const(_le_words(s_b), L)
+    valid &= ~bad
+    if bad.any():
+        idx = np.nonzero(bad)[0]
+        ay_b[idx] = 0
+        ry_b[idx] = 0
+        ay_b[idx, 0] = 1
+        ry_b[idx, 0] = 1
+        s_b = s_b.copy()
+        s_b[idx] = 0
+
+    # k = SHA-512(R || A || M) mod L — hashlib + bigint mod are the only
+    # per-item C calls left
+    k_parts = []
+    for i in range(n):
         h = hashlib.sha512()
-        h.update(sig[:32])
-        h.update(vk)
+        h.update(norm_sigs[i][:32])
+        h.update(norm_vks[i])
         h.update(msgs[i])
         k_int = int.from_bytes(h.digest(), "little") % L
-        ay.append(ay_v)
-        asign.append(as_v)
-        ry.append(ry_v)
-        rsign.append(rs_v)
-        s_sc.append(s_int)
-        k_sc.append(k_int)
-    arrays = [_pack_fe(ay),
-              np.asarray(asign, np.int32),
-              _pack_fe(ry),
-              np.asarray(rsign, np.int32),
-              _pack_words(s_sc),
-              _pack_words(k_sc)]
+        k_parts.append(k_int.to_bytes(32, "little"))
+    k_b = np.frombuffer(b"".join(k_parts), dtype=np.uint8).reshape(n, 32)
+
+    arrays = [_limbs_from_bytes(ay_b),
+              asign,
+              _limbs_from_bytes(ry_b),
+              rsign,
+              np.ascontiguousarray(s_b).view(np.uint32).reshape(n, 8),
+              k_b.view(np.uint32).reshape(n, 8)]
     return arrays, valid
 
 
