@@ -76,11 +76,16 @@ class BatchExecutor(ABC):
 
     @abstractmethod
     def apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
-                    pp_time: int, pp_digest: str = "") -> Tuple[str, str, str]:
+                    pp_time: int, pp_digest: str = "",
+                    original_view_no: int = None) -> Tuple[str, str, str]:
         """Apply finalized requests (by digest) as one uncommitted batch.
         ``pp_digest`` is the PrePrepare digest binding the batch content —
         known to the ordering service at apply time, recorded in the audit
-        txn for recovery/audit provenance.
+        txn for recovery/audit provenance.  ``original_view_no`` is the
+        view the batch was FIRST proposed in — audit txns must record it
+        (not the current view) so re-applying an old-view PrePrepare after
+        a view change reproduces the identical audit root (reference
+        three_pc_batch.original_view_no + audit_batch_handler viewNo).
         → (state_root_b58, txn_root_b58, audit_root_b58)."""
 
     @abstractmethod
@@ -110,7 +115,8 @@ class SimExecutor(BatchExecutor):
         self.applied: List[Tuple] = []
         self.committed: List[Ordered] = []
 
-    def apply_batch(self, digests, ledger_id, pp_time, pp_digest=""):
+    def apply_batch(self, digests, ledger_id, pp_time, pp_digest="",
+                    original_view_no=None):
         from plenum_tpu.common.serializers.base58 import b58encode
         base = self.applied[-1][0] if self.applied else self.committed_root
         h = hashlib.sha256(
@@ -189,6 +195,10 @@ class OrderingService:
         # PRE-PREPAREs must apply sequentially or roots diverge
         self._last_applied_seq = 0
         self._first_batch_after_vc = False
+        # highest seq covered by the latest NEW_VIEW's batch set: the
+        # window in which PRE-PREPAREs at or below last_ordered may still
+        # be (re-)processed (reference prev_view_prepare_cert)
+        self._prev_view_prepare_cert = 0
 
     # ======================================================== properties
 
@@ -257,7 +267,8 @@ class OrderingService:
         pp_time = self._get_time()
         pp_digest = self.generate_pp_digest(digests, self.view_no, pp_time)
         state_root, txn_root, audit_root = self._executor.apply_batch(
-            digests, ledger_id, pp_time, pp_digest)
+            digests, ledger_id, pp_time, pp_digest,
+            original_view_no=self.view_no)
         params = dict(
             instId=self._data.inst_id,
             viewNo=self.view_no,
@@ -314,10 +325,20 @@ class OrderingService:
             self._raise_suspicion(frm, Suspicions.PPR_FRM_NON_PRIMARY,
                                   "PRE-PREPARE from non-primary", pp)
             return (DISCARD, "PRE-PREPARE from non-primary")
-        if self.is_master and pp.ppSeqNo > self._last_applied_seq + 1:
+        # A PRE-PREPARE for a seq this node already ordered is only
+        # acceptable during new-view re-ordering (the new primary
+        # re-broadcasts old-view batches; peers that ordered them in the
+        # old view must still vote so lagging peers reach quorum) — the
+        # reference's has_already_ordered path (ordering_service.py:826,
+        # 874 + msg_validator:140). Beyond the re-order window, discard.
+        already_ordered = pp.ppSeqNo <= self._data.last_ordered_3pc[1]
+        if already_ordered and pp.ppSeqNo > self._prev_view_prepare_cert:
+            return (DISCARD, "already ordered")
+        if self.is_master and not already_ordered \
+                and pp.ppSeqNo > self._last_applied_seq + 1:
             # must apply in sequence or state roots diverge
             return (STASH_WAITING_PREDECESSOR, "out-of-order PRE-PREPARE")
-        if self.is_master and not all(
+        if self.is_master and not already_ordered and not all(
                 self._executor.is_request_known(d) for d in pp.reqIdr):
             # normal reordering: our PROPAGATE quorum for one of the
             # requests hasn't completed yet — wait, don't crash/discard
@@ -352,10 +373,14 @@ class OrderingService:
                 self._raise_suspicion(
                     frm, Suspicions.PPR_BLS_MULTISIG_WRONG, err, pp)
                 return (DISCARD, "bad BLS in PRE-PREPARE")
-        # apply and compare roots (only the master executes batches)
-        if self.is_master:
+        # apply and compare roots (only the master executes batches, and
+        # only for batches not yet ordered — an already-ordered batch is
+        # in committed state; re-applying it would corrupt the roots)
+        if self.is_master and not already_ordered:
             state_root, txn_root, audit_root = self._executor.apply_batch(
-                list(pp.reqIdr), pp.ledgerId, pp.ppTime, pp.digest)
+                list(pp.reqIdr), pp.ledgerId, pp.ppTime, pp.digest,
+                original_view_no=pp.originalViewNo
+                if pp.originalViewNo is not None else pp.viewNo)
             if pp.stateRootHash is not None and state_root != pp.stateRootHash:
                 self._executor.revert_last_batch()
                 self._raise_suspicion(frm, Suspicions.PPR_STATE_WRONG,
@@ -376,7 +401,7 @@ class OrderingService:
         self.prePrepares[key] = pp
         self.batches[key] = pp
         self.lastPrePrepareSeqNo = max(self.lastPrePrepareSeqNo, pp.ppSeqNo)
-        if self.is_master:
+        if self.is_master and not already_ordered:
             self._last_applied_seq = pp.ppSeqNo
         self._consume_from_queue(pp)
         self._add_to_preprepared(pp)
@@ -620,11 +645,17 @@ class OrderingService:
         Re-application is strictly sequential: a missing old-view
         PrePrepare pauses everything after it until the reply arrives —
         applying out of order would diverge the uncommitted state."""
-        pending = sorted(
-            (batch_id_from(b) for b in msg.batches
-             if batch_id_from(b).pp_seq_no > self._data.last_ordered_3pc[1]),
-            key=lambda b: b.pp_seq_no)
+        # ALL batches in the NEW_VIEW re-enter 3PC — including ones this
+        # node already ordered in the old view: it must still register
+        # them and vote PREPARE/COMMIT so peers that had NOT ordered them
+        # can reach quorum in the new view (reference processes every
+        # NEW_VIEW batch through process_preprepare; has_already_ordered
+        # only skips apply/execute, ordering_service.py:826,874).
+        pending = sorted((batch_id_from(b) for b in msg.batches),
+                         key=lambda b: b.pp_seq_no)
         self._new_view_bids_to_reorder = list(pending)
+        self._prev_view_prepare_cert = max(
+            (b.pp_seq_no for b in pending), default=0)
         missing = [b for b in pending if self.old_view_preprepares.get(
             (b.pp_view_no, b.pp_seq_no, b.pp_digest)) is None]
         if missing:
@@ -672,12 +703,14 @@ class OrderingService:
         params["originalViewNo"] = bid.pp_view_no
         pp = PrePrepare(**params)
         key = (pp.viewNo, pp.ppSeqNo)
-        if self.is_master:
+        already_ordered = pp.ppSeqNo <= self._data.last_ordered_3pc[1]
+        if self.is_master and not already_ordered:
             if pp.stateRootHash is None or pp.txnRootHash is None:
                 self._discard_bad_old_view_pp(bid, "missing root hashes")
                 return False
             state_root, txn_root, audit_root = self._executor.apply_batch(
-                list(pp.reqIdr), pp.ledgerId, pp.ppTime, pp.digest)
+                list(pp.reqIdr), pp.ledgerId, pp.ppTime, pp.digest,
+                original_view_no=bid.pp_view_no)
             if (state_root != pp.stateRootHash
                     or txn_root != pp.txnRootHash
                     or (pp.auditTxnRootHash is not None

@@ -116,10 +116,16 @@ class AuditBatchHandler(BatchRequestHandler):
         return committed[0] if committed else None
 
     def _create_audit_txn(self, batch: ThreePcBatch) -> dict:
-        """reference audit_batch_handler.py:83 _create_audit_txn_data"""
+        """reference audit_batch_handler.py:83 _create_audit_txn_data.
+
+        Every field must depend only on batch-original data (original
+        view, primaries of the ORIGINAL view, pp digest, roots) so that
+        re-applying the same old-view PrePrepare after a view change
+        yields a bit-identical audit txn — the re-apply root comparison
+        in the ordering service depends on it."""
         txn = init_empty_txn(AUDIT_TXN)
         data = get_payload_data(txn)
-        data[AUDIT_TXN_VIEW_NO] = batch.view_no
+        data[AUDIT_TXN_VIEW_NO] = batch.original_view_no
         data[AUDIT_TXN_PP_SEQ_NO] = batch.pp_seq_no
         data[AUDIT_TXN_DIGEST] = batch.pp_digest
         sizes, ledger_roots, state_roots = {}, {}, {}
@@ -136,10 +142,47 @@ class AuditBatchHandler(BatchRequestHandler):
         data[AUDIT_TXN_LEDGERS_SIZE] = sizes
         data[AUDIT_TXN_LEDGER_ROOT] = ledger_roots
         data[AUDIT_TXN_STATE_ROOT] = state_roots
-        data[AUDIT_TXN_PRIMARIES] = batch.primaries
+        data[AUDIT_TXN_PRIMARIES] = self._fill_primaries(batch)
         if batch.node_reg is not None:
             data[AUDIT_TXN_NODE_REG] = batch.node_reg
         return txn
+
+    def _fill_primaries(self, batch: ThreePcBatch):
+        """Delta-encode primaries (reference _fill_primaries): store the
+        list only when it changed; otherwise an int = how many audit txns
+        back the last stored list is. Keeps every steady-state audit txn
+        identical in shape AND lets recovery resolve primaries at any
+        seq_no."""
+        last_seq = self.ledger.uncommitted_size
+        last_txn = self.ledger.get_by_seq_no_uncommitted(last_seq) \
+            if last_seq else None
+        if last_txn is None:
+            return batch.primaries
+        last_value = get_payload_data(last_txn).get(AUDIT_TXN_PRIMARIES)
+        if isinstance(last_value, int):
+            anchor_seq = last_seq - last_value
+            anchor = self.ledger.get_by_seq_no_uncommitted(anchor_seq)
+            anchor_primaries = get_payload_data(anchor).get(
+                AUDIT_TXN_PRIMARIES) if anchor else None
+            if anchor_primaries == batch.primaries:
+                return last_value + 1
+            return batch.primaries
+        if last_value == batch.primaries:
+            return 1
+        return batch.primaries
+
+    def primaries_at(self, seq_no: int):
+        """Resolve the primaries list effective at audit seq_no (follows
+        the delta chain) — recovery/catchup helper."""
+        txn = self.ledger.get_by_seq_no_uncommitted(seq_no)
+        if txn is None:
+            return None
+        value = get_payload_data(txn).get(AUDIT_TXN_PRIMARIES)
+        if isinstance(value, int):
+            anchor = self.ledger.get_by_seq_no_uncommitted(seq_no - value)
+            return get_payload_data(anchor).get(AUDIT_TXN_PRIMARIES) \
+                if anchor else None
+        return value
 
     def audit_root_for_pre_prepare(self) -> str:
         return self.ledger.hashToStr(self.ledger.uncommitted_root_hash)

@@ -42,20 +42,27 @@ CATCHUP_LEDGER_ORDER = [AUDIT_LEDGER_ID, POOL_LEDGER_ID, CONFIG_LEDGER_ID,
 class SeederService:
     """Answers peers' catchup questions from our committed ledgers."""
 
-    def __init__(self, db_manager, network, name: str = "?"):
+    def __init__(self, db_manager, network, name: str = "?",
+                 view_source: Callable[[], Tuple[int, int]] = None):
+        """view_source() → (view_no, last_ordered_pp_seq_no): stamped on
+        responses so a rejoining node can adopt the POOL's current view —
+        the audit ledger alone records only original (pre-view-change)
+        views (reference: LedgerStatus carries viewNo/ppSeqNo)."""
         self._db = db_manager
         self._network = network
         self.name = name
+        self._view_source = view_source or (lambda: (0, 0))
         network.subscribe(LedgerStatus, self.process_ledger_status)
         network.subscribe(CatchupReq, self.process_catchup_req)
 
     def _own_status(self, lid: int) -> LedgerStatus:
-        # viewNo=0 marks this as a RESPONSE: seeders only answer
+        # a non-None viewNo marks this as a RESPONSE: seeders only answer
         # solicitations (viewNo None), so two up-to-date peers can never
         # ping-pong statuses at each other forever
         ledger = self._db.get_ledger(lid)
+        view_no, pp_seq_no = self._view_source()
         return LedgerStatus(ledgerId=lid, txnSeqNo=ledger.size,
-                            viewNo=0, ppSeqNo=None,
+                            viewNo=view_no, ppSeqNo=pp_seq_no,
                             merkleRoot=ledger.root_hash,
                             protocolVersion=2)
 
@@ -94,9 +101,10 @@ class SeederService:
             logger.warning("%s cannot build consistency proof %s..%s",
                            self.name, start, end)
             return None
+        view_no, pp_seq_no = self._view_source()
         return ConsistencyProof(
             ledgerId=lid, seqNoStart=start, seqNoEnd=end,
-            viewNo=None, ppSeqNo=None,
+            viewNo=view_no, ppSeqNo=pp_seq_no,
             oldMerkleRoot=old_root, newMerkleRoot=ledger.root_hash,
             hashes=hashes)
 
@@ -130,7 +138,12 @@ class LedgerLeecher:
                  quorums_source: Callable[[], Quorums],
                  on_txn: Callable[[int, dict], None],
                  on_done: Callable[[int], None],
-                 config: Optional[Config] = None):
+                 config: Optional[Config] = None,
+                 view_tracker: Optional[Dict[str, int]] = None):
+        # peer → highest view_no that peer has reported (shared across
+        # ledgers by NodeLeecherService; feeds pool_view_estimate)
+        self._view_tracker = view_tracker if view_tracker is not None \
+            else {}
         self.lid = lid
         self._db = db_manager
         self._network = network
@@ -193,6 +206,9 @@ class LedgerLeecher:
     def process_ledger_status(self, status: LedgerStatus, frm: str):
         if self.state != LeecherState.SYNCING or status.ledgerId != self.lid:
             return
+        if status.viewNo is not None:
+            self._view_tracker[frm] = max(
+                self._view_tracker.get(frm, 0), status.viewNo)
         ledger = self.ledger
         # "same" means same size AND same root — an equal-size peer with a
         # different root is divergence, not agreement
@@ -206,6 +222,9 @@ class LedgerLeecher:
     def process_consistency_proof(self, proof: ConsistencyProof, frm: str):
         if self.state != LeecherState.SYNCING or proof.ledgerId != self.lid:
             return
+        if proof.viewNo is not None:
+            self._view_tracker[frm] = max(
+                self._view_tracker.get(frm, 0), proof.viewNo)
         if proof.seqNoStart != self.ledger.size:
             return
         key = (proof.seqNoStart, proof.seqNoEnd, proof.newMerkleRoot)
@@ -305,6 +324,9 @@ class NodeLeecherService:
         self._on_finished = on_finished
         self.name = name
         self.in_progress = False
+        self._quorums = quorums_source
+        # peer → highest view reported in any status/proof this catchup
+        self._view_tracker: Dict[str, int] = {}
         self._leechers: Dict[int, LedgerLeecher] = {}
         for lid in CATCHUP_LEDGER_ORDER:
             if self._db.get_ledger(lid) is None:
@@ -312,7 +334,7 @@ class NodeLeecherService:
             self._leechers[lid] = LedgerLeecher(
                 lid, db_manager, network, timer, quorums_source,
                 on_txn=on_catchup_txn, on_done=self._on_ledger_done,
-                config=config)
+                config=config, view_tracker=self._view_tracker)
         self._order = [lid for lid in CATCHUP_LEDGER_ORDER
                        if lid in self._leechers]
         self._current = 0
@@ -349,7 +371,21 @@ class NodeLeecherService:
             return
         self.in_progress = True
         self._current = 0
+        self._view_tracker.clear()
         self._start_current()
+
+    def pool_view_estimate(self) -> Optional[int]:
+        """The pool's current view as evidenced by peers during this
+        catchup: the (f+1)-th largest reported view — at least one honest
+        peer has reached it. None until f+1 peers have reported. Needed
+        because audit txns record each batch's ORIGINAL view, so a node
+        rejoining after a view change that only re-ordered old-view
+        batches cannot learn the new view from the audit ledger alone."""
+        views = sorted(self._view_tracker.values(), reverse=True)
+        f = self._quorums().f
+        if len(views) < f + 1:
+            return None
+        return views[f]
 
     def _start_current(self):
         active = self._active()

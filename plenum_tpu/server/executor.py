@@ -24,17 +24,21 @@ class NodeBatchExecutor(BatchExecutor):
     def __init__(self, write_manager: WriteRequestManager,
                  requests_source: Callable[[str], Optional[Request]],
                  get_view_no: Callable[[], int] = None,
-                 get_primaries: Callable[[], List[str]] = None,
+                 primaries_for_view: Callable[[int], List[str]] = None,
                  get_pp_seq_no: Callable[[], int] = None,
                  on_batch_committed: Callable = None):
         """requests_source(digest) → Request (the propagator's store).
         get_pp_seq_no() → seq of the batch being applied NOW (the
         ordering service's apply position + 1) — must survive catchup
-        fast-forwards and view changes, so it cannot be a local counter."""
+        fast-forwards and view changes, so it cannot be a local counter.
+        primaries_for_view(view_no) → primaries of that view — keyed by
+        the batch's ORIGINAL view so re-applied batches reproduce the
+        same audit txn (reference PrimaryBatchHandler.post_batch_applied
+        selects primaries from three_pc_batch.original_view_no)."""
         self.write_manager = write_manager
         self._requests_source = requests_source
         self._get_view_no = get_view_no or (lambda: 0)
-        self._get_primaries = get_primaries or (lambda: [])
+        self._primaries_for_view = primaries_for_view or (lambda v: [])
         self._get_pp_seq_no = get_pp_seq_no
         self._pp_seq_no = 0
         self._on_batch_committed = on_batch_committed
@@ -48,7 +52,8 @@ class NodeBatchExecutor(BatchExecutor):
     # -------------------------------------------------------------- apply
 
     def apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
-                    pp_time: int, pp_digest: str = "") -> Tuple[str, str, str]:
+                    pp_time: int, pp_digest: str = "",
+                    original_view_no: int = None) -> Tuple[str, str, str]:
         ledger = self.db.get_ledger(ledger_id)
         state = self.db.get_state(ledger_id)
         valid = []
@@ -71,17 +76,20 @@ class NodeBatchExecutor(BatchExecutor):
             self._pp_seq_no += 1
         state_root = ledger.hashToStr(state.headHash) if state else ""
         txn_root = ledger.hashToStr(ledger.uncommitted_root_hash)
+        view_no = self._get_view_no()
+        ov = original_view_no if original_view_no is not None else view_no
         batch = ThreePcBatch(
             ledger_id=ledger_id,
             inst_id=0,
-            view_no=self._get_view_no(),
+            view_no=view_no,
             pp_seq_no=self._pp_seq_no,
             pp_time=pp_time,
             state_root=state_root,
             txn_root=txn_root,
             valid_digests=valid,
             pp_digest=pp_digest,
-            primaries=self._get_primaries(),
+            primaries=self._primaries_for_view(ov),
+            original_view_no=ov,
         )
         self.write_manager.post_apply_batch(batch)
         self._staged.append(batch)

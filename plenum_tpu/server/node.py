@@ -120,11 +120,16 @@ class Node:
         self._req_clients: Dict[str, str] = {}
 
         # ---- consensus replica (master instance)
+        from plenum_tpu.consensus.primary_selector import (
+            RoundRobinConstantNodesPrimariesSelector)
+        self._primary_selector = RoundRobinConstantNodesPrimariesSelector(
+            validators)
         self.executor = NodeBatchExecutor(
             self.write_manager,
             requests_source=self._get_finalised_request,
             get_view_no=lambda: self.replica.view_no,
-            get_primaries=lambda: [self.replica.data.primary_name or ""],
+            primaries_for_view=lambda v: [
+                self._primary_selector.select_master_primary(v)],
             get_pp_seq_no=lambda:
                 self.replica.ordering._last_applied_seq + 1,
             on_batch_committed=self._on_batch_committed)
@@ -183,7 +188,10 @@ class Node:
             NeedMasterCatchup)
         from plenum_tpu.server.catchup import (
             NodeLeecherService, SeederService)
-        self.seeder = SeederService(self.db_manager, network, name=name)
+        self.seeder = SeederService(
+            self.db_manager, network, name=name,
+            view_source=lambda: (self.replica.view_no,
+                                 self.replica.data.last_ordered_3pc[1]))
         self.leecher = NodeLeecherService(
             self.db_manager, network, timer,
             quorums_source=lambda: self.replica.data.quorums,
@@ -391,6 +399,15 @@ class Node:
         logger.info("%s starting catchup", self.name)
         self.mode_participating = False
         self.replica.data.node_mode_participating = False
+        # uncommitted work must go before catchup txns land on the
+        # ledgers (reference preLedgerCatchUp: replicas revert unordered
+        # batches); the pool's committed history is authoritative
+        reverted = self.executor.revert_unordered_batches()
+        if reverted:
+            logger.info("%s reverted %d uncommitted batches for catchup",
+                        self.name, reverted)
+            self.replica.ordering._last_applied_seq = \
+                self.replica.data.last_ordered_3pc[1]
         self.leecher.start()
 
     def _on_catchup_txn(self, ledger_id: int, txn: dict):
@@ -416,25 +433,28 @@ class Node:
         (reference allLedgersCaughtUp node.py:1790)."""
         audit = self.db_manager.get_ledger(AUDIT_LEDGER_ID)
         last_audit = audit.get_last_txn()
+        # audit txns record each batch's ORIGINAL view (stable under
+        # re-ordering), so the pool's CURRENT view must come from peer
+        # evidence gathered during catchup (f+1-supported estimate)
+        view_no, pp_seq_no = 0, 0
         if last_audit is not None:
             data = get_payload_data(last_audit)
             view_no = data.get("viewNo", 0)
             pp_seq_no = data.get("ppSeqNo", 0)
-            current = self.replica.data.last_ordered_3pc
-            if (view_no, pp_seq_no) > current:
-                self.replica.data.last_ordered_3pc = (view_no, pp_seq_no)
-                self.replica.data.view_no = view_no
-                self.replica.ordering.lastPrePrepareSeqNo = pp_seq_no
-                self.replica.ordering._last_applied_seq = pp_seq_no
-                self.replica.checkpointer.caught_up_till_3pc(
-                    (view_no, pp_seq_no))
-                # primary for the adopted view
-                from plenum_tpu.consensus.primary_selector import (
-                    RoundRobinConstantNodesPrimariesSelector)
-                selector = RoundRobinConstantNodesPrimariesSelector(
-                    self.replica.data.validators)
-                self.replica.data.primary_name = \
-                    selector.select_master_primary(view_no)
+        pool_view = self.leecher.pool_view_estimate()
+        if pool_view is not None:
+            view_no = max(view_no, pool_view)
+        current = self.replica.data.last_ordered_3pc
+        if (view_no, pp_seq_no) > current:
+            pp_seq_no = max(pp_seq_no, current[1])
+            self.replica.data.last_ordered_3pc = (view_no, pp_seq_no)
+            self.replica.data.view_no = view_no
+            self.replica.ordering.lastPrePrepareSeqNo = pp_seq_no
+            self.replica.ordering._last_applied_seq = pp_seq_no
+            self.replica.checkpointer.caught_up_till_3pc(
+                (view_no, pp_seq_no))
+            self.replica.data.primary_name = \
+                self._primary_selector.select_master_primary(view_no)
         self.mode_participating = True
         self.replica.data.node_mode_participating = True
         self.replica.ordering.on_catchup_finished()

@@ -78,6 +78,7 @@ class SimNetwork:
         self._max_latency = max_latency
         self._serde = serialize_deserialize
         self._buses: Dict[str, ExternalBus] = {}
+        self._down: set = set()
         self.processors: List[Processor] = []
         self.sent_count = 0
 
@@ -94,6 +95,31 @@ class SimNetwork:
                 other.update_connecteds(other.connecteds | {name})
         bus.update_connecteds(set(p for p in self._buses if p != name))
         return bus
+
+    def disconnect(self, name: str):
+        """Take a peer down: its traffic stops both ways and every other
+        peer sees an ExternalBus.Disconnected event (reference
+        onConnsChanged node.py:1169 trigger side)."""
+        self._down.add(name)
+        for peer, bus in self._buses.items():
+            if peer != name:
+                bus.update_connecteds(bus.connecteds - {name})
+        me = self._buses.get(name)
+        if me is not None:
+            me.update_connecteds(set())
+
+    def reconnect(self, name: str):
+        """Bring a downed peer back; still-up peers see Connected events
+        (peers that are themselves down stay fully isolated)."""
+        self._down.discard(name)
+        for peer, bus in self._buses.items():
+            if peer != name and peer not in self._down:
+                bus.update_connecteds(bus.connecteds | {name})
+        me = self._buses.get(name)
+        if me is not None:
+            me.update_connecteds(
+                set(p for p in self._buses if p != name and
+                    p not in self._down))
 
     def add_processor(self, processor: Processor):
         self.processors.append(processor)
@@ -117,7 +143,7 @@ class SimNetwork:
             else:
                 dsts = list(dst)
             for d in dsts:
-                if d == frm:
+                if d == frm or d in self._down or frm in self._down:
                     continue
                 self.sent_count += 1
                 msg = PendingMessage(message, frm, d)
@@ -130,7 +156,7 @@ class SimNetwork:
         delay = self._random.float(self._min_latency, self._max_latency)
         def deliver():
             bus = self._buses.get(msg.dst)
-            if bus is None:
+            if bus is None or msg.dst in self._down or msg.frm in self._down:
                 return
             payload = msg.message
             if self._serde is not None:

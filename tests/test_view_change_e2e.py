@@ -1,0 +1,246 @@
+"""View change over the REAL pipeline (VERDICT round-1 item 10): full
+Nodes with real ledgers, MPT state, audit ledger — not SimExecutor.
+
+Covers the risky interaction the reference needed 73 integration files
+for (plenum/test/view_change/): killing the primary mid-stream, the
+prepared-but-unordered batch being reverted and re-ordered in the new
+view with identical state roots on every node, and seeded message-loss
+fuzz at this rung.
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import DOMAIN_LEDGER_ID, NYM
+from plenum_tpu.common.messages.node_messages import (
+    Commit, MessageRep, Reply)
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.mock_timer import MockTimer
+from plenum_tpu.testing.sim_network import Discard, SimNetwork
+
+from tests.test_node_e2e import (
+    ClientSink, NAMES, SIM_EPOCH, pump, signed_nym_request, submit_to_all)
+
+
+@pytest.fixture
+def pool(mock_timer):
+    """4 real nodes with a fast view-change config: primary-disconnect
+    tolerance of 4s so tests stay quick under MockTimer."""
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(101))
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15, ToleratePrimaryDisconnection=4,
+                  NEW_VIEW_TIMEOUT=8)
+    sinks, nodes = {}, []
+    for name in NAMES:
+        sink = ClientSink()
+        sinks[name] = sink
+        nodes.append(Node(name, NAMES, mock_timer, net.create_peer(name),
+                          config=conf, client_reply_handler=sink))
+    return nodes, sinks, net, mock_timer
+
+
+def live_roots_agree(nodes):
+    domain = {n.domain_ledger.root_hash for n in nodes}
+    audit = {n.audit_ledger.root_hash for n in nodes}
+    state = {n.write_manager.request_handlers[NYM].state.committedHeadHash
+             for n in nodes}
+    return len(domain) == 1 and len(audit) == 1 and len(state) == 1
+
+
+def test_kill_primary_view_change_resumes_ordering(pool):
+    """Primary dies → disconnect monitor votes → view change → new
+    primary orders new txns; live nodes converge on identical roots."""
+    nodes, sinks, net, timer = pool
+    # order a few txns in view 0 first
+    clients = [SimpleSigner(seed=bytes([70 + i]) * 32) for i in range(3)]
+    for i, c in enumerate(clients):
+        submit_to_all(nodes, signed_nym_request(c, req_id=300 + i))
+    pump(timer, nodes, 6)
+    assert all(n.last_ordered[1] >= 1 for n in nodes)
+    primary = next(n for n in nodes if n.replica.data.is_primary)
+    assert primary.name == nodes[0].master_primary_name
+
+    # kill it
+    net.disconnect(primary.name)
+    live = [n for n in nodes if n is not primary]
+    pump(timer, live, 20)   # > ToleratePrimaryDisconnection + VC time
+    for n in live:
+        assert n.view_no == 1, (n.name, n.view_no)
+        assert not n.replica.data.waiting_for_new_view
+        assert n.master_primary_name != primary.name
+
+    # ordering resumes in the new view over the real pipeline
+    before = live[0].domain_ledger.size
+    newcomers = [SimpleSigner(seed=bytes([90 + i]) * 32) for i in range(2)]
+    for i, c in enumerate(newcomers):
+        for n in live:
+            n.process_client_request(
+                dict(signed_nym_request(c, req_id=400 + i)), "late-client")
+    pump(timer, live, 10)
+    assert all(n.domain_ledger.size == before + 2 for n in live)
+    assert live_roots_agree(live)
+    # clients got replies from every live node
+    for n in live:
+        assert len(sinks[n.name].of_type(Reply)) >= 2
+
+
+def test_prepared_batch_reordered_with_identical_roots(pool):
+    """The hard case: a batch is applied+prepared (uncommitted txns and
+    MPT head moved) but COMMITs are blocked; the view change must revert
+    the uncommitted batch, then re-apply it from the old-view PrePrepare
+    in view 1 and commit — with every node reaching the same committed
+    roots (reference NewViewBuilder.calc_batches + re-ordering)."""
+    nodes, sinks, net, timer = pool
+    client = SimpleSigner(seed=b"\x5a" * 32)
+    blocker = Discard(DefaultSimRandom(0), probability=1.1,
+                      message_types=[Commit, MessageRep])
+    net.add_processor(blocker)
+    submit_to_all(nodes, signed_nym_request(client, req_id=500))
+    pump(timer, nodes, 5)
+    assert all(n.last_ordered[1] == 0 for n in nodes)
+    assert any(n.replica.data.prepared for n in nodes)
+    # uncommitted work is staged on at least the nodes that pre-prepared
+    assert all(n.domain_ledger.size == 0 for n in nodes)
+
+    net.remove_processor(blocker)
+    for n in nodes:
+        n.replica.start_view_change()
+    pump(timer, nodes, 15)
+    for n in nodes:
+        assert n.view_no == 1, (n.name, n.view_no)
+        assert n.last_ordered[1] >= 1, n.name
+        assert n.domain_ledger.size == 1
+    assert live_roots_agree(nodes)
+    # the re-ordered txn is committed and replied
+    for name in NAMES:
+        replies = sinks[name].of_type(Reply)
+        assert len(replies) == 1
+        assert replies[0].result["txnMetadata"]["seqNo"] == 1
+
+
+def test_view_change_under_seeded_message_loss(pool):
+    """Seeded 15% loss fuzz at the real-pipeline rung: the pool still
+    completes the view change and keeps ordering (MessageReq self-heal +
+    re-sends)."""
+    nodes, sinks, net, timer = pool
+    lossy = Discard(DefaultSimRandom(202), probability=0.15)
+    net.add_processor(lossy)
+    clients = [SimpleSigner(seed=bytes([110 + i]) * 32) for i in range(4)]
+    for i, c in enumerate(clients):
+        submit_to_all(nodes, signed_nym_request(c, req_id=600 + i))
+    pump(timer, nodes, 12)
+    for n in nodes:
+        n.replica.start_view_change()
+    pump(timer, nodes, 25)
+    # more traffic in the new view
+    extra = [SimpleSigner(seed=bytes([120 + i]) * 32) for i in range(2)]
+    for i, c in enumerate(extra):
+        submit_to_all(nodes, signed_nym_request(c, req_id=700 + i))
+    pump(timer, nodes, 25)
+    assert all(n.view_no >= 1 for n in nodes)
+    sizes = {n.domain_ledger.size for n in nodes}
+    assert sizes == {6}, sizes
+    assert live_roots_agree(nodes)
+
+
+def test_rejoiner_adopts_pool_view_after_reorder_only_view_change(pool):
+    """The nasty case for view adoption: the view change ONLY re-orders
+    an old-view batch, so every audit txn records viewNo=0 (original
+    view). A node that slept through the VC must learn view 1 from peer
+    evidence during catchup (pool_view_estimate), not the audit ledger."""
+    nodes, sinks, net, timer = pool
+    client = SimpleSigner(seed=b"\x77" * 32)
+    blocker = Discard(DefaultSimRandom(0), probability=1.1,
+                      message_types=[Commit, MessageRep])
+    net.add_processor(blocker)
+    submit_to_all(nodes, signed_nym_request(client, req_id=900))
+    pump(timer, nodes, 5)
+    assert any(n.replica.data.prepared for n in nodes)
+    # Delta sleeps through the whole view change with a STAGED
+    # uncommitted batch
+    sleeper = nodes[3]
+    net.disconnect(sleeper.name)
+    net.remove_processor(blocker)
+    live = nodes[:3]
+    for n in live:
+        n.replica.start_view_change()
+    pump(timer, live, 15)
+    for n in live:
+        assert n.view_no == 1 and n.domain_ledger.size == 1, n.name
+    # the only audit txn records the ORIGINAL view
+    from plenum_tpu.common.txn_util import get_payload_data
+    assert get_payload_data(live[0].audit_ledger.getBySeqNo(1))["viewNo"] == 0
+
+    net.reconnect(sleeper.name)
+    sleeper.start_catchup()
+    pump(timer, nodes, 20)
+    assert sleeper.domain_ledger.size == 1
+    assert sleeper.view_no == 1, "must adopt the pool view from peers"
+    assert sleeper.master_primary_name == live[0].master_primary_name
+    assert live_roots_agree(nodes)
+    # and the rejoined node keeps ordering in the adopted view
+    c2 = SimpleSigner(seed=b"\x78" * 32)
+    submit_to_all(nodes, signed_nym_request(c2, req_id=901))
+    pump(timer, nodes, 10)
+    assert all(n.domain_ledger.size == 2 for n in nodes)
+    assert live_roots_agree(nodes)
+
+
+def test_audit_primaries_delta_resolution(pool):
+    """primaries are delta-encoded in audit txns; primaries_at follows
+    the chain back to the anchor list (recovery helper)."""
+    nodes, sinks, net, timer = pool
+    clients = [SimpleSigner(seed=bytes([130 + i]) * 32) for i in range(3)]
+    for i, c in enumerate(clients):
+        submit_to_all(nodes, signed_nym_request(c, req_id=950 + i))
+        pump(timer, nodes, 1.5)
+    pump(timer, nodes, 5)
+    node = nodes[0]
+    audit = node.audit_ledger
+    assert audit.size >= 2
+    from plenum_tpu.common.txn_util import get_payload_data
+    from plenum_tpu.server.batch_handlers import AuditBatchHandler
+    handler = next(
+        h for chain in node.write_manager.batch_handlers.values()
+        for h in chain if isinstance(h, AuditBatchHandler))
+    first = get_payload_data(audit.getBySeqNo(1))["primaries"]
+    assert isinstance(first, list) and first == [node.master_primary_name]
+    # later txns in the same view must be deltas, not repeated lists
+    later = get_payload_data(audit.getBySeqNo(audit.size))["primaries"]
+    assert isinstance(later, int)
+    # the chain resolves to the same primaries at every seq
+    for seq in range(1, audit.size + 1):
+        assert handler.primaries_at(seq) == first
+
+
+def test_rejoining_old_primary_catches_up(pool):
+    """The killed primary reconnects, sees it is behind, catches up via
+    the leecher, and resumes participating in the new view."""
+    nodes, sinks, net, timer = pool
+    client0 = SimpleSigner(seed=b"\x66" * 32)
+    submit_to_all(nodes, signed_nym_request(client0, req_id=800))
+    pump(timer, nodes, 6)
+    primary = next(n for n in nodes if n.replica.data.is_primary)
+    net.disconnect(primary.name)
+    live = [n for n in nodes if n is not primary]
+    pump(timer, live, 20)
+    assert all(n.view_no == 1 for n in live)
+    # pool makes progress without it
+    client1 = SimpleSigner(seed=b"\x67" * 32)
+    for n in live:
+        n.process_client_request(
+            dict(signed_nym_request(client1, req_id=801)), "c2")
+    pump(timer, live, 8)
+    target_size = live[0].domain_ledger.size
+    assert target_size == 2
+
+    # rejoin + explicit catchup (transport-level rejoin triggers this via
+    # ledger-status exchange; here we drive it directly)
+    net.reconnect(primary.name)
+    primary.start_catchup()
+    pump(timer, nodes, 20)
+    assert primary.domain_ledger.size == target_size
+    assert primary.view_no == 1
+    assert live_roots_agree(nodes)
