@@ -770,6 +770,27 @@ class OrderingService:
         self._reapply_ready_batches()
         return None
 
+    def prepare_for_catchup(self):
+        """Catchup is about to make the pool's committed history
+        authoritative: un-register ALL 3PC state above last_ordered (the
+        caller reverts the executor's uncommitted batches). Without this
+        a surviving PrePrepare could reach commit quorum after catchup
+        and 'order' with nothing staged — silently dropping its txns.
+        Un-ordered requests go back to the queues; if the pool did order
+        them, catchup + the dedup index neutralize the re-proposal."""
+        last = self._data.last_ordered_3pc[1]
+        for key, pp in list(self.prePrepares.items()) + \
+                list(self.sent_preprepares.items()):
+            if pp.ppSeqNo > last:
+                for digest in pp.reqIdr:
+                    self.add_finalized_request(digest, pp.ledgerId)
+        for store in (self.sent_preprepares, self.prePrepares,
+                      self.prepares, self.commits, self.batches):
+            for k in [k for k in store if k[1] > last]:
+                del store[k]
+        self.lastPrePrepareSeqNo = last
+        self._last_applied_seq = last
+
     # ====================================================== checkpoints
 
     def process_checkpoint_stabilized(self, msg: CheckpointStabilized):

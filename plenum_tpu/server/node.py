@@ -114,8 +114,12 @@ class Node:
         self.req_authenticator = ReqAuthenticator()
         self.req_authenticator.register_authenticator(self.authnr)
 
-        # ---- dedup index: payload_digest → (ledger_id, seqNo)
-        self.seq_no_db = KeyValueStorageInMemory()
+        # ---- dedup index: payload_digest → (ledger_id, seqNo); rides the
+        # same storage factory as the ledgers so it survives restarts
+        # (reference loadSeqNoDB node.py:698)
+        self.seq_no_db = (storage_factory or
+                          (lambda _name: KeyValueStorageInMemory()))(
+                              "seq_no_db")
         # digest → client id awaiting reply
         self._req_clients: Dict[str, str] = {}
 
@@ -202,9 +206,14 @@ class Node:
             NeedMasterCatchup, lambda msg: self.start_catchup())
         self.mode_participating = True
 
-        # ---- genesis
-        if genesis_txns:
+        # ---- genesis (skipped on restart: the persisted ledgers already
+        # contain it) + restart recovery from persisted stores
+        if genesis_txns and all(
+                self.db_manager.get_ledger(lid).size == 0
+                for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID,
+                            CONFIG_LEDGER_ID)):
             self._load_genesis(genesis_txns)
+        self._recover_from_storage()
 
     # ========================================================== genesis
 
@@ -222,6 +231,80 @@ class Node:
             handler.update_state(txn, None, None, is_committed=True)
             if handler.state is not None:
                 handler.state.commit()
+
+    # ========================================================== recovery
+
+    def _recover_from_storage(self):
+        """Node restart from persisted stores (reference node restart:
+        ledgers recoverTree on init, states re-derived from txn logs via
+        ledgers_bootstrap.upload_states, seqNoDB reload node.py:698,
+        3PC position from the audit ledger — SURVEY.md §5.4)."""
+        from plenum_tpu.common.txn_util import get_payload_digest, get_type
+        from plenum_tpu.state.trie import BLANK_ROOT
+        for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID):
+            ledger = self.db_manager.get_ledger(lid)
+            state = self.db_manager.get_state(lid)
+            if ledger.size == 0 or state is None:
+                continue
+            if state.committedHeadHash != BLANK_ROOT:
+                continue  # state store survived; trie is intact
+            # state store lost/empty but ledger has history: replay
+            logger.info("%s rebuilding state for ledger %d from %d txns",
+                        self.name, lid, ledger.size)
+            for _, txn in ledger.getAllTxn():
+                handler = self.write_manager.request_handlers.get(
+                    get_type(txn))
+                if handler is not None and handler.ledger_id == lid:
+                    handler.update_state(txn, None, None, is_committed=True)
+            state.commit()
+        # dedup index: backfill any entry the ledgers have that the index
+        # lacks — a crash between the (separate) ledger and index stores
+        # can lose individual puts, not just the whole index
+        for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID):
+            ledger = self.db_manager.get_ledger(lid)
+            for seq, txn in ledger.getAllTxn():
+                payload_digest = get_payload_digest(txn)
+                if not payload_digest:
+                    continue
+                try:
+                    self.seq_no_db.get(payload_digest.encode())
+                except KeyError:
+                    self.seq_no_db.put(
+                        payload_digest.encode(),
+                        "{}:{}".format(lid, seq).encode())
+        self._adopt_3pc_from_audit()
+        # a node with committed history must re-sync with the pool before
+        # voting again: its persisted view is each batch's ORIGINAL view,
+        # which can lag the pool's current view (catchup gathers f+1 peer
+        # evidence via pool_view_estimate). Fresh-genesis nodes (empty
+        # audit) participate immediately.
+        if self.db_manager.get_ledger(AUDIT_LEDGER_ID).size > 0:
+            self.start_catchup()
+
+    def _adopt_3pc_from_audit(self, pool_view: Optional[int] = None):
+        """Fast-forward the replica to the audit ledger's last recorded
+        3PC position (floor: the audit view is the batch's ORIGINAL view;
+        `pool_view` — peer evidence from catchup — can raise it)."""
+        audit = self.db_manager.get_ledger(AUDIT_LEDGER_ID)
+        last_audit = audit.get_last_txn()
+        view_no, pp_seq_no = 0, 0
+        if last_audit is not None:
+            data = get_payload_data(last_audit)
+            view_no = data.get("viewNo", 0)
+            pp_seq_no = data.get("ppSeqNo", 0)
+        if pool_view is not None:
+            view_no = max(view_no, pool_view)
+        current = self.replica.data.last_ordered_3pc
+        if (view_no, pp_seq_no) <= current:
+            return
+        pp_seq_no = max(pp_seq_no, current[1])
+        self.replica.data.last_ordered_3pc = (view_no, pp_seq_no)
+        self.replica.data.view_no = view_no
+        self.replica.ordering.lastPrePrepareSeqNo = pp_seq_no
+        self.replica.ordering._last_applied_seq = pp_seq_no
+        self.replica.checkpointer.caught_up_till_3pc((view_no, pp_seq_no))
+        self.replica.data.primary_name = \
+            self._primary_selector.select_master_primary(view_no)
 
     # ===================================================== client intake
 
@@ -406,8 +489,7 @@ class Node:
         if reverted:
             logger.info("%s reverted %d uncommitted batches for catchup",
                         self.name, reverted)
-            self.replica.ordering._last_applied_seq = \
-                self.replica.data.last_ordered_3pc[1]
+        self.replica.ordering.prepare_for_catchup()
         self.leecher.start()
 
     def _on_catchup_txn(self, ledger_id: int, txn: dict):
@@ -431,30 +513,11 @@ class Node:
     def _on_catchup_finished(self):
         """Adopt 3PC position from the audit ledger, resume participating
         (reference allLedgersCaughtUp node.py:1790)."""
-        audit = self.db_manager.get_ledger(AUDIT_LEDGER_ID)
-        last_audit = audit.get_last_txn()
         # audit txns record each batch's ORIGINAL view (stable under
-        # re-ordering), so the pool's CURRENT view must come from peer
+        # re-ordering), so the pool's CURRENT view comes from peer
         # evidence gathered during catchup (f+1-supported estimate)
-        view_no, pp_seq_no = 0, 0
-        if last_audit is not None:
-            data = get_payload_data(last_audit)
-            view_no = data.get("viewNo", 0)
-            pp_seq_no = data.get("ppSeqNo", 0)
-        pool_view = self.leecher.pool_view_estimate()
-        if pool_view is not None:
-            view_no = max(view_no, pool_view)
-        current = self.replica.data.last_ordered_3pc
-        if (view_no, pp_seq_no) > current:
-            pp_seq_no = max(pp_seq_no, current[1])
-            self.replica.data.last_ordered_3pc = (view_no, pp_seq_no)
-            self.replica.data.view_no = view_no
-            self.replica.ordering.lastPrePrepareSeqNo = pp_seq_no
-            self.replica.ordering._last_applied_seq = pp_seq_no
-            self.replica.checkpointer.caught_up_till_3pc(
-                (view_no, pp_seq_no))
-            self.replica.data.primary_name = \
-                self._primary_selector.select_master_primary(view_no)
+        self._adopt_3pc_from_audit(
+            pool_view=self.leecher.pool_view_estimate())
         self.mode_participating = True
         self.replica.data.node_mode_participating = True
         self.replica.ordering.on_catchup_finished()

@@ -96,6 +96,13 @@ class SimNetwork:
         bus.update_connecteds(set(p for p in self._buses if p != name))
         return bus
 
+    def remove_peer(self, name: str):
+        """Forget a peer entirely so a restarted node can create_peer
+        under the same name (node restart in tests)."""
+        self.disconnect(name)
+        self._buses.pop(name, None)
+        self._down.discard(name)
+
     def disconnect(self, name: str):
         """Take a peer down: its traffic stops both ways and every other
         peer sees an ExternalBus.Disconnected event (reference

@@ -1,0 +1,154 @@
+"""Node restart from durable storage (VERDICT round-1 item 9): stop a
+node mid-stream, build a NEW Node over the same on-disk stores, and show
+it recovers ledgers (recoverTree), MPT state, the dedup index, and its
+3PC position — then catches up the missed suffix and resumes ordering.
+
+Reference: ledger/ledger.py:70 recoverTree,
+plenum/server/ledgers_bootstrap.py upload_states, node.py:698 loadSeqNoDB.
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.common.messages.node_messages import Reply
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.storage.kv_file import KeyValueStorageFile
+from plenum_tpu.testing.sim_network import SimNetwork
+
+from tests.test_node_e2e import (
+    ClientSink, NAMES, SIM_EPOCH, pump, signed_nym_request, submit_to_all)
+
+CONF = dict(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=5,
+            LOG_SIZE=15, ToleratePrimaryDisconnection=4, NEW_VIEW_TIMEOUT=8)
+
+
+def file_factory(base_dir, node_name):
+    return lambda store_name: KeyValueStorageFile(
+        str(base_dir / node_name), store_name)
+
+
+def build_node(name, net, timer, base_dir, sink):
+    return Node(name, NAMES, timer, net.create_peer(name),
+                config=Config(**CONF),
+                storage_factory=file_factory(base_dir, name),
+                client_reply_handler=sink)
+
+
+@pytest.fixture
+def durable_pool(mock_timer, tmp_path):
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(404))
+    sinks = {name: ClientSink() for name in NAMES}
+    nodes = [build_node(name, net, mock_timer, tmp_path, sinks[name])
+             for name in NAMES]
+    return nodes, sinks, net, mock_timer, tmp_path
+
+
+def test_restart_recovers_and_resumes(durable_pool):
+    nodes, sinks, net, timer, base = durable_pool
+    clients = [SimpleSigner(seed=bytes([10 + i]) * 32) for i in range(3)]
+    for i, c in enumerate(clients):
+        submit_to_all(nodes, signed_nym_request(c, req_id=i))
+        pump(timer, nodes, 1.5)
+    pump(timer, nodes, 5)
+    assert all(n.domain_ledger.size == 3 for n in nodes)
+    expected_root = nodes[0].domain_ledger.root_hash
+    nym_state_root = nodes[0].write_manager.request_handlers[NYM] \
+        .state.committedHeadHash
+
+    # stop Delta (drop the object entirely; its stores stay on disk)
+    victim_name = NAMES[3]
+    net.remove_peer(victim_name)
+    nodes = nodes[:3]
+
+    # pool keeps ordering without it
+    late = [SimpleSigner(seed=bytes([30 + i]) * 32) for i in range(2)]
+    for i, c in enumerate(late):
+        submit_to_all(nodes, signed_nym_request(c, req_id=100 + i))
+    pump(timer, nodes, 8)
+    assert all(n.domain_ledger.size == 5 for n in nodes)
+
+    # restart Delta from disk: a brand-new Node over the same stores
+    sink = ClientSink()
+    restarted = build_node(victim_name, net, timer, base, sink)
+    # recovery before any network traffic: ledgers + state + position
+    assert restarted.domain_ledger.size == 3
+    assert restarted.domain_ledger.root_hash == expected_root
+    assert restarted.write_manager.request_handlers[NYM] \
+        .state.committedHeadHash == nym_state_root
+    assert restarted.last_ordered[1] >= 1
+    # dedup index recovered: a replayed old request answers from ledger
+    old_req = signed_nym_request(clients[0], req_id=0)
+    restarted.process_client_request(dict(old_req), "replayer")
+    replies = sink.of_type(Reply)
+    assert len(replies) == 1 and \
+        replies[0].result["txnMetadata"]["seqNo"] == 1
+
+    # catch up the missed suffix and rejoin ordering
+    all_nodes = nodes + [restarted]
+    restarted.start_catchup()
+    pump(timer, all_nodes, 15)
+    assert restarted.domain_ledger.size == 5
+    assert restarted.domain_ledger.root_hash == \
+        nodes[0].domain_ledger.root_hash
+
+    fresh = SimpleSigner(seed=b"\x55" * 32)
+    submit_to_all(all_nodes, signed_nym_request(fresh, req_id=200))
+    pump(timer, all_nodes, 8)
+    assert all(n.domain_ledger.size == 6 for n in all_nodes)
+    assert len({n.audit_ledger.root_hash for n in all_nodes}) == 1
+
+
+def test_restart_rebuilds_state_from_ledger_when_state_store_lost(
+        durable_pool):
+    """Losing only the state store is survivable: the trie is re-derived
+    from the txn log (reference upload_states)."""
+    import shutil
+    nodes, sinks, net, timer, base = durable_pool
+    client = SimpleSigner(seed=b"\x44" * 32)
+    submit_to_all(nodes, signed_nym_request(client, req_id=1))
+    pump(timer, nodes, 6)
+    assert all(n.domain_ledger.size == 1 for n in nodes)
+    state_root = nodes[3].write_manager.request_handlers[NYM] \
+        .state.committedHeadHash
+
+    victim_name = NAMES[3]
+    net.remove_peer(victim_name)
+    # delete ONLY the domain state store file
+    (base / victim_name / "domain_state.kvlog").unlink()
+
+    restarted = build_node(victim_name, net, timer, base, ClientSink())
+    assert restarted.domain_ledger.size == 1
+    assert restarted.write_manager.request_handlers[NYM] \
+        .state.committedHeadHash == state_root
+
+
+def test_whole_pool_restart(durable_pool):
+    """Every node stops and restarts from disk; the pool resumes
+    ordering with no catchup needed (identical persisted histories)."""
+    nodes, sinks, net, timer, base = durable_pool
+    clients = [SimpleSigner(seed=bytes([80 + i]) * 32) for i in range(2)]
+    for i, c in enumerate(clients):
+        submit_to_all(nodes, signed_nym_request(c, req_id=i))
+    pump(timer, nodes, 8)
+    assert all(n.domain_ledger.size == 2 for n in nodes)
+    root_before = nodes[0].domain_ledger.root_hash
+
+    for name in NAMES:
+        net.remove_peer(name)
+    sinks2 = {name: ClientSink() for name in NAMES}
+    restarted = [build_node(name, net, timer, base, sinks2[name])
+                 for name in NAMES]
+    assert all(n.domain_ledger.size == 2 for n in restarted)
+    assert all(n.domain_ledger.root_hash == root_before for n in restarted)
+    assert all(n.last_ordered[1] >= 1 for n in restarted)
+
+    fresh = SimpleSigner(seed=b"\x66" * 32)
+    submit_to_all(restarted, signed_nym_request(fresh, req_id=50))
+    pump(timer, restarted, 10)
+    assert all(n.domain_ledger.size == 3 for n in restarted)
+    assert len({n.domain_ledger.root_hash for n in restarted}) == 1
+    for name in NAMES:
+        assert len(sinks2[name].of_type(Reply)) == 1
