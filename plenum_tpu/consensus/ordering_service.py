@@ -788,6 +788,12 @@ class OrderingService:
                       self.prepares, self.commits, self.batches):
             for k in [k for k in store if k[1] > last]:
                 del store[k]
+        # the dropped batches must not be advertised as prepared evidence
+        # in a later VIEW_CHANGE — nobody could supply their PrePrepares
+        self._data.preprepared = [b for b in self._data.preprepared
+                                  if b.pp_seq_no <= last]
+        self._data.prepared = [b for b in self._data.prepared
+                               if b.pp_seq_no <= last]
         self.lastPrePrepareSeqNo = last
         self._last_applied_seq = last
 

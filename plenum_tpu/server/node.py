@@ -94,7 +94,8 @@ class Node:
                  storage_factory=None,
                  client_reply_handler: Callable[[str, object], None] = None,
                  bls_bft_replica=None,
-                 genesis_txns: Optional[List[dict]] = None):
+                 genesis_txns: Optional[List[dict]] = None,
+                 on_membership_change: Callable[[List[str]], None] = None):
         """network: ExternalBus to peers; client_reply_handler(client_id,
         msg) delivers Acks/Nacks/Replies back to clients."""
         self.name = name
@@ -108,12 +109,32 @@ class Node:
         self.write_manager, self.read_manager = \
             NodeBootstrap.init_managers(self.db_manager)
 
+        # ---- genesis (skipped on restart: the persisted ledgers already
+        # contain it) — must precede membership derivation, which reads
+        # the pool ledger
+        if genesis_txns and all(
+                self.db_manager.get_ledger(lid).size == 0
+                for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID,
+                            CONFIG_LEDGER_ID)):
+            self._load_genesis(genesis_txns)
+
+        # ---- live pool membership (reference TxnPoolManager): the ctor
+        # list seeds the registry; committed NODE txns evolve it
+        from plenum_tpu.server.pool_manager import TxnPoolManager
+        self.pool_manager = TxnPoolManager(
+            validators, self.db_manager,
+            on_change=self._on_validators_changed)
+        self._on_membership_change = on_membership_change
+        validators = self.pool_manager.validators
+
         # ---- client authentication (TPU-batched seam)
         self.authnr = CoreAuthNr(
             verkey_provider=self._verkey_from_domain_state)
         self.req_authenticator = ReqAuthenticator()
         self.req_authenticator.register_authenticator(self.authnr)
 
+        # requests rejected at (speculative) apply, freed on stable chk
+        self._rejected_digests: set = set()
         # ---- dedup index: payload_digest → (ledger_id, seqNo); rides the
         # same storage factory as the ledgers so it survives restarts
         # (reference loadSeqNoDB node.py:698)
@@ -136,7 +157,8 @@ class Node:
                 self._primary_selector.select_master_primary(v)],
             get_pp_seq_no=lambda:
                 self.replica.ordering._last_applied_seq + 1,
-            on_batch_committed=self._on_batch_committed)
+            on_batch_committed=self._on_batch_committed,
+            on_request_rejected=self._on_request_rejected)
         self.replica = ReplicaService(
             name, validators, timer, network, executor=self.executor,
             config=self.config, bls_bft_replica=bls_bft_replica,
@@ -171,6 +193,10 @@ class Node:
             config=self.config)
         self.replica.internal_bus.subscribe(
             NewViewAccepted, lambda msg: self.monitor.reset())
+        from plenum_tpu.common.messages.internal_messages import (
+            CheckpointStabilized)
+        self.replica.internal_bus.subscribe(
+            CheckpointStabilized, self._gc_rejected)
 
         def _check_master_degraded():
             if self.mode_participating and self.monitor.is_master_degraded():
@@ -206,13 +232,7 @@ class Node:
             NeedMasterCatchup, lambda msg: self.start_catchup())
         self.mode_participating = True
 
-        # ---- genesis (skipped on restart: the persisted ledgers already
-        # contain it) + restart recovery from persisted stores
-        if genesis_txns and all(
-                self.db_manager.get_ledger(lid).size == 0
-                for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID,
-                            CONFIG_LEDGER_ID)):
-            self._load_genesis(genesis_txns)
+        # ---- restart recovery from persisted stores
         self._recover_from_storage()
 
     # ========================================================== genesis
@@ -232,6 +252,42 @@ class Node:
             if handler.state is not None:
                 handler.state.commit()
 
+    # ================================================== pool membership
+
+    def _on_validators_changed(self, new_validators: List[str]):
+        """A committed NODE txn changed pool membership: re-derive
+        quorums/f on every protocol instance, adjust the backup instance
+        count, update primary selectors (future views only — the current
+        primary never silently moves), reconnect the transport, and vote
+        a view change if the current primary was demoted (reference
+        pool_manager.py + adjustReplicas node.py:1260)."""
+        from plenum_tpu.common.messages.internal_messages import (
+            VoteForViewChange)
+        for replica in self.replicas:
+            replica.data.set_validators(new_validators)
+            replica.selector.validators[:] = new_validators
+        self._primary_selector.validators[:] = new_validators
+        self.replicas.adjust_replicas(new_validators)
+        self.propagator.update_quorums(self.replica.data.quorums)
+        if self._on_membership_change is not None:
+            self._on_membership_change(new_validators)
+        if self.name not in new_validators:
+            logger.info("%s demoted from the pool — stops participating",
+                        self.name)
+            self.mode_participating = False
+            self.replica.data.node_mode_participating = False
+            return
+        if not self.mode_participating and not self.leecher.in_progress:
+            # re-promoted
+            self.mode_participating = True
+            self.replica.data.node_mode_participating = True
+        primary = self.replica.data.primary_name
+        if primary is not None and primary not in new_validators:
+            logger.info("%s: primary %s demoted — voting view change",
+                        self.name, primary)
+            self.replica.internal_bus.send(
+                VoteForViewChange(suspicion="PRIMARY_DEMOTED"))
+
     # ========================================================== recovery
 
     def _recover_from_storage(self):
@@ -241,27 +297,50 @@ class Node:
         3PC position from the audit ledger — SURVEY.md §5.4)."""
         from plenum_tpu.common.txn_util import get_payload_digest, get_type
         from plenum_tpu.state.trie import BLANK_ROOT
+        expected_roots = self._audit_state_roots()
         for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID):
             ledger = self.db_manager.get_ledger(lid)
             state = self.db_manager.get_state(lid)
             if ledger.size == 0 or state is None:
                 continue
-            if state.committedHeadHash != BLANK_ROOT:
-                continue  # state store survived; trie is intact
-            # state store lost/empty but ledger has history: replay
+            expected = expected_roots.get(lid)
+            if state.committedHeadHash != BLANK_ROOT and (
+                    expected is None
+                    or state.committedHeadHash == expected):
+                continue  # state store survived and matches the audit
+            # state store lost, or STALE (crash between the ledger flush
+            # and the state-root commit): replay the txn log from scratch
             logger.info("%s rebuilding state for ledger %d from %d txns",
                         self.name, lid, ledger.size)
+            state.revertToHead(BLANK_ROOT)
             for _, txn in ledger.getAllTxn():
                 handler = self.write_manager.request_handlers.get(
                     get_type(txn))
                 if handler is not None and handler.ledger_id == lid:
                     handler.update_state(txn, None, None, is_committed=True)
             state.commit()
-        # dedup index: backfill any entry the ledgers have that the index
+            if expected is not None and \
+                    state.committedHeadHash != expected:
+                logger.warning(
+                    "%s ledger %d state root %s still differs from audit "
+                    "record after rebuild", self.name, lid,
+                    state.committedHeadHash_b58)
+        # dedup index: backfill entries the ledgers have that the index
         # lacks — a crash between the (separate) ledger and index stores
-        # can lose individual puts, not just the whole index
+        # can lose individual puts, not just the whole index. Fast path:
+        # if each ledger's LAST txn is indexed, the tail is intact and
+        # the O(ledger) scan is skipped.
         for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID):
             ledger = self.db_manager.get_ledger(lid)
+            if ledger.size == 0:
+                continue
+            last_digest = get_payload_digest(ledger.get_last_txn())
+            if last_digest:
+                try:
+                    self.seq_no_db.get(last_digest.encode())
+                    continue
+                except KeyError:
+                    pass
             for seq, txn in ledger.getAllTxn():
                 payload_digest = get_payload_digest(txn)
                 if not payload_digest:
@@ -280,6 +359,23 @@ class Node:
         # audit) participate immediately.
         if self.db_manager.get_ledger(AUDIT_LEDGER_ID).size > 0:
             self.start_catchup()
+
+    def _audit_state_roots(self) -> Dict[int, bytes]:
+        """ledger_id → expected committed state root from the last audit
+        txn (every audit txn records all current state roots)."""
+        audit = self.db_manager.get_ledger(AUDIT_LEDGER_ID)
+        last = audit.get_last_txn()
+        if last is None:
+            return {}
+        from plenum_tpu.ledger.ledger import Ledger
+        roots = {}
+        for lid_str, root_b58 in (
+                get_payload_data(last).get("stateRoot") or {}).items():
+            try:
+                roots[int(lid_str)] = Ledger.strToHash(root_b58)
+            except Exception:
+                continue
+        return roots
 
     def _adopt_3pc_from_audit(self, pool_view: Optional[int] = None):
         """Fast-forward the replica to the audit ledger's last recorded
@@ -417,8 +513,10 @@ class Node:
     # ================================================ propagation → 3PC
 
     def _forward_finalised(self, request: Request):
-        lid = self.write_manager.type_to_ledger_id(request.txn_type) \
-            or DOMAIN_LEDGER_ID
+        # POOL_LEDGER_ID is 0 — `or` would misroute NODE txns to domain
+        lid = self.write_manager.type_to_ledger_id(request.txn_type)
+        if lid is None:
+            lid = DOMAIN_LEDGER_ID
         self.replicas.submit_request(request.key, lid)
 
     def _get_finalised_request(self, digest: str) -> Optional[Request]:
@@ -447,6 +545,7 @@ class Node:
             digest = get_digest(txn)
             if digest:
                 self.monitor.request_ordered(digest, ordered.instId)
+                self._rejected_digests.discard(digest)
             client_id = self._req_clients.pop(digest, None)
             if client_id is not None:
                 result = dict(txn)
@@ -457,6 +556,35 @@ class Node:
                 self._reply_to_client(client_id, Reply(result=result))
             if digest:
                 self.propagator.requests.free(digest)
+        if ordered.ledgerId == POOL_LEDGER_ID:
+            for txn in committed_txns or []:
+                self.pool_manager.process_committed_txn(txn)
+
+    def _on_request_rejected(self, digest: str, reason: str):
+        """A request failed dynamic validation at apply time: tell the
+        waiting client (reference: Reject from _apply_pre_prepare
+        rejects). Apply is SPECULATIVE (uncommitted) — a view-change
+        re-order can still commit this request later, so the client
+        mapping and the in-flight entry survive until the batch that
+        excluded it reaches a stable checkpoint (_gc_rejected)."""
+        if digest in self._rejected_digests:
+            return
+        self._rejected_digests.add(digest)
+        request = self._get_finalised_request(digest)
+        client_id = self._req_clients.get(digest)
+        if client_id is not None and request is not None:
+            self._reply_to_client(client_id, Reject(
+                identifier=request.identifier or "unknown",
+                reqId=request.reqId or 0, reason=reason))
+
+    def _gc_rejected(self, msg):
+        """Stable checkpoint: rejected requests below it can never be
+        re-ordered — free their in-flight state so client retries get
+        answered instead of being swallowed by the propagator dedup."""
+        for digest in self._rejected_digests:
+            self._req_clients.pop(digest, None)
+            self.propagator.requests.free(digest)
+        self._rejected_digests.clear()
 
     def _committed_reply(self, request: Request) -> Optional[Reply]:
         try:
@@ -509,6 +637,8 @@ class Node:
             seq_no = get_seq_no(txn)
             self.seq_no_db.put(payload_digest.encode(),
                                "{}:{}".format(ledger_id, seq_no).encode())
+        if ledger_id == POOL_LEDGER_ID:
+            self.pool_manager.process_committed_txn(txn)
 
     def _on_catchup_finished(self):
         """Adopt 3PC position from the audit ledger, resume participating
@@ -518,6 +648,12 @@ class Node:
         # evidence gathered during catchup (f+1-supported estimate)
         self._adopt_3pc_from_audit(
             pool_view=self.leecher.pool_view_estimate())
+        if self.name not in self.pool_manager.validators:
+            # catchup may have delivered our own demotion — a
+            # non-validator must not resume voting
+            logger.info("%s not a validator after catchup — staying "
+                        "passive", self.name)
+            return
         self.mode_participating = True
         self.replica.data.node_mode_participating = True
         self.replica.ordering.on_catchup_finished()

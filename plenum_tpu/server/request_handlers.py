@@ -19,7 +19,7 @@ from typing import Optional
 
 from plenum_tpu.common.constants import (
     DATA, DOMAIN_LEDGER_ID, GET_TXN, NODE, NYM, POOL_LEDGER_ID, ROLE,
-    STEWARD, TARGET_NYM, TRUSTEE, TXN_TYPE, VERKEY)
+    SERVICES, STEWARD, TARGET_NYM, TRUSTEE, TXN_TYPE, VALIDATOR, VERKEY)
 from plenum_tpu.common.exceptions import (
     InvalidClientRequest, UnauthorizedClientRequest)
 from plenum_tpu.common.request import Request
@@ -185,6 +185,14 @@ class NodeHandler(WriteRequestHandler):
         if not isinstance(data, dict) or not data.get("alias"):
             raise InvalidClientRequest(request.identifier, request.reqId,
                                        "NODE data must include alias")
+        services = data.get(SERVICES)
+        if services is not None and (
+                not isinstance(services, list)
+                or any(s != VALIDATOR for s in services)):
+            raise InvalidClientRequest(
+                request.identifier, request.reqId,
+                "services must be a list drawn from ['{}']".format(
+                    VALIDATOR))
 
     def dynamic_validation(self, request: Request, req_pp_time=None):
         op = request.operation

@@ -1,0 +1,195 @@
+"""Live pool membership (VERDICT round-1 item 8): committed NODE txns
+reconfigure the RUNNING pool — validators, quorums/f, backup instance
+count, primary selection — and a 5th node joins via catchup and
+participates in ordering.
+
+Reference: plenum/server/pool_manager.py (TxnPoolManager),
+plenum/server/node.py:1260 (adjustReplicas).
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    ALIAS, DATA, NODE, NYM, ROLE, SERVICES, STEWARD, TARGET_NYM, VALIDATOR,
+    VERKEY)
+from plenum_tpu.common.messages.node_messages import Reply, RequestNack
+from plenum_tpu.common.txn_util import (
+    get_payload_data, init_empty_txn)
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.mock_timer import MockTimer
+from plenum_tpu.testing.sim_network import SimNetwork
+
+from tests.test_node_e2e import (
+    ClientSink, NAMES, SIM_EPOCH, pump, signed_nym_request, submit_to_all)
+
+CONF = dict(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=5,
+            LOG_SIZE=15, ToleratePrimaryDisconnection=4, NEW_VIEW_TIMEOUT=8)
+
+STEWARDS = [SimpleSigner(seed=bytes([200 + i]) * 32) for i in range(4)]
+
+
+def genesis_txns():
+    """One steward NYM per node (genesis-style, unsigned envelopes)."""
+    txns = []
+    for steward in STEWARDS:
+        txn = init_empty_txn(NYM)
+        get_payload_data(txn).update({
+            TARGET_NYM: steward.identifier,
+            VERKEY: steward.verkey,
+            ROLE: STEWARD,
+        })
+        txns.append(txn)
+    return txns
+
+
+def signed_node_request(steward, alias, services, req_id=1,
+                        dest="node-key-"):
+    req = {
+        "identifier": steward.identifier,
+        "reqId": req_id,
+        "protocolVersion": 2,
+        "operation": {"type": NODE, TARGET_NYM: dest + alias,
+                      DATA: {ALIAS: alias, SERVICES: services}},
+    }
+    req["signature"] = steward.sign(dict(req))
+    return req
+
+
+def build_node(name, names, net, timer, sink):
+    return Node(name, names, timer, net.create_peer(name),
+                config=Config(**CONF), client_reply_handler=sink,
+                genesis_txns=genesis_txns())
+
+
+@pytest.fixture
+def pool(mock_timer):
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(808))
+    sinks = {name: ClientSink() for name in NAMES}
+    nodes = [build_node(name, NAMES, net, mock_timer, sinks[name])
+             for name in NAMES]
+    return nodes, sinks, net, mock_timer
+
+
+def test_add_fifth_node_live(pool):
+    nodes, sinks, net, timer = pool
+    # sanity: pool orders with genesis stewards in place
+    client = SimpleSigner(seed=b"\x31" * 32)
+    submit_to_all(nodes, signed_nym_request(client, req_id=1))
+    pump(timer, nodes, 6)
+    assert all(n.domain_ledger.size == 5 for n in nodes)  # 4 genesis + 1
+    assert all(n.replica.data.quorums.n == 4 for n in nodes)
+
+    # a steward adds Epsilon as a VALIDATOR
+    req = signed_node_request(STEWARDS[0], "Epsilon", [VALIDATOR],
+                              req_id=2)
+    submit_to_all(nodes, req)
+    pump(timer, nodes, 6)
+    for n in nodes:
+        assert n.pool_manager.validators == NAMES + ["Epsilon"], n.name
+        assert n.replica.data.quorums.n == 5
+        assert n.propagator.quorums.n == 5
+
+    # Epsilon joins: syncs history via catchup, then participates
+    sink = ClientSink()
+    epsilon = build_node("Epsilon", NAMES + ["Epsilon"], net, timer, sink)
+    epsilon.start_catchup()
+    all_nodes = nodes + [epsilon]
+    pump(timer, all_nodes, 15)
+    assert epsilon.domain_ledger.size == 5
+    assert epsilon.pool_manager.validators == NAMES + ["Epsilon"]
+
+    late = SimpleSigner(seed=b"\x32" * 32)
+    submit_to_all(all_nodes, signed_nym_request(late, req_id=3))
+    pump(timer, all_nodes, 8)
+    # quorums n=5 ⇒ commit needs 4 — Epsilon's votes count
+    assert all(n.domain_ledger.size == 6 for n in all_nodes)
+    assert len({n.domain_ledger.root_hash for n in all_nodes}) == 1
+    assert len({n.audit_ledger.root_hash for n in all_nodes}) == 1
+    assert len(sink.of_type(Reply)) == 1
+
+
+def test_demote_validator_shrinks_pool(pool):
+    nodes, sinks, net, timer = pool
+    # add Delta's NODE record first so it can be demoted (Delta is in
+    # the ctor seed; demotion needs a NODE txn flipping its services)
+    req = signed_node_request(STEWARDS[1], "Delta", [], req_id=10)
+    submit_to_all(nodes, req)
+    pump(timer, nodes, 6)
+    for n in nodes:
+        assert n.pool_manager.validators == NAMES[:3], n.name
+        assert n.replica.data.quorums.n == 3
+    # the demoted node stops participating
+    assert nodes[3].mode_participating is False
+    # remaining 3 keep ordering (f=0, commit quorum 3)
+    client = SimpleSigner(seed=b"\x33" * 32)
+    live = nodes[:3]
+    for n in live:
+        n.process_client_request(dict(signed_nym_request(client, req_id=11)),
+                                 "cli")
+    pump(timer, live, 8)
+    assert all(n.domain_ledger.size >= 1 for n in live)
+    assert len({n.domain_ledger.root_hash for n in live}) == 1
+
+
+def test_demoting_primary_triggers_view_change(pool):
+    nodes, sinks, net, timer = pool
+    primary_name = nodes[0].master_primary_name
+    assert primary_name == "Alpha"
+    req = signed_node_request(STEWARDS[2], "Alpha", [], req_id=20)
+    submit_to_all(nodes, req)
+    pump(timer, nodes, 15)
+    live = [n for n in nodes if n.name != "Alpha"]
+    for n in live:
+        assert n.view_no >= 1, (n.name, n.view_no)
+        assert n.master_primary_name != "Alpha"
+    # ordering continues under the new primary with n=3 quorums
+    client = SimpleSigner(seed=b"\x34" * 32)
+    for n in live:
+        n.process_client_request(dict(signed_nym_request(client, req_id=21)),
+                                 "cli")
+    pump(timer, live, 8)
+    assert all(n.domain_ledger.size >= 1 for n in live)
+    assert len({n.domain_ledger.root_hash for n in live}) == 1
+
+
+def test_non_steward_cannot_add_node(pool):
+    nodes, sinks, net, timer = pool
+    rando = SimpleSigner(seed=b"\x35" * 32)
+    # rando self-registers a plain nym first (so the signature verifies)
+    submit_to_all(nodes, signed_nym_request(rando, req_id=30))
+    pump(timer, nodes, 6)
+    req = signed_node_request(rando, "Mallory", [VALIDATOR], req_id=31)
+    nodes[0].process_client_request(dict(req), "mallory")
+    pump(timer, nodes, 5)
+    assert all(n.pool_manager.validators == NAMES for n in nodes)
+    nacks = sinks["Alpha"].of_type(RequestNack)
+    rejects = [m for m in sinks["Alpha"].messages
+               if "STEWARD" in str(getattr(m[1], "reason", ""))]
+    assert nacks or rejects
+
+
+def test_backup_instances_follow_f(pool):
+    """n=4 → f=1 → 2 instances; growing the registry to 7 validators
+    raises f to 2 → 3 instances (adjustReplicas)."""
+    nodes, sinks, net, timer = pool
+    node = nodes[0]
+    assert node.replicas.num_instances == 2
+    # registry applied directly on all nodes (unit-level check of
+    # adjustReplicas; the steward authz rule is covered above)
+    for alias in ["Eta", "Theta", "Iota"]:
+        for n in nodes:
+            n.pool_manager.process_committed_txn(_node_txn(alias))
+    assert node.replicas.num_instances == 3
+    assert node.replica.data.quorums.n == 7
+
+
+def _node_txn(alias):
+    txn = init_empty_txn(NODE)
+    get_payload_data(txn).update({
+        TARGET_NYM: "k-" + alias,
+        DATA: {ALIAS: alias, SERVICES: [VALIDATOR]},
+    })
+    return txn

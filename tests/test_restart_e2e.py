@@ -125,6 +125,36 @@ def test_restart_rebuilds_state_from_ledger_when_state_store_lost(
         .state.committedHeadHash == state_root
 
 
+def test_restart_rebuilds_stale_state_store(durable_pool):
+    """Crash between the ledger flush and the state-root commit leaves a
+    valid-looking but STALE state store; recovery must detect the
+    audit-root mismatch and replay (review finding: BLANK_ROOT check
+    alone misses this)."""
+    import shutil
+    nodes, sinks, net, timer, base = durable_pool
+    c1 = SimpleSigner(seed=b"\x48" * 32)
+    submit_to_all(nodes, signed_nym_request(c1, req_id=1))
+    pump(timer, nodes, 6)
+    victim_name = NAMES[3]
+    state_file = base / victim_name / "domain_state.kvlog"
+    snapshot = state_file.read_bytes()  # state as of txn 1
+
+    c2 = SimpleSigner(seed=b"\x49" * 32)
+    submit_to_all(nodes, signed_nym_request(c2, req_id=2))
+    pump(timer, nodes, 6)
+    assert all(n.domain_ledger.size == 2 for n in nodes)
+    good_root = nodes[3].write_manager.request_handlers[NYM] \
+        .state.committedHeadHash
+
+    net.remove_peer(victim_name)
+    state_file.write_bytes(snapshot)  # "crash" lost the txn-2 commit
+
+    restarted = build_node(victim_name, net, timer, base, ClientSink())
+    assert restarted.domain_ledger.size == 2
+    assert restarted.write_manager.request_handlers[NYM] \
+        .state.committedHeadHash == good_root
+
+
 def test_whole_pool_restart(durable_pool):
     """Every node stops and restarts from disk; the pool resumes
     ordering with no catchup needed (identical persisted histories)."""
