@@ -141,6 +141,47 @@ def micro_ed25519():
     return device_rate, openssl_rate, python_rate
 
 
+def micro_merkle(n_leaves=None):
+    """BASELINE config 4: 1M-leaf merkle build + audit-path batch on the
+    device-resident tree (ops/merkle.py: one fused jit for all levels,
+    gather kernel for proof batches) vs the hashlib (OpenSSL) scalar
+    floor on a smaller tree, normalized per leaf."""
+    from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from plenum_tpu.ledger.hash_store import MemoryHashStore
+    from plenum_tpu.ledger.tree_hasher import TreeHasher
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+
+    n_leaves = n_leaves or int(os.environ.get("BENCH_MERKLE_LEAVES",
+                                              str(1 << 20)))
+    # batched audit paths need a power-of-two tree: round down
+    n_leaves = max(2, 1 << (n_leaves.bit_length() - 1))
+    leaves = [b"txn-%020d" % i for i in range(n_leaves)]
+    dev = DeviceMerkleTree()
+    dev.build(leaves)  # compile + warm
+    t0 = time.perf_counter()
+    root = dev.build(leaves)
+    build_s = time.perf_counter() - t0
+    device_leaves_per_s = n_leaves / build_s
+
+    # audit-path batch: one gather + one download for 10k proofs
+    n_proofs = min(10000, n_leaves)
+    idx = list(range(0, n_leaves, max(1, n_leaves // n_proofs)))[:n_proofs]
+    dev.audit_path_batch(idx)  # compile gather
+    t0 = time.perf_counter()
+    paths = dev.audit_path_batch(idx)
+    proof_rate = len(idx) / (time.perf_counter() - t0)
+    assert dev.verify_path(leaves[idx[0]], idx[0], paths[0], root)
+
+    # hashlib floor on a smaller tree, normalized per leaf
+    n_floor = min(100000, n_leaves)
+    t0 = time.perf_counter()
+    floor_tree = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    for leaf in leaves[:n_floor]:
+        floor_tree.append(leaf)
+    floor_leaves_per_s = n_floor / (time.perf_counter() - t0)
+    return (n_leaves, device_leaves_per_s, proof_rate, floor_leaves_per_s)
+
+
 def main():
     from plenum_tpu.crypto.signer import SimpleSigner
 
@@ -161,6 +202,7 @@ def main():
     cpu_rate = cpu_ordered / cpu_elapsed
 
     device_rate, openssl_rate, python_rate = micro_ed25519()
+    mk_n, mk_rate, mk_proofs, mk_floor = micro_merkle()
 
     print(json.dumps({
         "metric": "ordered write-reqs/s, 4-node pool, TPU-batched verify"
@@ -181,6 +223,13 @@ def main():
                 "pure_python": round(python_rate, 1),
             },
             "vs_openssl_core": round(device_rate / openssl_rate, 2),
+            "merkle": {
+                "leaves": mk_n,
+                "build_leaves_per_s": round(mk_rate, 1),
+                "audit_paths_per_s": round(mk_proofs, 1),
+                "hashlib_floor_leaves_per_s": round(mk_floor, 1),
+                "vs_hashlib": round(mk_rate / mk_floor, 2),
+            },
         },
     }))
 

@@ -43,6 +43,10 @@ class Config:
     STATE_FRESHNESS_UPDATE_INTERVAL = 300
     ACCEPTABLE_DEVIATION_PREPREPARE_SECS = 300
 
+    # ---- merkle hashing (TreeHasher TPU seam, ledger/tree_hasher.py)
+    SHA256_BACKEND = "jax"       # "jax" (batched device kernel) | "scalar"
+    SHA256_BATCH_THRESHOLD = 512  # below this, hashlib wins on latency
+
     # ---- catchup
     CATCHUP_BATCH_SIZE = 5
     CATCHUP_TXN_TIMEOUT = 6

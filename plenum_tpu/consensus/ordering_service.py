@@ -313,7 +313,7 @@ class OrderingService:
     # ====================================================== PRE-PREPARE
 
     def process_preprepare(self, pp: PrePrepare, frm: str):
-        verdict = self._validate_3pc(pp)
+        verdict = self._validate_3pc(pp, frm)
         if verdict is not None:
             return verdict
         key = (pp.viewNo, pp.ppSeqNo)
@@ -447,7 +447,7 @@ class OrderingService:
     # ========================================================== PREPARE
 
     def process_prepare(self, prepare: Prepare, frm: str):
-        verdict = self._validate_3pc(prepare)
+        verdict = self._validate_3pc(prepare, frm)
         if verdict is not None:
             return verdict
         key = (prepare.viewNo, prepare.ppSeqNo)
@@ -501,7 +501,7 @@ class OrderingService:
     # =========================================================== COMMIT
 
     def process_commit(self, commit: Commit, frm: str):
-        verdict = self._validate_3pc(commit)
+        verdict = self._validate_3pc(commit, frm)
         if verdict is not None:
             return verdict
         key = (commit.viewNo, commit.ppSeqNo)
@@ -584,11 +584,15 @@ class OrderingService:
 
     # ======================================================= validation
 
-    def _validate_3pc(self, msg):
+    def _validate_3pc(self, msg, frm: str = None):
         """Common 3PC message validation verdicts (reference
         ordering_service_msg_validator.py)."""
         if msg.instId != self._data.inst_id:
             return (DISCARD, "wrong instance")
+        if frm is not None and frm not in self._data.validators:
+            # votes from non-members (e.g. a freshly demoted node whose
+            # instances keep running) must not count toward any quorum
+            return (DISCARD, "sender not a pool validator")
         if not self._data.node_mode_participating:
             return (STASH_CATCH_UP, "catching up")
         if msg.viewNo < self.view_no:

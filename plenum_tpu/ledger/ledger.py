@@ -64,11 +64,13 @@ class Ledger:
             self.recoverTreeFromTxnLog()
 
     def recoverTreeFromTxnLog(self):
+        """Bulk rebuild: one batched leaf-hash dispatch plus level-wise
+        node hashing through the TreeHasher TPU seam (reference
+        ledger.py:70 recoverTree rebuilds leaf-by-leaf on hashlib)."""
         self.tree.reset()
-        self.seqNo = 0
-        for _, value in self._store.iterator():
-            self.tree.append(bytes(value))
-            self.seqNo += 1
+        values = [bytes(v) for _, v in self._store.iterator()]
+        self.tree.extend(values)
+        self.seqNo = len(values)
 
     # ---------------------------------------------------------- commits
 

@@ -119,14 +119,26 @@ def pad_messages(msgs: Sequence[bytes], nblocks: int = None
             nblocks *= 2
     assert maxb <= nblocks
     out = np.zeros((len(msgs), nblocks * 64), dtype=np.uint8)
-    for i, m in enumerate(msgs):
-        ln = len(m)
-        out[i, :ln] = np.frombuffer(m, dtype=np.uint8)
-        out[i, ln] = 0x80
-        bitlen = ln * 8
-        end = need[i] * 64
-        out[i, end - 8:end] = np.frombuffer(
-            bitlen.to_bytes(8, "big"), dtype=np.uint8)
+    ln0 = len(msgs[0]) if msgs else 0
+    if msgs and all(len(m) == ln0 for m in msgs):
+        # uniform lengths (merkle node hashes, fixed-size leaves): one
+        # vectorized fill instead of a per-message Python loop — the
+        # host-side padding is the bottleneck at 1M-leaf scale
+        out[:, :ln0] = np.frombuffer(b"".join(msgs), dtype=np.uint8) \
+            .reshape(len(msgs), ln0)
+        out[:, ln0] = 0x80
+        end = need[0] * 64
+        out[:, end - 8:end] = np.frombuffer(
+            (ln0 * 8).to_bytes(8, "big"), dtype=np.uint8)
+    else:
+        for i, m in enumerate(msgs):
+            ln = len(m)
+            out[i, :ln] = np.frombuffer(m, dtype=np.uint8)
+            out[i, ln] = 0x80
+            bitlen = ln * 8
+            end = need[i] * 64
+            out[i, end - 8:end] = np.frombuffer(
+                bitlen.to_bytes(8, "big"), dtype=np.uint8)
     words = out.reshape(len(msgs), nblocks, 16, 4)
     words = (words[..., 0].astype(np.uint32) << 24
              | words[..., 1].astype(np.uint32) << 16
@@ -158,3 +170,15 @@ class JaxSha256Backend:
 
     def node_hashes(self, pairs: Sequence[Tuple[bytes, bytes]]) -> List[bytes]:
         return sha256_many([b"\x01" + l + r for l, r in pairs])
+
+
+_default_backend = None
+
+
+def get_default_backend() -> JaxSha256Backend:
+    """Process-wide backend so every ledger shares the compiled
+    executables (one per nblocks bucket)."""
+    global _default_backend
+    if _default_backend is None:
+        _default_backend = JaxSha256Backend()
+    return _default_backend

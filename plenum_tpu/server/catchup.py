@@ -290,9 +290,12 @@ class LedgerLeecher:
             return
         shadow = ledger.tree.copy_shadow()
         txns = [self._buffer[s] for s in range(start, self.target_size + 1)]
-        for txn in txns:
-            shadow._append_hash(ledger.hasher.hash_leaf(
-                ledger.serialize_for_tree(txn)))
+        # one batched device dispatch hashes the whole caught-up range
+        # (TreeHasher TPU seam) before the sequential frontier merge
+        leaf_hashes = ledger.hasher.hash_leaves(
+            [ledger.serialize_for_tree(txn) for txn in txns])
+        for leaf_hash in leaf_hashes:
+            shadow._append_hash(leaf_hash)
         got_root = Ledger.hashToStr(shadow.root_hash)
         if got_root != self.target_root:
             logger.warning("catchup root mismatch on ledger %s: got %s "

@@ -27,7 +27,8 @@ class NodeBatchExecutor(BatchExecutor):
                  primaries_for_view: Callable[[int], List[str]] = None,
                  get_pp_seq_no: Callable[[], int] = None,
                  on_batch_committed: Callable = None,
-                 on_request_rejected: Callable[[str, str], None] = None):
+                 on_request_rejected: Callable[[str, str, int],
+                                               None] = None):
         """requests_source(digest) → Request (the propagator's store).
         get_pp_seq_no() → seq of the batch being applied NOW (the
         ordering service's apply position + 1) — must survive catchup
@@ -43,7 +44,8 @@ class NodeBatchExecutor(BatchExecutor):
         self._get_pp_seq_no = get_pp_seq_no
         self._pp_seq_no = 0
         self._on_batch_committed = on_batch_committed
-        self._on_request_rejected = on_request_rejected or (lambda d, r: None)
+        self._on_request_rejected = on_request_rejected or \
+            (lambda d, r, s: None)
         # staged batches by apply order (mirrors write manager staging)
         self._staged: List[ThreePcBatch] = []
 
@@ -69,7 +71,9 @@ class NodeBatchExecutor(BatchExecutor):
             except Exception as e:
                 logger.info("request %s failed dynamic validation: %s",
                             digest, e)
-                self._on_request_rejected(digest, str(e))
+                seq = self._get_pp_seq_no() if self._get_pp_seq_no \
+                    else self._pp_seq_no + 1
+                self._on_request_rejected(digest, str(e), seq)
                 continue
             self.write_manager.apply_request(request, pp_time)
             valid.append(digest)

@@ -22,8 +22,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from plenum_tpu.common.config import Config
 from plenum_tpu.common.constants import (
-    AUDIT_LEDGER_ID, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID, GET_TXN, NYM,
-    POOL_LEDGER_ID, VERKEY)
+    AUDIT_LEDGER_ID, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID, GET_TXN, NODE,
+    NYM, POOL_LEDGER_ID, VERKEY)
 from plenum_tpu.common.exceptions import InvalidClientMessageException
 from plenum_tpu.common.messages.client_request import ClientMessageValidator
 from plenum_tpu.common.messages.node_messages import (
@@ -55,14 +55,31 @@ class NodeBootstrap:
     """Storage + handler registry init (reference node_bootstrap.py:17)."""
 
     @staticmethod
-    def init_storage(storage_factory=None) -> DatabaseManager:
+    def make_tree_hasher(config: Optional[Config] = None):
+        """TreeHasher wired to the batched JAX SHA-256 kernel above the
+        config threshold (the production path for bulk ledger recovery,
+        catchup verification and 1M-leaf proof batches — SURVEY §2.9
+        sha256 obligation); hashlib handles the scalar floor."""
+        from plenum_tpu.ledger.tree_hasher import TreeHasher
+        config = config or Config()
+        if config.SHA256_BACKEND != "jax":
+            return TreeHasher()
+        from plenum_tpu.ops.sha256 import get_default_backend
+        return TreeHasher(batch_backend=get_default_backend(),
+                          batch_threshold=config.SHA256_BATCH_THRESHOLD)
+
+    @staticmethod
+    def init_storage(storage_factory=None,
+                     config: Optional[Config] = None) -> DatabaseManager:
         make_kv = storage_factory or (lambda name: KeyValueStorageInMemory())
         dm = DatabaseManager()
         for lid, name in ((POOL_LEDGER_ID, "pool"),
                           (DOMAIN_LEDGER_ID, "domain"),
                           (CONFIG_LEDGER_ID, "config"),
                           (AUDIT_LEDGER_ID, "audit")):
-            ledger = Ledger(txn_store=make_kv(name + "_ledger"))
+            ledger = Ledger(txn_store=make_kv(name + "_ledger"),
+                            tree_hasher=NodeBootstrap.make_tree_hasher(
+                                config))
             state = None
             if lid != AUDIT_LEDGER_ID:
                 state = PruningState(make_kv(name + "_state"))
@@ -103,9 +120,13 @@ class Node:
         self.timer = timer
         self.network = network
         self._reply_to_client = client_reply_handler or (lambda c, m: None)
+        # without a client transport there is nobody to reply to — skip
+        # building Reply payloads (txn + b58 audit path) entirely
+        self._clients_attached = client_reply_handler is not None
 
         # ---- storage + execution pipeline
-        self.db_manager = NodeBootstrap.init_storage(storage_factory)
+        self.db_manager = NodeBootstrap.init_storage(storage_factory,
+                                                     self.config)
         self.write_manager, self.read_manager = \
             NodeBootstrap.init_managers(self.db_manager)
 
@@ -126,6 +147,12 @@ class Node:
             on_change=self._on_validators_changed)
         self._on_membership_change = on_membership_change
         validators = self.pool_manager.validators
+        # ctor-seeded validators have no pool-state NODE record; their
+        # aliases are reserved so a steward cannot hijack them
+        node_handler = self.write_manager.request_handlers.get(NODE)
+        if node_handler is not None:
+            node_handler.reserved_aliases = \
+                lambda: self.pool_manager.seed_aliases
 
         # ---- client authentication (TPU-batched seam)
         self.authnr = CoreAuthNr(
@@ -133,8 +160,9 @@ class Node:
         self.req_authenticator = ReqAuthenticator()
         self.req_authenticator.register_authenticator(self.authnr)
 
-        # requests rejected at (speculative) apply, freed on stable chk
-        self._rejected_digests: set = set()
+        # digest → pp_seq_no of the speculative batch that rejected it;
+        # freed once that batch is at or below a stable checkpoint
+        self._rejected_digests: Dict[str, int] = {}
         # ---- dedup index: payload_digest → (ledger_id, seqNo); rides the
         # same storage factory as the ledgers so it survives restarts
         # (reference loadSeqNoDB node.py:698)
@@ -153,8 +181,7 @@ class Node:
             self.write_manager,
             requests_source=self._get_finalised_request,
             get_view_no=lambda: self.replica.view_no,
-            primaries_for_view=lambda v: [
-                self._primary_selector.select_master_primary(v)],
+            primaries_for_view=self._primaries_for_batch,
             get_pp_seq_no=lambda:
                 self.replica.ordering._last_applied_seq + 1,
             on_batch_committed=self._on_batch_committed,
@@ -275,12 +302,15 @@ class Node:
             logger.info("%s demoted from the pool — stops participating",
                         self.name)
             self.mode_participating = False
-            self.replica.data.node_mode_participating = False
+            for replica in self.replicas:  # backups must stop voting too
+                replica.data.node_mode_participating = False
             return
         if not self.mode_participating and not self.leecher.in_progress:
-            # re-promoted
-            self.mode_participating = True
-            self.replica.data.node_mode_participating = True
+            # re-promoted: sync the missed window BEFORE voting again —
+            # everything ordered while passive sits stashed/unapplied
+            logger.info("%s re-promoted — catching up before rejoining",
+                        self.name)
+            self.start_catchup()
         primary = self.replica.data.primary_name
         if primary is not None and primary not in new_validators:
             logger.info("%s: primary %s demoted — voting view change",
@@ -360,6 +390,36 @@ class Node:
         if self.db_manager.get_ledger(AUDIT_LEDGER_ID).size > 0:
             self.start_catchup()
 
+    def _primaries_for_batch(self, original_view_no: int) -> List[str]:
+        """Primaries recorded in a batch's audit txn. Must be stable for
+        the WHOLE view regardless of later membership changes (the
+        reference records primaries at view start and back-references
+        after, audit_batch_handler._fill_primaries): if the previous
+        audit txn belongs to the same original view, reuse ITS resolved
+        primaries; only the first batch of a view derives them from the
+        live selector."""
+        handler = self._audit_handler()
+        if handler is not None:
+            last_seq = handler.ledger.uncommitted_size
+            if last_seq:
+                last = handler.ledger.get_by_seq_no_uncommitted(last_seq)
+                if last is not None and \
+                        get_payload_data(last).get("viewNo") == \
+                        original_view_no:
+                    prev = handler.primaries_at(last_seq)
+                    if prev:
+                        return list(prev)
+        return [self._primary_selector.select_master_primary(
+            original_view_no)]
+
+    def _audit_handler(self):
+        from plenum_tpu.server.batch_handlers import AuditBatchHandler
+        for chain in self.write_manager.batch_handlers.values():
+            for h in chain:
+                if isinstance(h, AuditBatchHandler):
+                    return h
+        return None
+
     def _audit_state_roots(self) -> Dict[int, bytes]:
         """ledger_id → expected committed state root from the last audit
         txn (every audit txn records all current state roots)."""
@@ -399,7 +459,17 @@ class Node:
         self.replica.ordering.lastPrePrepareSeqNo = pp_seq_no
         self.replica.ordering._last_applied_seq = pp_seq_no
         self.replica.checkpointer.caught_up_till_3pc((view_no, pp_seq_no))
-        self.replica.data.primary_name = \
+        # primary: prefer the audit ledger's own record (stable against
+        # mid-view membership changes); the live selector only decides
+        # views newer than the last audited batch
+        primary = None
+        if last_audit is not None and \
+                get_payload_data(last_audit).get("viewNo") == view_no:
+            handler = self._audit_handler()
+            recorded = handler.primaries_at(audit.size) if handler else None
+            if recorded:
+                primary = recorded[0]
+        self.replica.data.primary_name = primary or \
             self._primary_selector.select_master_primary(view_no)
 
     # ===================================================== client intake
@@ -545,9 +615,9 @@ class Node:
             digest = get_digest(txn)
             if digest:
                 self.monitor.request_ordered(digest, ordered.instId)
-                self._rejected_digests.discard(digest)
+                self._rejected_digests.pop(digest, None)
             client_id = self._req_clients.pop(digest, None)
-            if client_id is not None:
+            if client_id is not None and self._clients_attached:
                 result = dict(txn)
                 try:
                     result.update(ledger.merkleInfo(seq_no))
@@ -560,16 +630,20 @@ class Node:
             for txn in committed_txns or []:
                 self.pool_manager.process_committed_txn(txn)
 
-    def _on_request_rejected(self, digest: str, reason: str):
+    def _on_request_rejected(self, digest: str, reason: str,
+                             pp_seq_no: int):
         """A request failed dynamic validation at apply time: tell the
         waiting client (reference: Reject from _apply_pre_prepare
         rejects). Apply is SPECULATIVE (uncommitted) — a view-change
         re-order can still commit this request later, so the client
         mapping and the in-flight entry survive until the batch that
-        excluded it reaches a stable checkpoint (_gc_rejected)."""
+        excluded it (seq recorded here) reaches a STABLE checkpoint
+        (_gc_rejected)."""
         if digest in self._rejected_digests:
+            self._rejected_digests[digest] = max(
+                self._rejected_digests[digest], pp_seq_no)
             return
-        self._rejected_digests.add(digest)
+        self._rejected_digests[digest] = pp_seq_no
         request = self._get_finalised_request(digest)
         client_id = self._req_clients.get(digest)
         if client_id is not None and request is not None:
@@ -578,13 +652,17 @@ class Node:
                 reqId=request.reqId or 0, reason=reason))
 
     def _gc_rejected(self, msg):
-        """Stable checkpoint: rejected requests below it can never be
-        re-ordered — free their in-flight state so client retries get
-        answered instead of being swallowed by the propagator dedup."""
-        for digest in self._rejected_digests:
+        """Stable checkpoint: requests rejected in batches AT OR BELOW it
+        can never be re-ordered — free their in-flight state so client
+        retries get answered instead of swallowed by propagator dedup.
+        Rejections in still-speculative batches above the checkpoint must
+        survive (a re-order may yet commit them)."""
+        stable_seq = msg.last_stable_3pc[1]
+        for digest in [d for d, seq in self._rejected_digests.items()
+                       if seq <= stable_seq]:
+            del self._rejected_digests[digest]
             self._req_clients.pop(digest, None)
             self.propagator.requests.free(digest)
-        self._rejected_digests.clear()
 
     def _committed_reply(self, request: Request) -> Optional[Reply]:
         try:
@@ -609,7 +687,8 @@ class Node:
             return
         logger.info("%s starting catchup", self.name)
         self.mode_participating = False
-        self.replica.data.node_mode_participating = False
+        for replica in self.replicas:
+            replica.data.node_mode_participating = False
         # uncommitted work must go before catchup txns land on the
         # ledgers (reference preLedgerCatchUp: replicas revert unordered
         # batches); the pool's committed history is authoritative
@@ -655,7 +734,8 @@ class Node:
                         "passive", self.name)
             return
         self.mode_participating = True
-        self.replica.data.node_mode_participating = True
+        for replica in self.replicas:
+            replica.data.node_mode_participating = True
         self.replica.ordering.on_catchup_finished()
         logger.info("%s catchup finished; last_ordered=%s", self.name,
                     self.replica.data.last_ordered_3pc)

@@ -37,6 +37,7 @@ class TxnPoolManager:
         # alias order is consensus-critical (primary rotation indexes
         # into it): ctor seed order, then pool-ledger commit order
         self._order: List[str] = list(initial_validators)
+        self.seed_aliases = frozenset(initial_validators)
         self._info: Dict[str, dict] = {
             alias: {SERVICES: [VALIDATOR]} for alias in initial_validators}
         self._rescan()

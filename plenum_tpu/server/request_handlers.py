@@ -175,6 +175,11 @@ class NodeHandler(WriteRequestHandler):
                  steward_provider=None):
         super().__init__(database_manager, NODE, POOL_LEDGER_ID)
         self._steward_provider = steward_provider
+        # aliases seeded at pool construction without pool-ledger NODE
+        # records (wired by the node owner): they have no state entry, so
+        # without this a steward could "create" a NODE txn reusing a seed
+        # alias and hijack/demote a validator it does not own
+        self.reserved_aliases = lambda: set()
 
     def static_validation(self, request: Request):
         op = request.operation
@@ -208,6 +213,13 @@ class NodeHandler(WriteRequestHandler):
                 raise UnauthorizedClientRequest(
                     request.identifier, request.reqId,
                     "only a STEWARD or TRUSTEE may add a node")
+            if data.get("alias") in self.reserved_aliases() \
+                    and author_role != TRUSTEE:
+                raise UnauthorizedClientRequest(
+                    request.identifier, request.reqId,
+                    "alias {} belongs to a genesis validator — only a "
+                    "TRUSTEE may write its record".format(
+                        data.get("alias")))
             if author_role == STEWARD and self._steward_owns_node(
                     request.identifier):
                 raise UnauthorizedClientRequest(

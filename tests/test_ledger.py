@@ -292,3 +292,101 @@ def test_batch_inclusion_verification():
         t.append(leaf)
     items = [(LEAVES[i], i, t.inclusion_proof(i, 64)) for i in range(64)]
     assert V.verify_leaf_inclusion_batch(items, 64, t.root_hash)
+
+
+# ------------------------------------------------- bulk build (TPU seam)
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13, 64, 65, 127, 200])
+def test_bulk_build_matches_incremental(n):
+    """extend() from empty (level-wise batched hashing) must reproduce
+    the incremental tree exactly: root, frontier, stored subtree hashes,
+    inclusion AND consistency proofs."""
+    from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    leaves = [b"leaf-%d" % i for i in range(n)]
+    inc = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    for leaf in leaves:
+        inc.append(leaf)
+    bulk = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    bulk._bulk_build([bulk.hasher.hash_leaf(d) for d in leaves])
+    assert bulk.tree_size == inc.tree_size == n
+    assert bulk.root_hash == inc.root_hash
+    assert bulk._frontier == inc._frontier
+    for m in range(n):
+        assert bulk.inclusion_proof(m, n) == inc.inclusion_proof(m, n)
+    for first in range(1, n + 1):
+        assert bulk.consistency_proof(first, n) == \
+            inc.consistency_proof(first, n)
+
+
+def test_bulk_build_via_jax_backend_matches_hashlib():
+    """The production wiring: extend() over the JAX SHA-256 backend with
+    a tiny threshold produces the identical tree to hashlib."""
+    from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from plenum_tpu.ops.sha256 import get_default_backend
+    leaves = [b"txn-%d" % i for i in range(300)]
+    scalar = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    for leaf in leaves:
+        scalar.append(leaf)
+    jax_hasher = TreeHasher(batch_backend=get_default_backend(),
+                            batch_threshold=4)
+    bulk = CompactMerkleTree(jax_hasher, MemoryHashStore())
+    bulk._bulk_build(jax_hasher.hash_leaves(leaves))
+    assert bulk.root_hash == scalar.root_hash
+    assert bulk._frontier == scalar._frontier
+    assert bulk.inclusion_proof(123, 300) == scalar.inclusion_proof(123, 300)
+
+
+def test_ledger_recovery_uses_bulk_path(tdir):
+    """recoverTreeFromTxnLog over >=1024 txns goes through _bulk_build
+    and reproduces the same root as incremental appends."""
+    from plenum_tpu.ledger.ledger import Ledger
+    store = KeyValueStorageFile(tdir, "bulk_ledger")
+    ledger = Ledger(txn_store=store)
+    for i in range(1100):
+        ledger.add({"txn": {"type": "1", "data": {"i": i}},
+                    "txnMetadata": {}})
+    root = ledger.root_hash
+    store2 = KeyValueStorageFile(tdir, "bulk_ledger", read_only=True)
+    recovered = Ledger(txn_store=store2)
+    assert recovered.size == 1100
+    assert recovered.root_hash == root
+
+
+# -------------------------------------------- device-resident tree (TPU)
+
+def test_device_merkle_tree_matches_host():
+    """ops/merkle.py DeviceMerkleTree: fused on-device build reproduces
+    the host CompactMerkleTree root at pow2 AND ragged sizes."""
+    from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    for n in (1, 2, 3, 5, 13, 64, 100, 256):
+        leaves = [b"leaf-%d" % i for i in range(n)]
+        host = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+        for leaf in leaves:
+            host.append(leaf)
+        dev = DeviceMerkleTree()
+        assert dev.build(leaves) == host.root_hash, n
+
+
+def test_device_merkle_audit_path_batch():
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    n = 128
+    leaves = [b"txn-%04d" % i for i in range(n)]
+    dev = DeviceMerkleTree()
+    root = dev.build(leaves)
+    idx = list(range(0, n, 3))
+    paths = dev.audit_path_batch(idx)
+    for j, m in enumerate(idx):
+        assert dev.verify_path(leaves[m], m, paths[j], root), m
+    # forged path fails
+    bad = list(paths[0])
+    bad[0] = b"\x00" * 32
+    assert not dev.verify_path(leaves[idx[0]], idx[0], bad, root)
+
+
+def test_device_merkle_ragged_rejects_path_batch():
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    dev = DeviceMerkleTree()
+    dev.build([b"a", b"b", b"c"])
+    with pytest.raises(ValueError):
+        dev.audit_path_batch([0])

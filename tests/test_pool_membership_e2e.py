@@ -28,17 +28,20 @@ CONF = dict(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=5,
             LOG_SIZE=15, ToleratePrimaryDisconnection=4, NEW_VIEW_TIMEOUT=8)
 
 STEWARDS = [SimpleSigner(seed=bytes([200 + i]) * 32) for i in range(4)]
+TRUSTEE_SIGNER = SimpleSigner(seed=bytes([210]) * 32)
 
 
 def genesis_txns():
-    """One steward NYM per node (genesis-style, unsigned envelopes)."""
+    """One steward NYM per node + a trustee (genesis-style envelopes)."""
+    from plenum_tpu.common.constants import TRUSTEE
     txns = []
-    for steward in STEWARDS:
+    for signer, role in [(s, STEWARD) for s in STEWARDS] + \
+            [(TRUSTEE_SIGNER, TRUSTEE)]:
         txn = init_empty_txn(NYM)
         get_payload_data(txn).update({
-            TARGET_NYM: steward.identifier,
-            VERKEY: steward.verkey,
-            ROLE: STEWARD,
+            TARGET_NYM: signer.identifier,
+            VERKEY: signer.verkey,
+            ROLE: role,
         })
         txns.append(txn)
     return txns
@@ -79,7 +82,7 @@ def test_add_fifth_node_live(pool):
     client = SimpleSigner(seed=b"\x31" * 32)
     submit_to_all(nodes, signed_nym_request(client, req_id=1))
     pump(timer, nodes, 6)
-    assert all(n.domain_ledger.size == 5 for n in nodes)  # 4 genesis + 1
+    assert all(n.domain_ledger.size == 6 for n in nodes)  # 5 genesis + 1
     assert all(n.replica.data.quorums.n == 4 for n in nodes)
 
     # a steward adds Epsilon as a VALIDATOR
@@ -98,14 +101,14 @@ def test_add_fifth_node_live(pool):
     epsilon.start_catchup()
     all_nodes = nodes + [epsilon]
     pump(timer, all_nodes, 15)
-    assert epsilon.domain_ledger.size == 5
+    assert epsilon.domain_ledger.size == 6
     assert epsilon.pool_manager.validators == NAMES + ["Epsilon"]
 
     late = SimpleSigner(seed=b"\x32" * 32)
     submit_to_all(all_nodes, signed_nym_request(late, req_id=3))
     pump(timer, all_nodes, 8)
     # quorums n=5 ⇒ commit needs 4 — Epsilon's votes count
-    assert all(n.domain_ledger.size == 6 for n in all_nodes)
+    assert all(n.domain_ledger.size == 7 for n in all_nodes)
     assert len({n.domain_ledger.root_hash for n in all_nodes}) == 1
     assert len({n.audit_ledger.root_hash for n in all_nodes}) == 1
     assert len(sink.of_type(Reply)) == 1
@@ -115,7 +118,7 @@ def test_demote_validator_shrinks_pool(pool):
     nodes, sinks, net, timer = pool
     # add Delta's NODE record first so it can be demoted (Delta is in
     # the ctor seed; demotion needs a NODE txn flipping its services)
-    req = signed_node_request(STEWARDS[1], "Delta", [], req_id=10)
+    req = signed_node_request(TRUSTEE_SIGNER, "Delta", [], req_id=10)
     submit_to_all(nodes, req)
     pump(timer, nodes, 6)
     for n in nodes:
@@ -138,7 +141,7 @@ def test_demoting_primary_triggers_view_change(pool):
     nodes, sinks, net, timer = pool
     primary_name = nodes[0].master_primary_name
     assert primary_name == "Alpha"
-    req = signed_node_request(STEWARDS[2], "Alpha", [], req_id=20)
+    req = signed_node_request(TRUSTEE_SIGNER, "Alpha", [], req_id=20)
     submit_to_all(nodes, req)
     pump(timer, nodes, 15)
     live = [n for n in nodes if n.name != "Alpha"]
