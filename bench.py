@@ -182,6 +182,51 @@ def micro_merkle(n_leaves=None):
     return (n_leaves, device_leaves_per_s, proof_rate, floor_leaves_per_s)
 
 
+def micro_bls():
+    """BASELINE config 3: BLS multi-sig aggregate + verify for
+    n = 4/25/100 validators (the per-commit state-proof path). Native C
+    backend (the framework's ursa equivalent) vs the pure-Python floor."""
+    from plenum_tpu.crypto.bls import (
+        BlsCryptoSignerPlenum, BlsCryptoVerifierPlenum)
+    from plenum_tpu.crypto import bls_ops
+    results = {"backend": bls_ops.BACKEND}
+    verifier = BlsCryptoVerifierPlenum()
+    msg = b"state-root-commitment"
+    out = {}
+    for n in (4, 25, 100):
+        signers = [BlsCryptoSignerPlenum.generate(bytes([i]) * 32)[0]
+                   for i in range(n)]
+        sigs = [s.sign(msg) for s in signers]
+        pks = [s.pk for s in signers]
+        t0 = time.perf_counter()
+        reps_a = 5
+        for _ in range(reps_a):
+            multi = verifier.create_multi_sig(sigs)
+        agg_s = (time.perf_counter() - t0) / reps_a
+        reps_v = 5
+        t0 = time.perf_counter()
+        for _ in range(reps_v):
+            ok = verifier.verify_multi_sig(multi, msg, pks)
+        ver_s = (time.perf_counter() - t0) / reps_v
+        assert ok
+        out[str(n)] = {"aggregate_per_s": round(1 / agg_s, 1),
+                       "verify_per_s": round(1 / ver_s, 1)}
+    results["by_n"] = out
+    # pure-Python pairing floor for context (one verify) — calls the
+    # reference implementation directly, no backend switching
+    from plenum_tpu.crypto import bls12_381 as B
+    h = B.hash_to_g1(msg)
+    sk = 12345
+    sig = B.g1_mul(h, sk)
+    pk = B.g2_mul(B.G2_GEN, sk)
+    t0 = time.perf_counter()
+    assert B.multi_pairing(
+        [(sig, B.g2_neg(B.G2_GEN)), (h, pk)]) == B.FQ12_ONE
+    results["python_verify_per_s"] = round(
+        1 / (time.perf_counter() - t0), 2)
+    return results
+
+
 def main():
     from plenum_tpu.crypto.signer import SimpleSigner
 
@@ -203,6 +248,7 @@ def main():
 
     device_rate, openssl_rate, python_rate = micro_ed25519()
     mk_n, mk_rate, mk_proofs, mk_floor = micro_merkle()
+    bls_results = micro_bls()
 
     print(json.dumps({
         "metric": "ordered write-reqs/s, 4-node pool, TPU-batched verify"
@@ -230,6 +276,7 @@ def main():
                 "hashlib_floor_leaves_per_s": round(mk_floor, 1),
                 "vs_hashlib": round(mk_rate / mk_floor, 2),
             },
+            "bls": bls_results,
         },
     }))
 

@@ -18,7 +18,7 @@ import hashlib
 from abc import ABC, abstractmethod
 from typing import List, Optional, Sequence
 
-from . import bls12_381 as bls
+from . import bls_ops as bls
 
 _DST_SIG = b"PLENUM_TPU_BLS_SIG"
 _DST_POP = b"PLENUM_TPU_BLS_POP"
@@ -97,38 +97,72 @@ class BlsCryptoSignerPlenum(BlsCryptoSigner):
 
 
 class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
+    """Validator public keys are static pool state — decompression,
+    subgroup membership and the aggregate key are cached per key-set
+    (the reference's ursa keys are likewise deserialized once)."""
+
+    def __init__(self):
+        self._pk_cache = {}        # b58 pk -> (point, in_subgroup)
+        self._agg_cache = {}       # tuple(pks) -> aggregate point | None
+
     def _g1(self, s: str):
         return bls.g1_decompress(_unb58(s))
 
     def _g2(self, s: str):
         return bls.g2_decompress(_unb58(s))
 
+    def _pk_point(self, pk: str):
+        """→ (point, valid) with caching; valid ⇒ on-curve + subgroup."""
+        hit = self._pk_cache.get(pk)
+        if hit is not None:
+            return hit
+        try:
+            p = self._g2(pk)
+            valid = p is not None and bls.g2_in_subgroup(p)
+        except (ValueError, KeyError):
+            p, valid = None, False
+        if len(self._pk_cache) > 4096:
+            self._pk_cache.clear()
+        self._pk_cache[pk] = (p, valid)
+        return p, valid
+
+    def _aggregate_pks(self, pks: Sequence[str]):
+        key = tuple(pks)
+        if key in self._agg_cache:
+            return self._agg_cache[key]
+        agg = None
+        for pk in pks:
+            p, valid = self._pk_point(pk)
+            if not valid:
+                agg = None
+                break
+            agg = bls.g2_add(agg, p)
+        if len(self._agg_cache) > 1024:
+            self._agg_cache.clear()
+        self._agg_cache[key] = agg
+        return agg
+
     def verify_sig(self, signature: str, message: bytes, pk: str) -> bool:
         try:
             sig = self._g1(signature)
-            pub = self._g2(pk)
         except (ValueError, KeyError):
             return False
-        if sig is None or pub is None:
+        pub, valid = self._pk_point(pk)
+        if sig is None or not valid:
             return False
-        if not (bls.g1_in_subgroup(sig) and bls.g2_in_subgroup(pub)):
+        if not bls.g1_in_subgroup(sig):
             return False
         h = bls.hash_to_g1(message, _DST_SIG)
         # e(sig, G2) == e(H(m), pk)  ⇔  e(sig, -G2)·e(H(m), pk) == 1
-        out = bls.multi_pairing([(sig, bls.g2_neg(bls.G2_GEN)), (h, pub)])
-        return out == bls.FQ12_ONE
+        return bls.multi_pairing_is_one(
+            [(sig, bls.g2_neg(bls.G2_GEN)), (h, pub)])
 
     def verify_multi_sig(self, signature: str, message: bytes,
                          pks: Sequence[str]) -> bool:
         if not pks:
             return False
+        agg_pk = self._aggregate_pks(pks)
         try:
-            agg_pk = None
-            for pk in pks:
-                p = self._g2(pk)
-                if p is None or not bls.g2_in_subgroup(p):
-                    return False
-                agg_pk = bls.g2_add(agg_pk, p)
             sig = self._g1(signature)
         except (ValueError, KeyError):
             return False
@@ -137,8 +171,8 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
         if not bls.g1_in_subgroup(sig):
             return False
         h = bls.hash_to_g1(message, _DST_SIG)
-        out = bls.multi_pairing([(sig, bls.g2_neg(bls.G2_GEN)), (h, agg_pk)])
-        return out == bls.FQ12_ONE
+        return bls.multi_pairing_is_one(
+            [(sig, bls.g2_neg(bls.G2_GEN)), (h, agg_pk)])
 
     def create_multi_sig(self, signatures: Sequence[str]) -> str:
         agg = None
@@ -149,17 +183,17 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
     def verify_key_proof_of_possession(self, key_proof: str, pk: str) -> bool:
         try:
             proof = self._g1(key_proof)
-            pub = self._g2(pk)
         except (ValueError, KeyError):
             return False
-        if proof is None or pub is None:
+        pub, valid = self._pk_point(pk)
+        if proof is None or not valid:
             return False
-        if not (bls.g1_in_subgroup(proof) and bls.g2_in_subgroup(pub)):
+        if not bls.g1_in_subgroup(proof):
             return False
         pk_bytes = _unb58(pk)
         h = bls.hash_to_g1(pk_bytes, _DST_POP)
-        out = bls.multi_pairing([(proof, bls.g2_neg(bls.G2_GEN)), (h, pub)])
-        return out == bls.FQ12_ONE
+        return bls.multi_pairing_is_one(
+            [(proof, bls.g2_neg(bls.G2_GEN)), (h, pub)])
 
 
 class MultiSignatureValue:

@@ -324,12 +324,24 @@ def g2_is_on_curve(p: G2Point) -> bool:
     return y.sq() == x.sq() * x + Fq2(4, 4)
 
 
-def g1_in_subgroup(p: G1Point) -> bool:
-    return g1_is_on_curve(p) and g1_mul(p, R) is None
+def g1_in_subgroup(p: G1Point, g1_mul_fn=None) -> bool:
+    """On-curve + r-torsion. g1_mul reduces scalars mod r, so mul-by-r
+    cannot be used directly (it would be vacuously None); instead check
+    (r−1)·p == −p ⇔ r·p = O ⇔ ord(p) | r (r prime).
+
+    g1_mul_fn lets a faster backend (bls_ops) supply the scalar mult
+    while keeping this single implementation of the security check."""
+    if p is None:
+        return True
+    mul = g1_mul_fn or g1_mul
+    return g1_is_on_curve(p) and mul(p, R - 1) == g1_neg(p)
 
 
-def g2_in_subgroup(p: G2Point) -> bool:
-    return g2_is_on_curve(p) and g2_mul(p, R) is None
+def g2_in_subgroup(p: G2Point, g2_mul_fn=None) -> bool:
+    if p is None:
+        return True
+    mul = g2_mul_fn or g2_mul
+    return g2_is_on_curve(p) and mul(p, R - 1) == g2_neg(p)
 
 
 # ------------------------------------------------------------ pairing
@@ -469,15 +481,21 @@ def g2_decompress(data: bytes) -> G2Point:
     return (x, y)
 
 
-def hash_to_g1(msg: bytes, dst: bytes = b"PLENUM_TPU_BLS_G1") -> G1Point:
+def hash_to_g1(msg: bytes, dst: bytes = b"PLENUM_TPU_BLS_G1",
+               g1_mul_fn=None) -> G1Point:
     """Deterministic hash-to-curve by try-and-increment over SHA-256.
 
     Not the IRTF SSWU suite — this framework defines its own wire format
     (no Ursa compatibility requirement); try-and-increment is simple,
     deterministic, and its variable-time nature leaks nothing secret
     (inputs are public consensus data).
+
+    ``g1_mul_fn`` lets the backend dispatch (bls_ops) run the cofactor
+    clearing on the native path — ONE construction, consensus-critical:
+    every node must hash to the identical point.
     """
     import hashlib as _h
+    mul = g1_mul_fn or g1_mul
     ctr = 0
     while True:
         d = _h.sha256(dst + ctr.to_bytes(4, "big") + msg).digest()
@@ -487,7 +505,7 @@ def hash_to_g1(msg: bytes, dst: bytes = b"PLENUM_TPU_BLS_G1") -> G1Point:
         if y * y % Q == yy:
             # clear cofactor to land in the r-torsion subgroup
             h = ((1 - (-X_ABS)) ** 2) // 3  # G1 cofactor (x-1)^2/3
-            p = g1_mul((x, min(y, Q - y)), h)
+            p = mul((x, min(y, Q - y)), h)
             if p is not None:
                 return p
         ctr += 1

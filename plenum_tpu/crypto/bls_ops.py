@@ -1,0 +1,84 @@
+"""Backend dispatch for BLS12-381 curve operations.
+
+The hot operations (scalar mults, pairing checks) route to the native C
+module (plenum_tpu/native/bls12_381.c — the framework's ursa equivalent,
+~100-300x the pure-Python speed) when a C compiler is available, and
+fall back to the pure-Python reference implementation otherwise. Select
+explicitly with PLENUM_TPU_BLS=python|native.
+
+Serialization, constants and the Fq towers always come from the Python
+module — they are not hot and keep a single source of truth for the
+wire format.
+"""
+from __future__ import annotations
+
+import os
+from typing import Sequence, Tuple
+
+from plenum_tpu.crypto import bls12_381 as _py
+from plenum_tpu.crypto.bls12_381 import (  # noqa: F401  (re-exports)
+    FQ12_ONE, G1Point, G2Point, G1_GEN, G2_GEN, Q, R, X_ABS,
+    g1_compress, g1_decompress, g1_is_on_curve, g1_neg,
+    g2_compress, g2_decompress, g2_is_on_curve, g2_neg)
+
+
+def _pick_backend():
+    import logging
+    log = logging.getLogger(__name__)
+    mode = os.environ.get("PLENUM_TPU_BLS", "auto")
+    if mode not in ("auto", "native", "python"):
+        log.warning("unrecognized PLENUM_TPU_BLS=%r; using auto", mode)
+        mode = "auto"
+    if mode == "python":
+        return None
+    try:
+        from plenum_tpu.crypto import bls_native
+        if bls_native.available():
+            return bls_native
+        err = bls_native.build_error()
+    except Exception as e:  # pragma: no cover - import failure path
+        err = e
+    if mode == "native":
+        raise RuntimeError(
+            "PLENUM_TPU_BLS=native but the C backend failed to build: %s"
+            % (err,))
+    log.warning("native BLS backend unavailable (%s); falling back to the "
+                "~100-300x slower pure-Python pairing", err)
+    return None
+
+
+_native = _pick_backend()
+BACKEND = "native" if _native is not None else "python"
+
+if _native is not None:
+    g1_add = _native.g1_add
+    g1_mul = _native.g1_mul
+    g2_add = _native.g2_add
+    g2_mul = _native.g2_mul
+    multi_pairing_is_one = _native.multi_pairing_is_one
+    g1_decompress = _native.g1_decompress  # noqa: F811 (hot override)
+else:
+    g1_add = _py.g1_add
+    g1_mul = _py.g1_mul
+    g2_add = _py.g2_add
+    g2_mul = _py.g2_mul
+
+    def multi_pairing_is_one(
+            pairs: Sequence[Tuple[G1Point, G2Point]]) -> bool:
+        return _py.multi_pairing(pairs) == _py.FQ12_ONE
+
+
+def hash_to_g1(msg: bytes, dst: bytes = b"PLENUM_TPU_BLS_G1") -> G1Point:
+    """The single shared try-and-increment construction from bls12_381,
+    with the cofactor clearing running on the fast backend."""
+    return _py.hash_to_g1(msg, dst, g1_mul_fn=g1_mul)
+
+
+def g1_in_subgroup(p: G1Point) -> bool:
+    """The single shared check from bls12_381, with the scalar mult
+    running on the fast backend."""
+    return _py.g1_in_subgroup(p, g1_mul_fn=g1_mul)
+
+
+def g2_in_subgroup(p: G2Point) -> bool:
+    return _py.g2_in_subgroup(p, g2_mul_fn=g2_mul)

@@ -1,0 +1,36 @@
+"""Native (C) components — compiled on first use with the system
+compiler, cached next to the source. The framework's answer to the
+reference's native library bindings (SURVEY.md §2.9): where indy-plenum
+links libsodium/ursa/rocksdb, this package carries its own C sources.
+"""
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_and_load(name: str) -> ctypes.CDLL:
+    """Compile `<name>.c` into `<name>.so` (if stale) and dlopen it.
+
+    The compile targets a pid-unique temp file that is os.rename()d into
+    place, so concurrent processes never dlopen a half-written library."""
+    src = os.path.join(_DIR, name + ".c")
+    so = os.path.join(_DIR, name + ".so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        cc = os.environ.get("CC", "cc")
+        tmp = "%s.%d.tmp" % (so, os.getpid())
+        cmd = [cc, "-O3", "-shared", "-fPIC", "-std=c11", "-o", tmp, src]
+        logger.info("building native module: %s", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.rename(tmp, so)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return ctypes.CDLL(so)

@@ -177,6 +177,9 @@ class DeviceMerkleTree:
         if self._size != self._padded:
             raise ValueError("batched audit paths need a power-of-two "
                              "tree (got size {})".format(self._size))
+        if len(self._levels) == 1:
+            # single-leaf tree: the audit path of leaf 0 is empty
+            return [[] for _ in indices]
         idx = jnp.asarray(np.asarray(list(indices), dtype=np.int32))
         stacked = np.asarray(_gather_paths(self._levels, idx))
         k, depth = stacked.shape[0], stacked.shape[1]

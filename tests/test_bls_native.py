@@ -1,0 +1,131 @@
+"""Native BLS12-381 backend (plenum_tpu/native/bls12_381.c) against the
+pure-Python reference implementation — the pair must be bit-identical at
+the point level and agree on every pairing decision.
+
+Reference parity: this module fills the role ursa (Rust) plays in
+crypto/bls/indy_crypto/bls_crypto_indy_crypto.py.
+"""
+import os
+
+import pytest
+
+from plenum_tpu.crypto import bls12_381 as B
+
+if os.environ.get("PLENUM_TPU_BLS") == "python":
+    pytest.skip("PLENUM_TPU_BLS=python forces the pure-Python backend",
+                allow_module_level=True)
+bls_native = pytest.importorskip("plenum_tpu.crypto.bls_native")
+if not bls_native.available():
+    pytest.skip("no C compiler available for the native backend",
+                allow_module_level=True)
+
+H = B.hash_to_g1(b"cross-check")
+G2 = B.G2_GEN
+
+
+@pytest.mark.parametrize("k", [0, 1, 2, 3, 7, 12345,
+                               2 ** 128 + 5, B.R - 1, B.R, B.R + 9])
+def test_g1_mul_matches_python(k):
+    assert bls_native.g1_mul(H, k) == B.g1_mul(H, k)
+
+
+@pytest.mark.parametrize("k", [0, 1, 2, 3, 999, 2 ** 200 + 3, B.R - 1])
+def test_g2_mul_matches_python(k):
+    assert bls_native.g2_mul(G2, k) == B.g2_mul(G2, k)
+
+
+def test_adds_match_python():
+    p2 = B.g1_mul(H, 2)
+    assert bls_native.g1_add(H, p2) == B.g1_add(H, p2)
+    assert bls_native.g1_add(H, H) == B.g1_add(H, H)  # doubling branch
+    assert bls_native.g1_add(H, B.g1_neg(H)) is None  # inverse branch
+    assert bls_native.g1_add(None, H) == H
+    q2 = B.g2_mul(G2, 2)
+    assert bls_native.g2_add(G2, q2) == B.g2_add(G2, q2)
+    assert bls_native.g2_add(G2, G2) == B.g2_add(G2, G2)
+    assert bls_native.g2_add(G2, B.g2_neg(G2)) is None
+
+
+def test_pairing_bilinearity_and_negatives():
+    a = 987654321987654321
+    aP = B.g1_mul(H, a)
+    aQ = B.g2_mul(G2, a)
+    # e(aP, Q)·e(−P, aQ) == 1
+    assert bls_native.multi_pairing_is_one(
+        [(aP, G2), (B.g1_neg(H), aQ)])
+    assert not bls_native.multi_pairing_is_one(
+        [(aP, G2), (H, aQ)])
+    assert bls_native.multi_pairing_is_one([(None, G2)])
+    assert bls_native.multi_pairing_is_one([])
+
+
+def test_pairing_agrees_with_python_decision():
+    """Every verify decision must match the Python pairing's (the native
+    final exp is a fixed cube power — decisions are identical)."""
+    for sk, msg in [(3, b"a"), (2 ** 100 + 7, b"b"), (B.R - 2, b"c")]:
+        h = B.hash_to_g1(msg)
+        sig = B.g1_mul(h, sk)
+        pk = B.g2_mul(G2, sk)
+        pairs_good = [(sig, B.g2_neg(G2)), (h, pk)]
+        pairs_bad = [(sig, B.g2_neg(G2)), (B.g1_mul(h, 2), pk)]
+        for pairs in (pairs_good, pairs_bad):
+            py = B.multi_pairing(pairs) == B.FQ12_ONE
+            assert bls_native.multi_pairing_is_one(pairs) == py
+
+
+def test_bls_scheme_end_to_end_on_dispatch_backend():
+    """crypto/bls.py rides bls_ops (native when available): sign,
+    aggregate, multi-verify, PoP."""
+    from plenum_tpu.crypto import bls_ops
+    from plenum_tpu.crypto.bls import (
+        BlsCryptoSignerPlenum, BlsCryptoVerifierPlenum)
+    assert bls_ops.BACKEND == "native"
+    signers = []
+    proofs = []
+    for i in range(4):
+        s, proof = BlsCryptoSignerPlenum.generate(bytes([50 + i]) * 32)
+        signers.append(s)
+        proofs.append(proof)
+    v = BlsCryptoVerifierPlenum()
+    msg = b"root-of-batch"
+    sigs = [s.sign(msg) for s in signers]
+    for s, sig in zip(signers, sigs):
+        assert v.verify_sig(sig, msg, s.pk)
+    multi = v.create_multi_sig(sigs)
+    assert v.verify_multi_sig(multi, msg, [s.pk for s in signers])
+    assert not v.verify_multi_sig(multi, b"other", [s.pk for s in signers])
+    assert not v.verify_multi_sig(multi, msg, [s.pk for s in signers[:3]])
+    for s, proof in zip(signers, proofs):
+        assert v.verify_key_proof_of_possession(proof, s.pk)
+
+
+def test_hash_to_g1_dispatch_matches_python():
+    from plenum_tpu.crypto import bls_ops
+    for msg in (b"", b"x", b"state-root-123"):
+        assert bls_ops.hash_to_g1(msg) == B.hash_to_g1(msg)
+
+
+def test_subgroup_check_rejects_non_subgroup_points():
+    """Regression: scalars are reduced mod r, so a naive mul-by-r check
+    is vacuous — the check must reject on-curve points OUTSIDE the
+    r-torsion (cofactor components enable signature malleability)."""
+    from plenum_tpu.crypto import bls_ops
+    Q = B.Q
+    x = 5
+    while True:
+        yy = (x * x * x + 4) % Q
+        y = pow(yy, (Q + 1) // 4, Q)
+        if y * y % Q == yy:
+            # random on-curve point: in the r-subgroup with prob ~2^-125
+            p = (x, y)
+            break
+        x += 1
+    # p is on the curve
+    assert B.g1_is_on_curve(p)
+    in_sub_py = B.g1_in_subgroup(p)
+    in_sub_ops = bls_ops.g1_in_subgroup(p)
+    assert in_sub_py == in_sub_ops
+    # the subgroup member (after cofactor clearing) passes; raw p fails
+    cleared = B.g1_mul(p, ((1 + B.X_ABS) ** 2) // 3)
+    assert bls_ops.g1_in_subgroup(cleared)
+    assert not bls_ops.g1_in_subgroup(p)

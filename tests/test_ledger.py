@@ -390,3 +390,12 @@ def test_device_merkle_ragged_rejects_path_batch():
     dev.build([b"a", b"b", b"c"])
     with pytest.raises(ValueError):
         dev.audit_path_batch([0])
+
+
+def test_device_merkle_single_leaf_paths():
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    dev = DeviceMerkleTree()
+    root = dev.build([b"only"])
+    paths = dev.audit_path_batch([0])
+    assert paths == [[]]
+    assert dev.verify_path(b"only", 0, paths[0], root)
