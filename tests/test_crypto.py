@@ -120,6 +120,83 @@ def test_provider_dispatch_scalar_floor():
         create_verifier("nope")
 
 
+def test_coalescing_hub_fuses_concurrent_dispatches():
+    """CoalescingVerifierHub: n dispatches before any harvest fuse into
+    ONE underlying launch; per-dispatch slices stay isolated (including
+    a bad signature); a post-flush dispatch starts a new generation."""
+    from plenum_tpu.crypto.batch_verifier import CoalescingVerifierHub
+
+    launches = []
+
+    class FakeBatch:
+        def dispatch(self, items):
+            launches.append(len(items))
+
+            class R:
+                def collect(_self):
+                    return [sig == b"ok" for (_, sig, _) in items]
+            return R()
+
+    hub = CoalescingVerifierHub(batch=FakeBatch(), threshold=1)
+    good = (b"m", b"ok", b"vk")
+    bad = (b"m", b"forged", b"vk")
+    p1 = hub.dispatch([good, good])
+    p2 = hub.dispatch([good, bad, good])
+    p3 = hub.dispatch([bad])
+    assert launches == []                      # nothing launched yet
+    assert p2.collect() == [True, False, True]
+    assert launches == [6]                     # one fused launch
+    assert p1.collect() == [True, True]
+    assert p3.collect() == [False]
+    assert launches == [6]                     # harvests reuse it
+    p4 = hub.dispatch([good])                  # new generation
+    assert p4.collect() == [True]
+    assert launches == [6, 1]
+    assert hub.verify_batch([]) == []          # empty dispatch safe
+
+
+def test_coalescing_hub_device_roundtrip():
+    """Hub over the real JAX batch verifier: mixed dispatches with a
+    forged signature verify correctly through one device launch."""
+    from plenum_tpu.crypto.batch_verifier import create_verifier
+
+    hub = create_verifier("tpu_hub", threshold=1)
+    seed = bytes(range(32))
+    vk, _ = ed.keypair_from_seed(seed)
+    good = (b"msg-a", ed.sign(b"msg-a", seed), vk)
+    forged = (b"msg-b", ed.sign(b"msg-x", seed), vk)
+    p1 = hub.dispatch([good] * 3)
+    p2 = hub.dispatch([forged, good])
+    assert p2.collect() == [False, True]
+    assert p1.collect() == [True, True, True]
+
+
+def test_coalescing_hub_scalar_floor_and_failure_isolation():
+    """A lone small generation takes the CPU floor (no device launch);
+    a dispatch failure poisons only its own generation."""
+    from plenum_tpu.crypto.batch_verifier import CoalescingVerifierHub
+
+    launches = []
+
+    class FakeBatch:
+        def dispatch(self, items):
+            launches.append(len(items))
+            raise RuntimeError("device fell over")
+
+    hub = CoalescingVerifierHub(batch=FakeBatch(), threshold=4)
+    seed = bytes(range(32))
+    vk, _ = ed.keypair_from_seed(seed)
+    good = (b"m", ed.sign(b"m", seed), vk)
+    # below threshold: CPU floor, the failing batch backend never runs
+    assert hub.verify_batch([good, good]) == [True, True]
+    assert launches == []
+    # at threshold: batch backend raises, but only this generation is hit
+    p_bad = hub.dispatch([good] * 4)
+    with pytest.raises(RuntimeError):
+        p_bad.collect()
+    assert hub.verify_batch([good, good]) == [True, True]  # hub still live
+
+
 # ---------------------------------------------------------------- BLS
 
 @pytest.fixture(scope="module")
