@@ -1,0 +1,4 @@
+from plenum_tpu.client.wallet import Wallet, WalletStorageHelper
+from plenum_tpu.client.client import PoolClient
+
+__all__ = ["Wallet", "WalletStorageHelper", "PoolClient"]

@@ -1,0 +1,197 @@
+"""Pool client: signs, submits, and confirms requests against a pool.
+
+The reference keeps only the Wallet in-tree (plenum/client/wallet.py)
+and delegates the full client to the external SDK; this framework ships
+the client too, because rung-2/3 testing and the ops scripts need one:
+
+- submit to all nodes (or a subset), track REQACK / REQNACK / REJECT
+- confirm a request once f+1 nodes return matching Reply results
+  (Quorums.reply — the BFT read quorum on write acks)
+- timer-driven resubmission of unconfirmed requests
+
+Transport-agnostic: `send_fn(node_name, msg_dict)` is injected — the
+SimNetwork client channel in tests, the TCP client stack in deployment
+(server side: plenum_tpu/server/networked_node.py clientstack).
+Inbound replies are fed to `receive(node_name, msg)` as either
+MessageBase objects or wire dicts.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, Dict, List, Optional, Sequence
+
+from plenum_tpu.common.constants import OP_FIELD_NAME
+from plenum_tpu.common.messages.node_messages import (
+    Reject, Reply, RequestAck, RequestNack)
+from plenum_tpu.common.request import Request
+from plenum_tpu.consensus.quorums import Quorums
+from plenum_tpu.runtime.timer import RepeatingTimer, TimerService
+from plenum_tpu.client.wallet import Wallet
+
+logger = logging.getLogger(__name__)
+
+_CLIENT_MSG_CLASSES = {c.typename: c for c in
+                       (Reply, RequestAck, RequestNack, Reject)}
+
+
+class RequestStatus:
+    """Per-request bookkeeping: who acked/nacked, which results arrived."""
+
+    def __init__(self, req: Request):
+        self.request = req
+        self.acks: set = set()
+        self.nacks: Dict[str, str] = {}
+        self.rejects: Dict[str, str] = {}
+        self.replies: Dict[str, dict] = {}   # node -> result
+        self.confirmed_result: Optional[dict] = None
+        self.failed: bool = False            # terminally nacked/rejected
+
+    @property
+    def key(self):
+        return (self.request.identifier, self.request.reqId)
+
+
+def _result_fingerprint(result: dict) -> str:
+    """Node-agnostic identity of a Reply result for quorum matching."""
+    return json.dumps(result, sort_keys=True, default=str)
+
+
+class PoolClient:
+    def __init__(self, wallet: Wallet, node_names: Sequence[str],
+                 send_fn: Callable[[str, dict], None],
+                 timer: TimerService = None,
+                 resubmit_interval: float = 15.0):
+        self.wallet = wallet
+        self.node_names = list(node_names)
+        self._send = send_fn
+        self.quorums = Quorums(len(self.node_names))
+        self._pending: Dict[tuple, RequestStatus] = {}
+        self._completed: Dict[tuple, RequestStatus] = {}
+        self._resubmitter = None
+        if timer is not None and resubmit_interval > 0:
+            self._resubmitter = RepeatingTimer(
+                timer, resubmit_interval, self._resubmit_pending)
+
+    # ---------------------------------------------------------- submit
+
+    def submit(self, operation: dict, identifier: str = None,
+               taa_acceptance: dict = None) -> Request:
+        """Sign an operation with the wallet and send it to every node."""
+        req = self.wallet.sign_op(operation, identifier=identifier,
+                                  taa_acceptance=taa_acceptance)
+        return self.submit_request(req)
+
+    def submit_request(self, req: Request) -> Request:
+        status = RequestStatus(req)
+        self._pending[status.key] = status
+        self._broadcast(req)
+        return req
+
+    def _broadcast(self, req: Request):
+        for name in self.node_names:
+            try:
+                self._send(name, req.as_dict())
+            except Exception:
+                logger.warning("send to %s failed", name, exc_info=True)
+
+    def _resubmit_pending(self):
+        for status in list(self._pending.values()):
+            self._broadcast(status.request)
+
+    # --------------------------------------------------------- receive
+
+    def receive(self, node_name: str, msg) -> None:
+        """Feed one inbound client-stack message (object or wire dict)."""
+        if isinstance(msg, dict):
+            msg = self._from_wire(msg)
+            if msg is None:
+                return
+        if isinstance(msg, Reply):
+            self._on_reply(node_name, msg)
+        elif isinstance(msg, RequestAck):
+            self._on_status(node_name, msg, "acks")
+        elif isinstance(msg, RequestNack):
+            self._on_status(node_name, msg, "nacks")
+        elif isinstance(msg, Reject):
+            self._on_status(node_name, msg, "rejects")
+
+    @staticmethod
+    def _result_key(result: dict):
+        """(identifier, reqId) from a Reply result — write results are
+        committed txns (author/reqId under txn.metadata, txn_util
+        format), read results carry them at top level."""
+        try:
+            from plenum_tpu.common.txn_util import get_from, get_req_id
+            frm, rid = get_from(result), get_req_id(result)
+            if frm is not None or rid is not None:
+                return (frm, rid)
+        except Exception:
+            pass
+        return (result.get("identifier"), result.get("reqId"))
+
+    @staticmethod
+    def _from_wire(d: dict):
+        cls = _CLIENT_MSG_CLASSES.get(d.get(OP_FIELD_NAME))
+        if cls is None:
+            return None
+        fields = {k: v for k, v in d.items() if k != OP_FIELD_NAME}
+        try:
+            return cls(**fields)
+        except Exception:
+            logger.warning("malformed client-stack message: %r", d)
+            return None
+
+    def _on_status(self, node_name: str, msg, bucket: str):
+        key = (msg.identifier, msg.reqId)
+        status = self._pending.get(key) or self._completed.get(key)
+        if status is None:
+            return
+        if bucket == "acks":
+            status.acks.add(node_name)
+            return
+        getattr(status, bucket)[node_name] = msg.reason
+        # terminal failure: once n-f nodes nacked/rejected, fewer than
+        # f+1 can ever produce matching Replies — stop resubmitting
+        refused = set(status.nacks) | set(status.rejects)
+        if (key in self._pending
+                and self.quorums.strong.is_reached(len(refused))):
+            status.failed = True
+            self._completed[key] = self._pending.pop(key)
+
+    def _on_reply(self, node_name: str, msg: Reply):
+        result = msg.result or {}
+        key = self._result_key(result)
+        status = self._pending.get(key)
+        if status is None:
+            return
+        status.replies[node_name] = result
+        by_fp: Dict[str, List[str]] = {}
+        for node, res in status.replies.items():
+            by_fp.setdefault(_result_fingerprint(res), []).append(node)
+        for fp, nodes in by_fp.items():
+            if self.quorums.reply.is_reached(len(nodes)):
+                status.confirmed_result = status.replies[nodes[0]]
+                self._completed[key] = self._pending.pop(key)
+                break
+
+    # ----------------------------------------------------------- query
+
+    def status_of(self, req: Request) -> Optional[RequestStatus]:
+        key = (req.identifier, req.reqId)
+        return self._pending.get(key) or self._completed.get(key)
+
+    def result_of(self, req: Request) -> Optional[dict]:
+        status = self.status_of(req)
+        return status.confirmed_result if status else None
+
+    def is_confirmed(self, req: Request) -> bool:
+        return self.result_of(req) is not None
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    def close(self):
+        if self._resubmitter is not None:
+            self._resubmitter.stop()

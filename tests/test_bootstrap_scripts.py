@@ -1,0 +1,151 @@
+"""Ops bootstrap (SURVEY §1 layer 12, reference setup.py:145-154): key
+init, pool genesis generation, and starting nodes from on-disk state —
+the full operator flow, ending with a steward write ordered over real
+sockets by nodes booted purely from files.
+"""
+import asyncio
+import json
+import os
+
+import pytest
+
+from plenum_tpu.bootstrap import (
+    DOMAIN_GENESIS_FILE, POOL_GENESIS_FILE, build_networked_node,
+    client_ha_from_pool_genesis, generate_pool, init_node_keys,
+    load_node_keys, read_genesis, registry_from_pool_genesis)
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    NODE, NYM, STEWARD, TARGET_NYM, TRUSTEE, VERKEY)
+from plenum_tpu.common.txn_util import get_payload_data, get_type
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+
+
+def _free_base_port() -> int:
+    """Grab an ephemeral port as a base for a 2*N contiguous block (the
+    block itself is not reserved, but collisions are vanishingly rare)."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1] + 100
+
+
+def test_init_node_keys_idempotent(tdir):
+    info1 = init_node_keys("Alpha", tdir, seed=b"\x50" * 32)
+    info2 = init_node_keys("Alpha", tdir)            # load, not regen
+    assert info1 == info2
+    info3 = init_node_keys("Alpha", tdir, force=True)
+    assert info3["verkey"] != info1["verkey"]
+    keys, info = load_node_keys("Alpha", tdir)
+    assert keys.verkey == info3["verkey"]
+
+
+def test_generate_pool_writes_genesis_and_wallets(tdir):
+    summary = generate_pool(tdir, NAMES, base_port=9800)
+    assert os.path.exists(os.path.join(tdir, POOL_GENESIS_FILE))
+    assert os.path.exists(os.path.join(tdir, DOMAIN_GENESIS_FILE))
+    txns = read_genesis(tdir)
+    assert sum(1 for t in txns if get_type(t) == NODE) == 4
+    nyms = [t for t in txns if get_type(t) == NYM]
+    roles = [get_payload_data(t).get("role") for t in nyms]
+    assert roles.count(TRUSTEE) == 1 and roles.count(STEWARD) == 4
+    registry = registry_from_pool_genesis(tdir)
+    assert sorted(registry) == sorted(NAMES)
+    assert registry["Alpha"].ha.port == 9800
+    assert client_ha_from_pool_genesis(tdir, "Beta").port == 9803
+    # steward wallets reload with signing intact
+    from plenum_tpu.client.wallet import WalletStorageHelper
+    helper = WalletStorageHelper(os.path.join(tdir, "keyrings"))
+    w = helper.load_wallet("steward_Alpha")
+    assert w.default_id == summary["nodes"][0]["steward"]
+
+
+def test_cli_scripts_run(tdir):
+    """The executable scripts themselves (argparse plumbing)."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "init_plenum_tpu_keys"),
+         "--name", "Solo", "--base-dir", tdir],
+        capture_output=True, text=True, check=True)
+    info = json.loads(out.stdout)
+    assert info["name"] == "Solo" and info["verkey"]
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "scripts", "generate_plenum_tpu_pool"),
+         "--base-dir", os.path.join(tdir, "pool"),
+         "--nodes", "A,B,C,D", "--base-port", "9900"],
+        capture_output=True, text=True, check=True)
+    summary = json.loads(out.stdout)
+    assert [n["name"] for n in summary["nodes"]] == ["A", "B", "C", "D"]
+
+
+def test_pool_boots_from_files_and_orders(tdir):
+    """End-to-end operator flow: generate pool → boot 4 nodes from disk
+    → steward wallet (loaded from disk) writes a NYM over a real client
+    socket → ordered with agreement."""
+    from plenum_tpu.client import PoolClient, Wallet, WalletStorageHelper
+    from plenum_tpu.network.stack import ClientConnection
+
+    base_port = _free_base_port()
+    generate_pool(tdir, NAMES, base_port=base_port)
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15, HEARTBEAT_FREQ=60)
+
+    async def main():
+        nodes = [build_networked_node(n, tdir, config=conf) for n in NAMES]
+        for n in nodes:
+            await n.start_async()
+
+        async def pump(seconds, until=None):
+            end = asyncio.get_event_loop().time() + seconds
+            while asyncio.get_event_loop().time() < end:
+                for n in nodes:
+                    await n.prod()
+                if until is not None and until():
+                    return True
+                await asyncio.sleep(0.01)
+            return until() if until is not None else True
+
+        ok = await pump(10, until=lambda: all(
+            len(n.nodestack.connecteds) == 3 for n in nodes))
+        assert ok, {n.name: n.nodestack.connecteds for n in nodes}
+
+        # steward wallet from disk signs; PoolClient submits over a real
+        # encrypted client connection to every node
+        helper = WalletStorageHelper(os.path.join(tdir, "keyrings"))
+        wallet = helper.load_wallet("steward_Alpha")
+        conns = {}
+        for n in nodes:
+            _, info = load_node_keys(n.name, tdir)
+            c = ClientConnection(client_ha_from_pool_genesis(tdir, n.name))
+            await c.connect()
+            conns[n.name] = c
+
+        client = PoolClient(wallet, NAMES,
+                            lambda name, d: conns[name].send(d))
+        dest = Wallet("w")
+        dest_idr, dest_signer = dest.add_identifier(seed=b"\x51" * 32)
+        req = client.submit({"type": NYM, TARGET_NYM: dest_idr,
+                             VERKEY: dest_signer.verkey})
+
+        def drain():
+            for name, c in conns.items():
+                while c.rx:
+                    client.receive(name, c.rx.popleft())
+            return client.is_confirmed(req)
+
+        ok = await pump(20, until=drain)
+        assert ok, "write not confirmed"
+        result = client.result_of(req)
+        assert result["txnMetadata"]["seqNo"] >= 1
+        roots = {n.node.domain_ledger.root_hash for n in nodes}
+        assert len(roots) == 1
+        for c in conns.values():
+            c.close()
+        for n in nodes:
+            await n.nodestack.stop()
+            await n.clientstack.stop()
+
+    asyncio.run(main())

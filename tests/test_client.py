@@ -1,0 +1,184 @@
+"""Client layer (SURVEY §1 layer 11): wallet signing (single + multi-sig
+against the server authenticator), wallet storage permissions, and the
+PoolClient confirming writes via f+1 matching Replies on a live 4-node
+sim pool. Reference: plenum/client/wallet.py:38,294.
+"""
+import os
+import stat
+
+import pytest
+
+from plenum_tpu.client import PoolClient, Wallet, WalletStorageHelper
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.client_authn import CoreAuthNr
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.sim_network import SimNetwork
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+
+
+def test_wallet_sign_request_authenticates():
+    w = Wallet("w1")
+    idr, signer = w.add_identifier(signer=SimpleSigner(seed=b"\x31" * 32))
+    req = w.sign_op({"type": NYM, TARGET_NYM: idr})
+    authnr = CoreAuthNr()
+    authnr.addIdr(idr, signer.verkey)
+    assert authnr.authenticate(req) == [idr]
+
+
+def test_wallet_multi_sig_authenticates():
+    w = Wallet("w2")
+    idr1, s1 = w.add_identifier(signer=SimpleSigner(seed=b"\x32" * 32),
+                                alias="first")
+    idr2, s2 = w.add_identifier(signer=SimpleSigner(seed=b"\x33" * 32),
+                                alias="second")
+    req = w.sign_op({"type": NYM, TARGET_NYM: idr1}, identifier=idr1)
+    req.signature = None                       # pure multi-sig form
+    w.sign_using_multi_sig(req, identifier=idr1)
+    w.sign_using_multi_sig(req, identifier=idr2)
+    authnr = CoreAuthNr()
+    authnr.addIdr(idr1, s1.verkey)
+    authnr.addIdr(idr2, s2.verkey)
+    assert authnr.authenticate(req) == sorted([idr1, idr2])
+    # one forged signature fails the whole request
+    req.signatures[idr2] = req.signatures[idr1]
+    with pytest.raises(Exception):
+        authnr.authenticate(req)
+
+
+def test_wallet_aliases_and_default():
+    w = Wallet()
+    idr1, _ = w.add_identifier(seed=b"\x34" * 32, alias="steward")
+    idr2, _ = w.add_identifier(seed=b"\x35" * 32)
+    assert w.default_id == idr1
+    assert w.required_idr(alias="steward") == idr1
+    assert w.identifiers == [idr1, idr2]
+    assert w.get_verkey(idr2)
+    with pytest.raises(KeyError):
+        w.required_idr("unknown")
+
+
+def test_wallet_storage_roundtrip_and_permissions(tdir):
+    helper = WalletStorageHelper(os.path.join(tdir, "keyrings"))
+    w = Wallet("alice")
+    idr, _ = w.add_identifier(seed=b"\x36" * 32, alias="main")
+    path = helper.save_wallet(w)
+    assert stat.S_IMODE(os.stat(path).st_mode) == 0o600
+    assert stat.S_IMODE(os.stat(os.path.dirname(path)).st_mode) == 0o700
+    w2 = helper.load_wallet("alice")
+    assert w2.identifiers == [idr]
+    assert w2.alias_of(idr) == "main"
+    assert w2.default_id == idr
+    # same seed -> same signatures
+    assert (w2.sign_msg({"a": 1}, idr) == w.sign_msg({"a": 1}, idr))
+    with pytest.raises(ValueError):
+        helper.save_wallet(Wallet("../escape"))
+
+
+@pytest.fixture
+def pool_with_client(mock_timer):
+    mock_timer.set_time(1600000000)
+    net = SimNetwork(mock_timer, DefaultSimRandom(5))
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15)
+    wallet = Wallet("client")
+    wallet.add_identifier(signer=SimpleSigner(seed=b"\x37" * 32))
+
+    client = None
+    nodes = []
+
+    def reply_handler_for(name):
+        def handler(client_id, msg):
+            client.receive(name, msg.to_dict())   # wire-dict path
+        return handler
+
+    for name in NAMES:
+        nodes.append(Node(name, NAMES, mock_timer, net.create_peer(name),
+                          config=conf,
+                          client_reply_handler=reply_handler_for(name)))
+
+    def send(node_name, req_dict):
+        next(n for n in nodes if n.name == node_name) \
+            .process_client_request(dict(req_dict), "cli")
+
+    client = PoolClient(wallet, NAMES, send, timer=mock_timer,
+                        resubmit_interval=30.0)
+    return client, nodes, mock_timer
+
+
+def pump(timer, nodes, seconds=6.0, step=0.05):
+    end = timer.get_current_time() + seconds
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(step)
+
+
+def test_pool_client_write_confirmed(pool_with_client):
+    client, nodes, timer = pool_with_client
+    dest = SimpleSigner(seed=b"\x38" * 32)
+    req = client.submit({"type": NYM, TARGET_NYM: dest.identifier,
+                         VERKEY: dest.verkey})
+    pump(timer, nodes)
+    status = client.status_of(req)
+    assert len(status.acks) == len(NAMES)
+    assert client.is_confirmed(req)
+    result = client.result_of(req)
+    assert result["txnMetadata"]["seqNo"] >= 1
+    assert client.pending_count == 0
+
+
+def test_pool_client_nack_terminal(pool_with_client):
+    """n-f nacks mark a request terminally failed: it leaves the pending
+    set, so the resubmit timer stops rebroadcasting it."""
+    client, nodes, timer = pool_with_client
+    dest = SimpleSigner(seed=b"\x39" * 32)
+    req = client.wallet.sign_op({"type": NYM, TARGET_NYM: dest.identifier})
+    req.signature = "1" * 88                   # corrupt after signing
+    client.submit_request(req)
+    pump(timer, nodes, seconds=3.0)
+    status = client.status_of(req)
+    assert len(status.nacks) == len(NAMES)
+    assert status.failed
+    assert not client.is_confirmed(req)
+    assert client.pending_count == 0
+
+
+def test_req_ids_unique_in_tight_loop():
+    w = Wallet()
+    w.add_identifier(seed=b"\x3b" * 32)
+    ids = {w.sign_op({"type": NYM}).reqId for _ in range(200)}
+    assert len(ids) == 200
+
+
+def test_sign_request_rejects_foreign_identifier():
+    from plenum_tpu.common.request import Request
+    w = Wallet()
+    idr, _ = w.add_identifier(seed=b"\x3c" * 32)
+    req = Request(identifier="SomeoneElse", reqId=1, operation={"type": NYM})
+    with pytest.raises(ValueError):
+        w.sign_request(req, identifier=idr)
+
+
+def test_pool_client_resubmits_until_confirmed(pool_with_client):
+    client, nodes, timer = pool_with_client
+    # drop the first broadcast entirely: only 1 of 4 nodes hears it
+    heard = []
+    real_send = client._send
+
+    def flaky_send(name, d):
+        if len(heard) < 1:
+            heard.append(name)
+            real_send(name, d)
+    client._send = flaky_send
+    dest = SimpleSigner(seed=b"\x3a" * 32)
+    req = client.submit({"type": NYM, TARGET_NYM: dest.identifier,
+                         VERKEY: dest.verkey})
+    pump(timer, nodes, seconds=5.0)
+    assert not client.is_confirmed(req)
+    client._send = real_send                   # network heals
+    pump(timer, nodes, seconds=31.0)           # resubmit timer fires
+    assert client.is_confirmed(req)
