@@ -218,13 +218,16 @@ def build_networked_node(name: str, base_dir: str, config=None):
 
     domain_txns = list(
         GenesisTxnInitiatorFromFile(base_dir, DOMAIN_GENESIS_FILE)())
+    from plenum_tpu.utils.metrics import KvStoreMetricsCollector
     return NetworkedNode(
         name, registry, keys,
         node_ha=registry[name].ha,
         client_ha=client_ha_from_txns(pool_txns, name),
         config=config,
         storage_factory=storage_factory,
-        genesis_txns=pool_txns + domain_txns)
+        genesis_txns=pool_txns + domain_txns,
+        metrics=KvStoreMetricsCollector(storage_factory("metrics")),
+        info_dir=os.path.join(base_dir, name))
 
 
 async def run_node(node, stop_event=None) -> None:

@@ -75,6 +75,11 @@ class Config:
     REPLICAS_REMOVING_WITH_DEGRADATION = "local"
     REPLICAS_REMOVING_WITH_PRIMARY_DISCONNECTED = "local"
 
+    # ---- metrics / validator info (reference plenum/config.py
+    # METRICS_COLLECTOR_TYPE + DUMP_VALIDATOR_INFO_PERIOD_SEC)
+    METRICS_FLUSH_INTERVAL = 10          # seconds between KV flushes
+    VALIDATOR_INFO_DUMP_INTERVAL = 60    # seconds between JSON dumps
+
     # ---- storage
     domainStateStorage = "memory"
     poolStateStorage = "memory"

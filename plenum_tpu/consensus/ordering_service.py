@@ -255,6 +255,32 @@ class OrderingService:
                 break
             self._send_one_batch(ledger_id, queue)
             sent += 1
+        sent += self._send_freshness_batches()
+        return sent
+
+    def _send_freshness_batches(self) -> int:
+        """EMPTY batches for ledgers whose signed state went stale
+        (reference ordering_service.py send_3pc_freshness_batch): keeps
+        BLS root signatures fresh with zero client traffic."""
+        if self._freshness_checker is None:
+            return 0
+        sent = 0
+        for ledger_id, _age in self._freshness_checker.get_outdated(
+                self._get_time()):
+            if self.requestQueues.get(ledger_id):
+                continue    # real traffic queued: it will refresh anyway
+            in_flight = (self.lastPrePrepareSeqNo
+                         - self._data.last_ordered_3pc[1])
+            if in_flight >= self._config.Max3PCBatchesInFlight:
+                break
+            if not self._data.is_in_watermarks(self.lastPrePrepareSeqNo + 1):
+                break
+            self._send_batch_of(ledger_id, [])
+            # optimistic bump so one stale period emits one batch; the
+            # ordered batch will set the real time
+            self._freshness_checker.update_freshness(ledger_id,
+                                                     self._get_time())
+            sent += 1
         return sent
 
     def _send_one_batch(self, ledger_id: int, queue: OrderedDict):
@@ -263,6 +289,9 @@ class OrderingService:
             d, _ = queue.popitem(last=False)
             self._queue_entry_time.pop(d, None)
             digests.append(d)
+        self._send_batch_of(ledger_id, digests)
+
+    def _send_batch_of(self, ledger_id: int, digests: List[str]):
         pp_seq_no = self.lastPrePrepareSeqNo + 1
         pp_time = self._get_time()
         pp_digest = self.generate_pp_digest(digests, self.view_no, pp_time)
@@ -556,6 +585,8 @@ class OrderingService:
         self.ordered.add(key)
         self._data.last_ordered_3pc = key
         self._consume_from_queue(pp)
+        if self._freshness_checker is not None:
+            self._freshness_checker.update_freshness(pp.ledgerId, pp.ppTime)
         if self._bls is not None:
             self._bls.process_order(key, self.commits[key], pp,
                                     self._data.quorums)

@@ -36,7 +36,8 @@ class ReplicaService:
                  config: Optional[Config] = None,
                  bls_bft_replica=None,
                  internal_bus: Optional[InternalBus] = None,
-                 checkpoint_digest_source: Optional[Callable] = None):
+                 checkpoint_digest_source: Optional[Callable] = None,
+                 freshness_checker=None):
         self.name = name
         self.config = config or Config()
         self.internal_bus = internal_bus or InternalBus()
@@ -58,7 +59,8 @@ class ReplicaService:
         self.ordering = OrderingService(
             data=self._data, timer=timer, bus=self.internal_bus,
             network=network, executor=self.executor, stasher=self.stasher,
-            config=self.config, bls_bft_replica=bls_bft_replica)
+            config=self.config, bls_bft_replica=bls_bft_replica,
+            freshness_checker=freshness_checker if is_master else None)
         self.checkpointer = CheckpointService(
             data=self._data, bus=self.internal_bus, network=network,
             stasher=self.stasher, config=self.config,

@@ -451,9 +451,10 @@ class NodeStack(StackBase):
                 continue
             remote.outbox.append(raw)
 
-    def flush_outboxes(self):
+    def flush_outboxes(self) -> int:
         """Coalesce each remote's outbox into signed BATCH frames
-        (reference batched.py:91 flushOutBoxes)."""
+        (reference batched.py:91 flushOutBoxes). → messages flushed."""
+        flushed = 0
         for remote in self.remotes.values():
             if not remote.outbox:
                 continue
@@ -464,6 +465,7 @@ class NodeStack(StackBase):
                 continue
             msgs = list(remote.outbox)
             remote.outbox.clear()
+            flushed += len(msgs)
             try:
                 if len(msgs) == 1:
                     remote.conn.send_frame(msgs[0])
@@ -475,7 +477,9 @@ class NodeStack(StackBase):
                             self.name, remote.name)
                 remote.disconnect()
                 remote.outbox.extendleft(reversed(msgs))
+                flushed -= len(msgs)
         self._emit_connecteds()
+        return flushed
 
     def _make_batches(self, msgs: List[bytes]) -> List[bytes]:
         """Pack serialized messages into signed batches under the size

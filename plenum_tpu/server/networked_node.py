@@ -23,6 +23,7 @@ from plenum_tpu.network.keys import NodeKeys
 from plenum_tpu.network.stack import (
     HA, ClientStack, NodeStack, RemoteInfo)
 from plenum_tpu.server.node import Node
+from plenum_tpu.utils.metrics import MetricsName
 
 logger = logging.getLogger(__name__)
 
@@ -33,7 +34,8 @@ class NetworkedNode(Prodable):
                  config: Optional[Config] = None,
                  timer: Optional[QueueTimer] = None,
                  storage_factory=None,
-                 genesis_txns: Optional[List[dict]] = None):
+                 genesis_txns: Optional[List[dict]] = None,
+                 metrics=None, info_dir: Optional[str] = None):
         import time
         self._name = name
         self.config = config or Config()
@@ -55,7 +57,38 @@ class NetworkedNode(Prodable):
                          config=self.config,
                          storage_factory=storage_factory,
                          client_reply_handler=self._reply_to_client,
-                         genesis_txns=genesis_txns)
+                         genesis_txns=genesis_txns,
+                         metrics=metrics)
+
+        # periodic metrics flush + validator-info dump (reference
+        # node.py: dump_additional_info / flush on prod)
+        from plenum_tpu.runtime.timer import RepeatingTimer
+
+        def _guarded(label, fn):
+            # a transient I/O error must neither crash the prod tick nor
+            # kill the repeating timer
+            def run():
+                try:
+                    fn()
+                except Exception:
+                    logger.warning("%s: %s failed", name, label,
+                                   exc_info=True)
+            return run
+
+        if metrics is not None:
+            RepeatingTimer(self.timer, self.config.METRICS_FLUSH_INTERVAL,
+                           _guarded("metrics flush",
+                                    metrics.flush_accumulated))
+        self.info_tool = None
+        if info_dir is not None:
+            from plenum_tpu.server.validator_info import (
+                ValidatorNodeInfoTool)
+            self.info_tool = ValidatorNodeInfoTool(self.node,
+                                                   metrics=metrics)
+            RepeatingTimer(
+                self.timer, self.config.VALIDATOR_INFO_DUMP_INTERVAL,
+                _guarded("validator-info dump",
+                         lambda: self.info_tool.dump_json_file(info_dir)))
 
     # --------------------------------------------------------- tx glue
 
@@ -118,5 +151,8 @@ class NetworkedNode(Prodable):
         c += self.node.service()
         c += self.timer.service()
         self.nodestack.service_lifecycle()
-        self.nodestack.flush_outboxes()
+        flushed = self.nodestack.flush_outboxes()
+        if flushed:
+            self.node.metrics.add_event(
+                MetricsName.TRANSPORT_BATCH_SIZE, flushed)
         return c

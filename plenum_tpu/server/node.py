@@ -47,6 +47,7 @@ from plenum_tpu.server.write_request_manager import (
     ReadRequestManager, WriteRequestManager)
 from plenum_tpu.state.pruning_state import PruningState
 from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
 
@@ -112,11 +113,13 @@ class Node:
                  client_reply_handler: Callable[[str, object], None] = None,
                  bls_bft_replica=None,
                  genesis_txns: Optional[List[dict]] = None,
-                 on_membership_change: Callable[[List[str]], None] = None):
+                 on_membership_change: Callable[[List[str]], None] = None,
+                 metrics=None):
         """network: ExternalBus to peers; client_reply_handler(client_id,
         msg) delivers Acks/Nacks/Replies back to clients."""
         self.name = name
         self.config = config or Config()
+        self.metrics = metrics or NullMetricsCollector()
         self.timer = timer
         self.network = network
         self._reply_to_client = client_reply_handler or (lambda c, m: None)
@@ -186,10 +189,24 @@ class Node:
                 self.replica.ordering._last_applied_seq + 1,
             on_batch_committed=self._on_batch_committed,
             on_request_rejected=self._on_request_rejected)
+        # ---- freshness: stale ledgers get empty batches so BLS-signed
+        # state roots never age past the timeout (reference
+        # replica_freshness_checker.py)
+        from plenum_tpu.consensus.freshness_checker import FreshnessChecker
+        self.freshness_checker = None
+        if (self.config.UPDATE_STATE_FRESHNESS
+                and self.config.STATE_FRESHNESS_UPDATE_INTERVAL > 0):
+            self.freshness_checker = FreshnessChecker(
+                self.config.STATE_FRESHNESS_UPDATE_INTERVAL)
+            for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID):
+                self.freshness_checker.register_ledger(
+                    lid, timer.get_current_time())
+
         self.replica = ReplicaService(
             name, validators, timer, network, executor=self.executor,
             config=self.config, bls_bft_replica=bls_bft_replica,
-            checkpoint_digest_source=self._audit_root_at)
+            checkpoint_digest_source=self._audit_root_at,
+            freshness_checker=self.freshness_checker)
 
         # ---- RBFT redundant instances: f backups benchmark the master
         from plenum_tpu.server.replicas import (
@@ -517,6 +534,8 @@ class Node:
             parsed.append((request, client_id))
         if not parsed:
             return None
+        self.metrics.add_event(MetricsName.CLIENT_AUTH_BATCH_SIZE,
+                               len(parsed))
         handle = self.authnr.dispatch_batch([r for r, _ in parsed])
         return (parsed, handle)
 
@@ -525,7 +544,8 @@ class Node:
         if pending is None:
             return
         parsed, handle = pending
-        results = self.authnr.conclude_batch(handle)
+        with self.metrics.measure_time(MetricsName.CLIENT_AUTH_TIME):
+            results = self.authnr.conclude_batch(handle)
         for (request, client_id), idrs in zip(parsed, results):
             if idrs is None:
                 self._reply_to_client(client_id, RequestNack(
@@ -598,11 +618,14 @@ class Node:
     def _on_backup_ordered(self, ordered: Ordered):
         """Backup instances never execute; they only feed the monitor's
         master-vs-backup throughput comparison (RBFT ratio path)."""
+        self.metrics.add_event(MetricsName.BACKUP_ORDERED, 1)
         for digest in ordered.valid_reqIdr:
             self.monitor.request_ordered(digest, ordered.instId)
 
     def _on_batch_committed(self, ordered: Ordered, committed_txns):
         """Send Replies with audit paths; update dedup index; free reqs."""
+        self.metrics.add_event(MetricsName.ORDERED_BATCH_COMMITTED,
+                               len(committed_txns or []))
         ledger = self.db_manager.get_ledger(ordered.ledgerId)
         for txn in committed_txns or []:
             seq_no = get_seq_no(txn)
@@ -702,6 +725,7 @@ class Node:
     def _on_catchup_txn(self, ledger_id: int, txn: dict):
         """Apply one caught-up txn: ledger append + state update
         (reference postTxnFromCatchupAddedToLedger node.py:1748)."""
+        self.metrics.add_event(MetricsName.CATCHUP_TXNS_RECEIVED, 1)
         from plenum_tpu.common.txn_util import get_payload_digest, get_type
         ledger = self.db_manager.get_ledger(ledger_id)
         ledger.add(dict(txn))
@@ -758,7 +782,8 @@ class Node:
 
     def service(self):
         """One prod tick: all protocol instances (master + backups)."""
-        return self.replicas.service()
+        with self.metrics.measure_time(MetricsName.NODE_PROD_TIME):
+            return self.replicas.service()
 
     # ------------------------------------------------------- inspection
 

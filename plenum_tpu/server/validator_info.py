@@ -1,0 +1,137 @@
+"""Validator status snapshot — the operator's one-stop node dump.
+
+Reference: plenum/server/validator_info_tool.py:54
+(ValidatorNodeInfoTool — alias/did, pool counts, ledger sizes + root
+hashes, per-replica status, mode, metrics averages, periodic JSON
+dump). Same shape here, reading the live Node aggregate.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from plenum_tpu.common.constants import (
+    AUDIT_LEDGER_ID, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID, POOL_LEDGER_ID)
+
+_LEDGER_NAMES = {
+    POOL_LEDGER_ID: "pool",
+    DOMAIN_LEDGER_ID: "domain",
+    CONFIG_LEDGER_ID: "config",
+    AUDIT_LEDGER_ID: "audit",
+}
+
+
+class ValidatorNodeInfoTool:
+    def __init__(self, node, metrics=None, get_time=time.time):
+        self._node = node
+        self._metrics = metrics
+        self._get_time = get_time
+        self._started_at = get_time()
+
+    # ------------------------------------------------------------- info
+
+    @property
+    def info(self) -> dict:
+        node = self._node
+        return {
+            "alias": node.name,
+            "timestamp": int(self._get_time()),
+            "uptime_s": int(self._get_time() - self._started_at),
+            "Node_info": {
+                "Name": node.name,
+                "Mode": ("participating" if node.mode_participating
+                         else ("syncing" if node.leecher.in_progress
+                               else "stalled")),
+                "View_no": node.view_no,
+                "Last_ordered_3PC": list(node.last_ordered),
+                "Master_primary": node.master_primary_name,
+                "Count_of_replicas": node.replicas.num_instances,
+                "Replicas_status": self._replicas_status(),
+                "Committed_ledger_root_hashes": self._ledger_roots(),
+                "Committed_state_root_hashes": self._state_roots(),
+                "Ledger_sizes": self._ledger_sizes(),
+            },
+            "Pool_info": self._pool_info(),
+            "Software": {"plenum_tpu": _version()},
+            "Metrics": (self._metrics.summary()
+                        if self._metrics is not None
+                        and hasattr(self._metrics, "summary") else {}),
+        }
+
+    def _replicas_status(self) -> dict:
+        out = {}
+        for replica in self._node.replicas:
+            data = replica.data
+            out[str(data.inst_id)] = {
+                "Primary": data.primary_name,
+                "Watermarks": "{}:{}".format(data.low_watermark,
+                                             data.high_watermark),
+                "Last_ordered_3PC": list(data.last_ordered_3pc),
+            }
+        return out
+
+    def _ledger_roots(self) -> dict:
+        out = {}
+        for lid, name in _LEDGER_NAMES.items():
+            ledger = self._node.db_manager.get_ledger(lid)
+            if ledger is not None:
+                out[name] = str(ledger.root_hash)
+        return out
+
+    def _state_roots(self) -> dict:
+        out = {}
+        for lid, name in _LEDGER_NAMES.items():
+            state = self._node.db_manager.get_state(lid)
+            if state is not None:
+                from plenum_tpu.common.serializers.base58 import b58encode
+                out[name] = b58encode(state.committedHeadHash)
+        return out
+
+    def _ledger_sizes(self) -> dict:
+        out = {}
+        for lid, name in _LEDGER_NAMES.items():
+            ledger = self._node.db_manager.get_ledger(lid)
+            if ledger is not None:
+                out[name] = ledger.size
+        return out
+
+    def _pool_info(self) -> dict:
+        node = self._node
+        validators = list(node.replica.data.validators)
+        quorums = node.replica.data.quorums
+        info = {
+            "Total_nodes_count": len(validators),
+            "f_value": quorums.f,
+            "Quorums": repr(quorums),
+            "Validators": validators,
+        }
+        bus = node.network
+        connecteds = getattr(bus, "connecteds", None)
+        if connecteds is not None:
+            reachable = sorted(set(connecteds) | {node.name})
+            info["Reachable_nodes"] = reachable
+            info["Unreachable_nodes"] = sorted(
+                set(validators) - set(reachable))
+        return info
+
+    # ------------------------------------------------------------- dump
+
+    def dump_json_file(self, out_dir: str) -> str:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir,
+                            "{}_info.json".format(self._node.name.lower()))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.info, f, indent=2, default=str)
+        os.replace(tmp, path)
+        return path
+
+
+def _version() -> str:
+    try:
+        from plenum_tpu import __version__
+        return __version__
+    except ImportError:
+        return "dev"

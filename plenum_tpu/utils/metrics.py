@@ -1,0 +1,174 @@
+"""Metrics collection: named events, accumulators, KV-backed storage.
+
+Reference: plenum/common/metrics_collector.py (MetricsName :19,
+MetricsCollector :331, KvStoreMetricsFormat :388,
+KvStoreMetricsCollector :428, measure_time :348). Same model — cheap
+in-memory accumulation per metric, periodic flush of compact records to
+a KV store keyed by (timestamp, seq) — with a smaller, load-bearing
+name set and a built-in reader that aggregates stats back out.
+"""
+from __future__ import annotations
+
+import struct
+import time
+from abc import ABC, abstractmethod
+from contextlib import contextmanager
+from enum import IntEnum
+from typing import Dict, Iterator, Optional, Tuple
+
+
+class MetricsName(IntEnum):
+    # prod loop
+    NODE_PROD_TIME = 1            # seconds per Node.service tick
+    # ordering pipeline
+    ORDERED_BATCH_COMMITTED = 11  # txns committed per batch
+    BACKUP_ORDERED = 13           # batches ordered by backup instances
+    # client intake
+    CLIENT_AUTH_BATCH_SIZE = 20   # signatures per device dispatch
+    CLIENT_AUTH_TIME = 21         # device-harvest (conclude) seconds
+    # catchup
+    CATCHUP_TXNS_RECEIVED = 30
+    # transport
+    TRANSPORT_BATCH_SIZE = 50     # messages per outbox flush
+
+
+class ValueAccumulator:
+    """count/sum/min/max running stats for one metric between flushes."""
+
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def add(self, value: float):
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+
+    @property
+    def avg(self) -> Optional[float]:
+        return (self.sum / self.count) if self.count else None
+
+    def merge(self, other: "ValueAccumulator"):
+        self.count += other.count
+        self.sum += other.sum
+        for v in (other.min, other.max):
+            if v is None:
+                continue
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+
+
+class MetricsCollector(ABC):
+    """add_event accumulates in memory; flush_accumulated persists."""
+
+    def __init__(self, get_time=time.time):
+        self._get_time = get_time
+        self._acc: Dict[int, ValueAccumulator] = {}
+
+    def add_event(self, name: MetricsName, value: float):
+        acc = self._acc.get(int(name))
+        if acc is None:
+            acc = self._acc[int(name)] = ValueAccumulator()
+        acc.add(float(value))
+
+    def flush_accumulated(self):
+        ts = self._get_time()
+        for name, acc in self._acc.items():
+            self._store(ts, name, acc)
+        self._acc.clear()
+
+    @abstractmethod
+    def _store(self, ts: float, name: int, acc: ValueAccumulator): ...
+
+    @contextmanager
+    def measure_time(self, name: MetricsName):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_event(name, time.perf_counter() - start)
+
+
+class NullMetricsCollector(MetricsCollector):
+    def add_event(self, name, value):
+        pass
+
+    def _store(self, ts, name, acc):
+        pass
+
+
+_RECORD = struct.Struct(">dHIddd")  # ts, name, count, sum, min, max
+
+
+class KvStoreMetricsCollector(MetricsCollector):
+    """Flushes accumulator records to a KeyValueStorage. Key = 8-byte
+    big-endian microsecond timestamp + 4-byte seq (sortable, unique);
+    value = packed (ts, name, count, sum, min, max)."""
+
+    def __init__(self, storage, get_time=time.time,
+                 max_records: int = 100_000):
+        super().__init__(get_time)
+        self._storage = storage
+        self._seq = 0
+        self._max_records = max_records
+        self._record_keys = []          # insertion order, for retention
+        # running per-metric totals so summary() is O(metrics), not
+        # O(stored history); seeded from whatever is already on disk
+        self._totals: Dict[int, ValueAccumulator] = {}
+        for _ts, name, acc in self.events():
+            self._totals.setdefault(name, ValueAccumulator()).merge(acc)
+
+    def _store(self, ts: float, name: int, acc: ValueAccumulator):
+        key = struct.pack(">QI", int(ts * 1e6), self._seq)
+        self._seq = (self._seq + 1) & 0xFFFFFFFF
+        value = _RECORD.pack(ts, name, acc.count, acc.sum,
+                             acc.min if acc.min is not None else 0.0,
+                             acc.max if acc.max is not None else 0.0)
+        self._storage.put(key, value)
+        self._totals.setdefault(name, ValueAccumulator()).merge(acc)
+        # retention: drop oldest records past the cap (totals keep the
+        # all-time aggregate; only the per-flush history is trimmed)
+        self._record_keys.append(key)
+        while len(self._record_keys) > self._max_records:
+            old = self._record_keys.pop(0)
+            try:
+                self._storage.remove(old)
+            except Exception:
+                break
+
+    def events(self) -> Iterator[Tuple[float, int, ValueAccumulator]]:
+        for _key, value in self._storage.iterator():
+            if len(value) != _RECORD.size:
+                continue
+            ts, name, count, total, mn, mx = _RECORD.unpack(value)
+            acc = ValueAccumulator()
+            acc.count, acc.sum = count, total
+            acc.min, acc.max = mn, mx
+            yield ts, name, acc
+
+    def summary(self) -> Dict[str, dict]:
+        """All-time per-metric stats (incl. unflushed) from the running
+        totals — O(number of metrics), never walks stored history."""
+        totals: Dict[int, ValueAccumulator] = {}
+        for name, acc in self._totals.items():
+            merged = ValueAccumulator()
+            merged.merge(acc)
+            totals[name] = merged
+        for name, acc in self._acc.items():
+            totals.setdefault(name, ValueAccumulator()).merge(acc)
+        out = {}
+        for name, acc in sorted(totals.items()):
+            try:
+                label = MetricsName(name).name
+            except ValueError:
+                label = str(name)
+            out[label] = {"count": acc.count, "sum": acc.sum,
+                          "avg": acc.avg, "min": acc.min, "max": acc.max}
+        return out
+
+

@@ -1,0 +1,155 @@
+"""Metrics collection + validator-info (SURVEY §5.1). Reference:
+plenum/common/metrics_collector.py, plenum/server/validator_info_tool.py.
+"""
+import json
+import os
+
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.server.validator_info import ValidatorNodeInfoTool
+from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+from plenum_tpu.testing.sim_network import SimNetwork
+from plenum_tpu.utils.metrics import (
+    KvStoreMetricsCollector, MetricsName, NullMetricsCollector,
+    ValueAccumulator)
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+
+
+def test_value_accumulator_stats():
+    acc = ValueAccumulator()
+    for v in (3.0, 1.0, 2.0):
+        acc.add(v)
+    assert (acc.count, acc.sum, acc.min, acc.max) == (3, 6.0, 1.0, 3.0)
+    assert acc.avg == 2.0
+    other = ValueAccumulator()
+    other.add(10.0)
+    acc.merge(other)
+    assert (acc.count, acc.max) == (4, 10.0)
+
+
+def test_kv_collector_flush_and_summary():
+    fake_now = [1000.0]
+    collector = KvStoreMetricsCollector(KeyValueStorageInMemory(),
+                                        get_time=lambda: fake_now[0])
+    collector.add_event(MetricsName.ORDERED_BATCH_COMMITTED, 5)
+    collector.add_event(MetricsName.ORDERED_BATCH_COMMITTED, 15)
+    collector.add_event(MetricsName.NODE_PROD_TIME, 0.25)
+    collector.flush_accumulated()
+    fake_now[0] = 1001.0
+    collector.add_event(MetricsName.ORDERED_BATCH_COMMITTED, 10)   # unflushed
+    summary = collector.summary()
+    bs = summary["ORDERED_BATCH_COMMITTED"]
+    assert (bs["count"], bs["sum"], bs["min"], bs["max"]) == (3, 30.0, 5, 15)
+    assert summary["NODE_PROD_TIME"]["avg"] == 0.25
+    # stored events are timestamped with the flush time
+    events = list(collector.events())
+    assert all(ts == 1000.0 for ts, _, _ in events)
+    assert len(events) == 2
+
+
+def test_measure_time_records_duration():
+    collector = KvStoreMetricsCollector(KeyValueStorageInMemory())
+    with collector.measure_time(MetricsName.CLIENT_AUTH_TIME):
+        pass
+    stats = collector.summary()["CLIENT_AUTH_TIME"]
+    assert stats["count"] == 1 and stats["max"] >= 0
+
+
+def test_kv_collector_retention_keeps_totals():
+    """Old records are trimmed past max_records, but summary() totals
+    keep the all-time aggregate (and stay O(metrics), not O(history))."""
+    collector = KvStoreMetricsCollector(KeyValueStorageInMemory(),
+                                        max_records=5)
+    for i in range(20):
+        collector.add_event(MetricsName.NODE_PROD_TIME, 1.0)
+        collector.flush_accumulated()
+    assert len(list(collector.events())) == 5         # history trimmed
+    assert collector.summary()["NODE_PROD_TIME"]["count"] == 20
+
+
+def test_kv_collector_reload_seeds_totals():
+    storage = KeyValueStorageInMemory()
+    c1 = KvStoreMetricsCollector(storage)
+    c1.add_event(MetricsName.ORDERED_BATCH_COMMITTED, 7)
+    c1.flush_accumulated()
+    c2 = KvStoreMetricsCollector(storage)   # restart: same store
+    assert c2.summary()["ORDERED_BATCH_COMMITTED"]["sum"] == 7
+
+
+def test_null_collector_is_free():
+    collector = NullMetricsCollector()
+    collector.add_event(MetricsName.NODE_PROD_TIME, 1.0)
+    collector.flush_accumulated()   # no-op, no error
+
+
+@pytest.fixture
+def pool(mock_timer):
+    mock_timer.set_time(1600000000)
+    net = SimNetwork(mock_timer, DefaultSimRandom(9))
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15)
+    collectors = {n: KvStoreMetricsCollector(KeyValueStorageInMemory())
+                  for n in NAMES}
+    nodes = [Node(n, NAMES, mock_timer, net.create_peer(n), config=conf,
+                  client_reply_handler=lambda c, m: None,
+                  metrics=collectors[n])
+             for n in NAMES]
+    return nodes, collectors, mock_timer
+
+
+def _order_one(nodes, timer):
+    client = SimpleSigner(seed=b"\x60" * 32)
+    req = {"identifier": client.identifier, "reqId": 1,
+           "protocolVersion": 2,
+           "operation": {"type": NYM, TARGET_NYM: client.identifier,
+                         VERKEY: client.verkey}}
+    req["signature"] = client.sign(dict(req))
+    for n in nodes:
+        n.process_client_request(dict(req), "c1")
+    end = timer.get_current_time() + 8.0
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(0.05)
+
+
+def test_node_records_ordering_metrics(pool):
+    nodes, collectors, timer = pool
+    _order_one(nodes, timer)
+    for name, collector in collectors.items():
+        summary = collector.summary()
+        assert summary["ORDERED_BATCH_COMMITTED"]["sum"] >= 1, name
+        assert summary["NODE_PROD_TIME"]["count"] > 0, name
+
+
+def test_validator_info_shape_and_dump(pool, tdir):
+    nodes, collectors, timer = pool
+    _order_one(nodes, timer)
+    node = nodes[0]
+    tool = ValidatorNodeInfoTool(node, metrics=collectors[node.name],
+                                 get_time=timer.get_current_time)
+    info = tool.info
+    assert info["alias"] == "Alpha"
+    ni = info["Node_info"]
+    assert ni["Mode"] == "participating"
+    assert ni["View_no"] == 0
+    assert ni["Last_ordered_3PC"][1] >= 1
+    assert ni["Master_primary"] in NAMES
+    assert ni["Ledger_sizes"]["domain"] >= 1
+    assert ni["Ledger_sizes"]["audit"] >= 1
+    assert set(ni["Committed_ledger_root_hashes"]) >= {"domain", "audit"}
+    assert set(ni["Committed_state_root_hashes"]) >= {"domain"}
+    assert str(ni["Count_of_replicas"]) in ni["Replicas_status"] or \
+        len(ni["Replicas_status"]) == ni["Count_of_replicas"]
+    pi = info["Pool_info"]
+    assert pi["Total_nodes_count"] == 4 and pi["f_value"] == 1
+    assert info["Metrics"]["ORDERED_BATCH_COMMITTED"]["sum"] >= 1
+    path = tool.dump_json_file(os.path.join(tdir, "info"))
+    with open(path) as f:
+        assert json.load(f)["alias"] == "Alpha"
