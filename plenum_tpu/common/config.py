@@ -80,6 +80,11 @@ class Config:
     METRICS_FLUSH_INTERVAL = 10          # seconds between KV flushes
     VALIDATOR_INFO_DUMP_INTERVAL = 60    # seconds between JSON dumps
 
+    # ---- TAA acceptance time window (reference plenum/config.py
+    # TXN_AUTHOR_AGREEMENT_ACCEPTANCE_TIME_{BEFORE_TAA,AFTER_PP}_TIME)
+    TAA_ACCEPTANCE_TIME_BEFORE_TAA = 120
+    TAA_ACCEPTANCE_TIME_AFTER_PP_TIME = 120
+
     # ---- storage
     domainStateStorage = "memory"
     poolStateStorage = "memory"

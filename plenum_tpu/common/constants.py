@@ -72,6 +72,16 @@ TAA_ACCEPTANCE_DIGEST = "taaDigest"
 TAA_ACCEPTANCE_MECHANISM = "mechanism"
 TAA_ACCEPTANCE_TIME = "time"
 
+# --- TAA txn payload fields (reference plenum/common/constants.py:197-208)
+TXN_AUTHOR_AGREEMENT_TEXT = "text"
+TXN_AUTHOR_AGREEMENT_VERSION = "version"
+TXN_AUTHOR_AGREEMENT_DIGEST = "digest"
+TXN_AUTHOR_AGREEMENT_RETIREMENT_TS = "retirement_ts"
+TXN_AUTHOR_AGREEMENT_RATIFICATION_TS = "ratification_ts"
+AML_VERSION = "version"
+AML = "aml"
+AML_CONTEXT = "amlContext"
+
 TARGET_NYM = "dest"
 VERKEY = "verkey"
 ROLE = "role"

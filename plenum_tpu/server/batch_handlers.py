@@ -94,6 +94,31 @@ class ConfigBatchHandler(LedgerBatchHandler):
         super().__init__(dm, CONFIG_LEDGER_ID)
 
 
+class TsStoreBatchHandler(BatchRequestHandler):
+    """Records (pp_time → committed state root) per batch so reads can
+    resolve state-at-a-timestamp (reference
+    plenum/server/batch_handlers/ts_store_batch_handler.py). Registered
+    on the AUDIT chain, which runs for every ordered batch regardless of
+    its target ledger."""
+
+    def __init__(self, dm):
+        super().__init__(dm, AUDIT_LEDGER_ID)
+
+    def post_batch_applied(self, batch: ThreePcBatch, prev_result=None):
+        return None
+
+    def post_batch_rejected(self, ledger_id: int, prev_result=None):
+        return None
+
+    def commit_batch(self, batch: ThreePcBatch, prev_result=None):
+        store = self.database_manager.get_store("state_ts")
+        state = self.database_manager.get_state(batch.ledger_id)
+        if store is None or state is None:
+            return None
+        store.set(batch.pp_time, state.committedHeadHash, batch.ledger_id)
+        return None
+
+
 class AuditBatchHandler(BatchRequestHandler):
     """One audit txn per ordered batch — the recovery backbone
     (reference audit_batch_handler.py:20, docs/source/audit_ledger.md)."""

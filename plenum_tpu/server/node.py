@@ -35,7 +35,7 @@ from plenum_tpu.ledger.ledger import Ledger
 from plenum_tpu.runtime.timer import TimerService
 from plenum_tpu.server.batch_handlers import (
     AuditBatchHandler, ConfigBatchHandler, DomainBatchHandler,
-    PoolBatchHandler)
+    PoolBatchHandler, TsStoreBatchHandler)
 from plenum_tpu.server.client_authn import CoreAuthNr, ReqAuthenticator
 from plenum_tpu.server.database_manager import DatabaseManager
 from plenum_tpu.server.executor import NodeBatchExecutor
@@ -87,21 +87,34 @@ class NodeBootstrap:
             dm.register_new_database(lid, ledger, state,
                                      taa_acceptance_required=(
                                          lid == DOMAIN_LEDGER_ID))
+        from plenum_tpu.storage.state_ts_store import StateTsStore
+        dm.register_new_store("state_ts", StateTsStore(make_kv("state_ts")))
         return dm
 
     @staticmethod
-    def init_managers(dm: DatabaseManager
+    def init_managers(dm: DatabaseManager, config: Optional[Config] = None
                       ) -> Tuple[WriteRequestManager, ReadRequestManager]:
+        from plenum_tpu.server.taa_handlers import (
+            GetTxnAuthorAgreementAmlHandler, GetTxnAuthorAgreementHandler,
+            TaaAcceptanceValidator, TxnAuthorAgreementAmlHandler,
+            TxnAuthorAgreementDisableHandler, TxnAuthorAgreementHandler)
         wm = WriteRequestManager(dm)
         wm.register_req_handler(NymHandler(dm))
         wm.register_req_handler(NodeHandler(dm))
+        wm.register_req_handler(TxnAuthorAgreementHandler(dm))
+        wm.register_req_handler(TxnAuthorAgreementAmlHandler(dm))
+        wm.register_req_handler(TxnAuthorAgreementDisableHandler(dm))
+        wm.taa_validator = TaaAcceptanceValidator(dm, config or Config())
         wm.register_batch_handler(PoolBatchHandler(dm))
         wm.register_batch_handler(DomainBatchHandler(dm))
         wm.register_batch_handler(ConfigBatchHandler(dm))
+        wm.register_batch_handler(TsStoreBatchHandler(dm))
         wm.register_batch_handler(AuditBatchHandler(dm))
         rm = ReadRequestManager()
         rm.register_req_handler(GetTxnHandler(dm))
         rm.register_req_handler(GetNymHandler(dm))
+        rm.register_req_handler(GetTxnAuthorAgreementHandler(dm))
+        rm.register_req_handler(GetTxnAuthorAgreementAmlHandler(dm))
         return wm, rm
 
 
@@ -131,7 +144,7 @@ class Node:
         self.db_manager = NodeBootstrap.init_storage(storage_factory,
                                                      self.config)
         self.write_manager, self.read_manager = \
-            NodeBootstrap.init_managers(self.db_manager)
+            NodeBootstrap.init_managers(self.db_manager, self.config)
 
         # ---- genesis (skipped on restart: the persisted ledgers already
         # contain it) — must precede membership derivation, which reads
@@ -398,6 +411,26 @@ class Node:
                     self.seq_no_db.put(
                         payload_digest.encode(),
                         "{}:{}".format(lid, seq).encode())
+        # state_ts backfill: a crash between the state commit and the
+        # ts-store put loses the final batch's (pp_time → root) entry —
+        # restore it from the last audit txn, which records every
+        # ledger's state root at that batch
+        ts_store = self.db_manager.get_store("state_ts")
+        audit = self.db_manager.get_ledger(AUDIT_LEDGER_ID)
+        if ts_store is not None and audit.size > 0:
+            from plenum_tpu.common.txn_util import get_txn_time
+            from plenum_tpu.server.batch_handlers import AUDIT_TXN_STATE_ROOT
+            last_audit = audit.get_last_txn()
+            txn_time = get_txn_time(last_audit)
+            roots = get_payload_data(last_audit).get(
+                AUDIT_TXN_STATE_ROOT) or {}
+            if txn_time is not None:
+                for lid_str, root_b58 in roots.items():
+                    lid = int(lid_str)
+                    if ts_store.get(txn_time, lid) is None:
+                        ledger = self.db_manager.get_ledger(lid)
+                        ts_store.set(txn_time, ledger.strToHash(root_b58),
+                                     lid)
         self._adopt_3pc_from_audit()
         # a node with committed history must re-sync with the pool before
         # voting again: its persisted view is each batch's ORIGINAL view,

@@ -31,6 +31,9 @@ class WriteRequestManager:
         self.request_handlers: Dict[str, WriteRequestHandler] = {}
         self.batch_handlers: Dict[int, List[BatchRequestHandler]] = {}
         self.audit_b_handler: Optional[AuditBatchHandler] = None
+        # TAA acceptance enforcement (reference do_taa_validation);
+        # installed by NodeBootstrap.init_managers
+        self.taa_validator = None
         # staged batches in apply order: (ledger_id, txn_count)
         self._applied_batches: List[Tuple[int, int]] = []
 
@@ -70,6 +73,9 @@ class WriteRequestManager:
             raise InvalidClientRequest(
                 request.identifier, request.reqId,
                 "unknown txn type {}".format(request.txn_type))
+        if self.taa_validator is not None and req_pp_time is not None:
+            self.taa_validator.validate(request, handler.ledger_id,
+                                        req_pp_time)
         handler.dynamic_validation(request, req_pp_time)
 
     # -------------------------------------------------------------- apply
