@@ -130,9 +130,11 @@ class Node:
                  metrics=None):
         """network: ExternalBus to peers; client_reply_handler(client_id,
         msg) delivers Acks/Nacks/Replies back to clients."""
+        from plenum_tpu.server.observer import Observable
         self.name = name
         self.config = config or Config()
         self.metrics = metrics or NullMetricsCollector()
+        self.observable = Observable()
         self.timer = timer
         self.network = network
         self._reply_to_client = client_reply_handler or (lambda c, m: None)
@@ -659,6 +661,8 @@ class Node:
         """Send Replies with audit paths; update dedup index; free reqs."""
         self.metrics.add_event(MetricsName.ORDERED_BATCH_COMMITTED,
                                len(committed_txns or []))
+        self.observable.batch_committed(ordered.ledgerId,
+                                        committed_txns or [])
         ledger = self.db_manager.get_ledger(ordered.ledgerId)
         for txn in committed_txns or []:
             seq_no = get_seq_no(txn)

@@ -1,0 +1,179 @@
+"""Recorder/replay determinism tooling (SURVEY aux 5.2, reference
+plenum/recorder/) and the observer framework (SURVEY aux 5.5, reference
+plenum/server/observer/).
+"""
+import os
+
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    DOMAIN_LEDGER_ID, NYM, TARGET_NYM, VERKEY)
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.server.observer import (
+    NodeObserver, ObservedData, make_observed_data)
+from plenum_tpu.testing.mock_timer import MockTimer
+from plenum_tpu.testing.sim_network import SimNetwork
+from plenum_tpu.utils.recorder import Recorder, attach_recorder, replay
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+SIM_EPOCH = 1600000000
+CONF = dict(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+            LOG_SIZE=15)
+
+
+def make_pool(timer, seed=19, recorders=None):
+    net = SimNetwork(timer, DefaultSimRandom(seed))
+    nodes = [Node(n, NAMES, timer, net.create_peer(n),
+                  config=Config(**CONF),
+                  client_reply_handler=lambda c, m: None)
+             for n in NAMES]
+    if recorders is not None:
+        for n in nodes:
+            rec = Recorder(timer.get_current_time)
+            attach_recorder(n, rec)
+            recorders[n.name] = rec
+    return nodes
+
+
+def pump(timer, nodes, seconds=8.0, step=0.05):
+    end = timer.get_current_time() + seconds
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(step)
+
+
+def submit_writes(nodes, count=3):
+    client = SimpleSigner(seed=b"\x77" * 32)
+    for i in range(count):
+        req = {"identifier": client.identifier, "reqId": i + 1,
+               "protocolVersion": 2,
+               "operation": {"type": NYM,
+                             TARGET_NYM: "dest-%02d" % i + "x" * 16,
+                             VERKEY: client.verkey}}
+        req["signature"] = client.sign(dict(req))
+        for n in nodes:
+            n.process_client_request(dict(req), "c1")
+
+
+def test_replay_reproduces_identical_roots(tdir):
+    # live run with recorders attached
+    timer = MockTimer()
+    timer.set_time(SIM_EPOCH)
+    recorders = {}
+    nodes = make_pool(timer, recorders=recorders)
+    submit_writes(nodes)
+    pump(timer, nodes)
+    live = nodes[0]
+    assert live.domain_ledger.size == 3
+    live_root = str(live.domain_ledger.root_hash)
+    live_audit = str(live.audit_ledger.root_hash)
+    live_state = live.db_manager.get_state(
+        DOMAIN_LEDGER_ID).committedHeadHash
+
+    # persist + reload the recording (the ops workflow)
+    path = os.path.join(tdir, "alpha.rec")
+    recorders["Alpha"].dump(path)
+    recording = Recorder.load(path)
+    assert recording.entries == recorders["Alpha"].entries
+
+    # replay into a FRESH node on a fresh timer; its sends go nowhere
+    replay_timer = MockTimer()
+    replay_timer.set_time(SIM_EPOCH)
+    from plenum_tpu.runtime.bus import ExternalBus
+    fresh = Node("Alpha", NAMES, replay_timer,
+                 ExternalBus(send_handler=lambda m, dst=None: None),
+                 config=Config(**CONF),
+                 client_reply_handler=lambda c, m: None)
+    replay(recording, fresh, replay_timer)
+    assert fresh.domain_ledger.size == 3
+    assert str(fresh.domain_ledger.root_hash) == live_root
+    assert str(fresh.audit_ledger.root_hash) == live_audit
+    assert fresh.db_manager.get_state(
+        DOMAIN_LEDGER_ID).committedHeadHash == live_state
+
+
+# ------------------------------------------------------------ observer
+
+def test_observer_follows_pool_via_observed_data():
+    timer = MockTimer()
+    timer.set_time(SIM_EPOCH)
+    nodes = make_pool(timer, seed=23)
+    observer = NodeObserver(n_validators=len(NAMES))
+    for n in nodes:
+        n.observable.add_observer(
+            "obs1", lambda msg, frm=n.name: observer.apply_data(msg, frm))
+    submit_writes(nodes, count=4)
+    pump(timer, nodes)
+    assert nodes[0].domain_ledger.size == 4
+    obs_ledger = observer.db_manager.get_ledger(DOMAIN_LEDGER_ID)
+    assert obs_ledger.size == 4
+    assert str(obs_ledger.root_hash) == \
+        str(nodes[0].domain_ledger.root_hash)
+    assert observer.db_manager.get_state(
+        DOMAIN_LEDGER_ID).committedHeadHash == \
+        nodes[0].db_manager.get_state(DOMAIN_LEDGER_ID).committedHeadHash
+
+
+def test_observer_needs_quorum_and_rejects_forged_batch():
+    observer = NodeObserver(n_validators=4)          # f = 1 -> quorum 2
+    client = SimpleSigner(seed=b"\x78" * 32)
+    from plenum_tpu.common.txn_util import (
+        append_txn_metadata, init_empty_txn, get_payload_data)
+    txn = init_empty_txn(NYM)
+    get_payload_data(txn).update({TARGET_NYM: client.identifier,
+                                  VERKEY: client.verkey})
+    append_txn_metadata(txn, seq_no=1, txn_time=SIM_EPOCH)
+    good = make_observed_data(DOMAIN_LEDGER_ID, [txn])
+    # deep copy: a shallow one would share the nested payload dict and
+    # corrupt the honest batch when forging the target
+    import copy
+    forged_txn = copy.deepcopy(txn)
+    get_payload_data(forged_txn)[TARGET_NYM] = "attacker" + "x" * 14
+    forged = make_observed_data(DOMAIN_LEDGER_ID, [forged_txn])
+
+    ledger = observer.db_manager.get_ledger(DOMAIN_LEDGER_ID)
+    # one honest copy: below f+1, nothing applied
+    assert not observer.apply_data(good, "Alpha")
+    assert ledger.size == 0
+    # a forged variant from another sender must not complete the quorum
+    assert not observer.apply_data(forged, "Mallory")
+    assert ledger.size == 0
+    # second identical honest copy: applied
+    assert observer.apply_data(good, "Beta")
+    assert ledger.size == 1
+    # replays of the same batch are ignored
+    assert not observer.apply_data(good, "Gamma")
+    assert ledger.size == 1
+    # decided batches leave no residue: forged variants are forgotten
+    assert observer.policy._votes == {}
+    assert observer.policy._payloads == {}
+
+
+def test_observer_applies_out_of_order_batches_in_order():
+    observer = NodeObserver(n_validators=4)
+    from plenum_tpu.common.txn_util import (
+        append_txn_metadata, init_empty_txn, get_payload_data)
+    client = SimpleSigner(seed=b"\x79" * 32)
+
+    def batch(seq_no):
+        txn = init_empty_txn(NYM)
+        get_payload_data(txn).update(
+            {TARGET_NYM: "id-%02d" % seq_no + "y" * 16,
+             VERKEY: client.verkey})
+        append_txn_metadata(txn, seq_no=seq_no, txn_time=SIM_EPOCH)
+        return make_observed_data(DOMAIN_LEDGER_ID, [txn])
+
+    ledger = observer.db_manager.get_ledger(DOMAIN_LEDGER_ID)
+    b1, b2 = batch(1), batch(2)
+    # batch 2 reaches quorum first: held back (gap at 1)
+    assert not observer.apply_data(b2, "Alpha")
+    assert not observer.apply_data(b2, "Beta")
+    assert ledger.size == 0
+    # batch 1 quorum: both apply, in order
+    assert not observer.apply_data(b1, "Alpha")
+    assert observer.apply_data(b1, "Beta")
+    assert ledger.size == 2
