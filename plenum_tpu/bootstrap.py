@@ -203,6 +203,7 @@ def build_networked_node(name: str, base_dir: str, config=None):
     """Construct a NetworkedNode from on-disk keys + genesis, with
     durable file-backed stores under <base>/<name>/data/."""
     from plenum_tpu.server.networked_node import NetworkedNode
+    from plenum_tpu.storage import kv_native
     from plenum_tpu.storage.kv_file import KeyValueStorageFile
 
     keys, _info = load_node_keys(name, base_dir)
@@ -213,8 +214,15 @@ def build_networked_node(name: str, base_dir: str, config=None):
     data_dir = os.path.join(base_dir, name, "data")
     os.makedirs(data_dir, exist_ok=True)
 
-    def storage_factory(store_name: str):
-        return KeyValueStorageFile(data_dir, store_name)
+    # the native C engine keeps values on disk (bounded RAM) and shares
+    # the .kvlog format with the Python backend, so either can open
+    # stores the other wrote
+    if kv_native.available():
+        def storage_factory(store_name: str):
+            return kv_native.KeyValueStorageNative(data_dir, store_name)
+    else:
+        def storage_factory(store_name: str):
+            return KeyValueStorageFile(data_dir, store_name)
 
     domain_txns = list(
         GenesisTxnInitiatorFromFile(base_dir, DOMAIN_GENESIS_FILE)())
