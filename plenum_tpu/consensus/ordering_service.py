@@ -156,6 +156,10 @@ class OrderingService:
         self._config = config or Config()
         self._bls = bls_bft_replica
         self._freshness_checker = freshness_checker
+        # optional hook: called with (view_no, pp_seq_no) after this
+        # PRIMARY sends a batch (backup primaries persist it so a
+        # restart resumes the seq — server/last_sent_pp_store.py)
+        self.on_pp_sent = None
         self._get_time = get_current_time or (
             lambda: int(timer.get_current_time()))
 
@@ -325,6 +329,8 @@ class OrderingService:
         self.batches[(self.view_no, pp_seq_no)] = pp
         self._add_to_preprepared(pp)
         self._network.send(pp)
+        if self.on_pp_sent is not None:
+            self.on_pp_sent(self.view_no, pp_seq_no)
         self._try_prepared(pp)  # n=1 pools order immediately
 
     @staticmethod

@@ -483,12 +483,29 @@ class NodeStack(StackBase):
 
     def _make_batches(self, msgs: List[bytes]) -> List[bytes]:
         """Pack serialized messages into signed batches under the size
-        limit (reference prepare_batch.py splitting)."""
+        limit (reference prepare_batch.py split_messages_on_batches).
+        A SINGLE message over the limit is dropped with an error — the
+        reference does the same; sending it anyway would make the
+        receiver kill the connection on the oversize frame."""
         frames = []
         group: List[bytes] = []
         group_size = 0
-        budget = self.msg_len_limit - 512  # envelope overhead
+        budget = self.msg_len_limit - 512  # batch-envelope overhead
         for m in msgs:
+            if len(m) > self.msg_len_limit:
+                logger.error(
+                    "%s: message of %d bytes exceeds the %d-byte frame "
+                    "limit — dropped (%r...)", self.name, len(m),
+                    self.msg_len_limit, m[:128])
+                continue
+            if len(m) > budget:
+                # too big to share a batch envelope, but fine as its own
+                # raw frame (singletons are sent unenveloped)
+                if group:
+                    frames.append(self._seal_batch(group))
+                    group, group_size = [], 0
+                frames.append(m)
+                continue
             if group and group_size + len(m) > budget:
                 frames.append(self._seal_batch(group))
                 group, group_size = [], 0

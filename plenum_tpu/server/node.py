@@ -184,9 +184,15 @@ class Node:
         # ---- dedup index: payload_digest → (ledger_id, seqNo); rides the
         # same storage factory as the ledgers so it survives restarts
         # (reference loadSeqNoDB node.py:698)
-        self.seq_no_db = (storage_factory or
-                          (lambda _name: KeyValueStorageInMemory()))(
-                              "seq_no_db")
+        make_kv = storage_factory or (
+            lambda _name: KeyValueStorageInMemory())
+        self.seq_no_db = make_kv("seq_no_db")
+        # node status DB: non-ledger runtime state that must survive a
+        # restart — currently the backup primary's last sent PrePrepare
+        # (reference nodeStatusDB, node.py loadNodeStatusDB)
+        self.node_status_db = make_kv("node_status_db")
+        from plenum_tpu.server.last_sent_pp_store import LastSentPpStoreHelper
+        self.last_sent_pp_store = LastSentPpStoreHelper(self.node_status_db)
         # digest → client id awaiting reply
         self._req_clients: Dict[str, str] = {}
 
@@ -229,7 +235,8 @@ class Node:
         self.replicas = Replicas(
             name, validators, timer, network, master=self.replica,
             config=self.config,
-            on_backup_ordered=self._on_backup_ordered)
+            on_backup_ordered=self._on_backup_ordered,
+            on_backup_pp_sent=self.last_sent_pp_store.store_last_sent)
 
         # ---- propagation
         self.propagator = Propagator(
@@ -252,6 +259,10 @@ class Node:
             config=self.config)
         self.replica.internal_bus.subscribe(
             NewViewAccepted, lambda msg: self.monitor.reset())
+        # a new view invalidates any stored backup-primary position
+        self.replica.internal_bus.subscribe(
+            NewViewAccepted,
+            lambda msg: self.last_sent_pp_store.erase_last_sent())
         from plenum_tpu.common.messages.internal_messages import (
             CheckpointStabilized)
         self.replica.internal_bus.subscribe(
@@ -434,6 +445,9 @@ class Node:
                         ts_store.set(txn_time, ledger.strToHash(root_b58),
                                      lid)
         self._adopt_3pc_from_audit()
+        # backup primaries resume their persisted pp_seq_no (master
+        # recovers via catchup; see last_sent_pp_store.try_restore)
+        self.last_sent_pp_store.try_restore(self)
         # a node with committed history must re-sync with the pool before
         # voting again: its persisted view is each batch's ORIGINAL view,
         # which can lag the pool's current view (catchup gathers f+1 peer

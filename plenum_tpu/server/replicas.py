@@ -43,13 +43,15 @@ class Replicas:
                  timer: TimerService, network,
                  master: ReplicaService,
                  config: Optional[Config] = None,
-                 on_backup_ordered: Callable[[Ordered], None] = None):
+                 on_backup_ordered: Callable[[Ordered], None] = None,
+                 on_backup_pp_sent: Callable[[int, int, int], None] = None):
         self._node_name = node_name
         self._validators = list(validators)
         self._timer = timer
         self._network = network
         self.config = config or Config()
         self._on_backup_ordered = on_backup_ordered or (lambda o: None)
+        self._on_backup_pp_sent = on_backup_pp_sent
         self._replicas: Dict[int, ReplicaService] = {0: master}
         master.internal_bus.subscribe(NewViewAccepted,
                                       self._on_master_new_view)
@@ -97,6 +99,10 @@ class Replicas:
         # align with the master's current view
         replica.reset_for_view(self.master.view_no)
         replica.internal_bus.subscribe(Ordered, self._on_backup_ordered)
+        if self._on_backup_pp_sent is not None:
+            replica.ordering.on_pp_sent = (
+                lambda view_no, pp_seq_no, iid=inst_id:
+                self._on_backup_pp_sent(iid, view_no, pp_seq_no))
         self._replicas[inst_id] = replica
         logger.info("%s: added backup instance %d (primary %s)",
                     self._node_name, inst_id, replica.data.primary_name)

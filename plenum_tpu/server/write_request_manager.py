@@ -34,6 +34,12 @@ class WriteRequestManager:
         # TAA acceptance enforcement (reference do_taa_validation);
         # installed by NodeBootstrap.init_managers
         self.taa_validator = None
+        # txn payload versioning seam (reference
+        # plenum/server/txn_version_controller.py — downstream ledgers
+        # override to gate validation rules on the pool version)
+        from plenum_tpu.common.txn_version_controller import (
+            TxnVersionController)
+        self.txn_version_controller = TxnVersionController()
         # staged batches in apply order: (ledger_id, txn_count)
         self._applied_batches: List[Tuple[int, int]] = []
 
@@ -111,6 +117,8 @@ class WriteRequestManager:
                 committed = result
         for handler in self.batch_handlers.get(AUDIT_LEDGER_ID, []):
             handler.commit_batch(three_pc_batch)
+        for txn in committed:
+            self.txn_version_controller.update_version(txn)
         if self._applied_batches:
             self._applied_batches.pop(0)
         return committed

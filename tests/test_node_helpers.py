@@ -1,0 +1,176 @@
+"""Consensus tail helpers (§2.2 inventory): LastSentPpStoreHelper +
+nodeStatusDB, TxnVersionController, oversize-message drop in the
+transport batcher. References: plenum/server/last_sent_pp_store_helper
+.py, plenum/server/txn_version_controller.py, common/prepare_batch.py.
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.common.txn_util import init_empty_txn
+from plenum_tpu.common.txn_version_controller import TxnVersionController
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.last_sent_pp_store import LastSentPpStoreHelper
+from plenum_tpu.server.node import Node
+from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+from plenum_tpu.testing.sim_network import SimNetwork
+
+# 7 nodes -> f=2 -> 3 protocol instances (master + 2 backups)
+NAMES7 = ["Alpha", "Beta", "Gamma", "Delta", "Epsilon", "Zeta", "Eta"]
+SIM_EPOCH = 1600000000
+
+
+def test_last_sent_pp_roundtrip_and_erase():
+    helper = LastSentPpStoreHelper(KeyValueStorageInMemory())
+    assert helper.load_last_sent() is None
+    helper.store_last_sent(1, 0, 42)
+    assert helper.load_last_sent() == (1, 0, 42)
+    helper.erase_last_sent()
+    assert helper.load_last_sent() is None
+    helper.erase_last_sent()                      # idempotent
+
+
+def test_malformed_last_sent_record_ignored():
+    db = KeyValueStorageInMemory()
+    db.put(b"lastSentPrePrepare", b"not json")
+    assert LastSentPpStoreHelper(db).load_last_sent() is None
+
+
+@pytest.fixture
+def pool7(mock_timer):
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(41))
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15)
+    stores = {n: {} for n in NAMES7}
+
+    def factory(name):
+        def make(store_name):
+            store = stores[name].get(store_name)
+            if store is None:
+                store = stores[name][store_name] = KeyValueStorageInMemory()
+            return store
+        return make
+
+    nodes = [Node(n, NAMES7, mock_timer, net.create_peer(n), config=conf,
+                  storage_factory=factory(n),
+                  client_reply_handler=lambda c, m: None)
+             for n in NAMES7]
+    return nodes, stores, net, mock_timer
+
+
+def pump(timer, nodes, seconds=8.0, step=0.05):
+    end = timer.get_current_time() + seconds
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(step)
+
+
+def order_writes(nodes, timer, count=3, seed0=140):
+    client = SimpleSigner(seed=bytes([seed0]) * 32)
+    for i in range(count):
+        req = {"identifier": client.identifier, "reqId": i + 1,
+               "protocolVersion": 2,
+               "operation": {"type": NYM, TARGET_NYM: client.identifier,
+                             VERKEY: client.verkey}}
+        req["signature"] = client.sign(dict(req))
+        for n in nodes:
+            n.process_client_request(dict(req), "c1")
+        pump(timer, nodes, 2.0)
+
+
+def test_backup_primary_persists_and_restores_position(pool7):
+    nodes, stores, net, timer = pool7
+    assert nodes[0].replicas.num_instances == 3   # f=2 -> 2 backups
+    order_writes(nodes, timer)
+    # the backup instance's primary persisted its last sent PrePrepare
+    backup_primary = next(
+        n for n in nodes
+        if n.replicas[1].data.primary_name == n.name)
+    stored = backup_primary.last_sent_pp_store.load_last_sent()
+    assert stored is not None
+    inst_id, view_no, pp_seq_no = stored
+    assert (inst_id, view_no) == (1, 0) and pp_seq_no >= 1
+
+    # restart the backup primary over the same stores: position resumes
+    name = backup_primary.name
+    net.remove_peer(name)
+    def factory(store_name):
+        return stores[name].setdefault(store_name,
+                                       KeyValueStorageInMemory())
+    reborn = Node(name, NAMES7, timer, net.create_peer(name),
+                  config=Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2,
+                                CHK_FREQ=5, LOG_SIZE=15),
+                  storage_factory=factory,
+                  client_reply_handler=lambda c, m: None)
+    assert reborn.replicas[1].ordering.lastPrePrepareSeqNo == pp_seq_no
+    # the master instance did NOT adopt the backup position
+    assert reborn.replicas[0].ordering.lastPrePrepareSeqNo != pp_seq_no \
+        or reborn.last_ordered[1] == pp_seq_no
+
+
+def test_txn_version_controller_defaults():
+    tvc = TxnVersionController()
+    assert tvc.version is None
+    assert tvc.get_pool_version(123) is None
+    txn = init_empty_txn(NYM)
+    assert tvc.get_txn_version(txn) in ("1", "2")   # payload version or default
+    txn["txn"]["protocolVersion"] = "7"
+    assert tvc.get_txn_version(txn) == "7"
+    tvc.update_version(txn)                          # base: no-op
+
+
+def test_oversize_message_dropped_not_sent():
+    """A single message above the frame limit is dropped sender-side
+    (reference prepare_batch: 'Batches were not created'); smaller
+    messages in the same flush still go out."""
+    from plenum_tpu.network.keys import NodeKeys
+    from plenum_tpu.network.stack import HA, NodeStack
+    stack = NodeStack("S", HA("127.0.0.1", 0), NodeKeys(b"\x01" * 32),
+                      {}, Config())
+    small = b"x" * 100
+    huge = b"y" * (Config.MSG_LEN_LIMIT + 1)
+    frames = stack._make_batches([small, huge, small])
+    # the two small messages batched; the huge one gone
+    assert len(frames) == 1
+    assert all(len(f) <= Config.MSG_LEN_LIMIT for f in frames)
+    # a message in (limit-512, limit] rides as its OWN raw frame —
+    # singletons carry no batch envelope, so the wire supports it
+    near = b"z" * (Config.MSG_LEN_LIMIT - 100)
+    frames = stack._make_batches([small, near, small])
+    assert near in frames
+    assert len(frames) == 3 or len(frames) == 2
+    assert all(len(f) <= Config.MSG_LEN_LIMIT for f in frames)
+
+
+def test_restored_backup_primary_resumes_sending(pool7):
+    """The restore must also set last_ordered/watermarks: a restored
+    backup primary KEEPS SENDING (a bare lastPrePrepareSeqNo restore
+    stalls on the in-flight gate and strict-sequential ordering)."""
+    nodes, stores, net, timer = pool7
+    order_writes(nodes, timer, count=3, seed0=150)
+    bp = next(n for n in nodes
+              if n.replicas[1].data.primary_name == n.name)
+    stored = bp.last_sent_pp_store.load_last_sent()
+    assert stored is not None
+    name, idx = bp.name, nodes.index(bp)
+    net.remove_peer(name)
+
+    def factory(store_name):
+        return stores[name].setdefault(store_name,
+                                       KeyValueStorageInMemory())
+    reborn = Node(name, NAMES7, timer, net.create_peer(name),
+                  config=Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2,
+                                CHK_FREQ=5, LOG_SIZE=15),
+                  storage_factory=factory,
+                  client_reply_handler=lambda c, m: None)
+    nodes[idx] = reborn
+    assert reborn.replicas[1].data.last_ordered_3pc[1] == stored[2]
+    pump(timer, nodes, 12)                    # catch up / rejoin
+    order_writes(nodes, timer, count=3, seed0=151)
+    pump(timer, nodes, 4)
+    after = reborn.replicas[1].ordering.lastPrePrepareSeqNo
+    assert after > stored[2], \
+        "restored backup primary must continue its 3PC sequence"
