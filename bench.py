@@ -56,8 +56,10 @@ def make_requests(n, signer):
     return reqs
 
 
-def run_pool(reqs, verifier_name):
-    """→ (elapsed_wall_seconds, ordered_count) for ordering all reqs."""
+def make_sim_pool(names, verifier_name, seed=7):
+    """Build an n-node sim pool with the given verification provider
+    (shared scaffolding for the 4-node headline and 25-node backlog
+    configs — one drain/hub wiring to maintain)."""
     from plenum_tpu.common.config import Config
     from plenum_tpu.crypto.batch_verifier import create_verifier
     from plenum_tpu.runtime.sim_random import DefaultSimRandom
@@ -67,14 +69,14 @@ def run_pool(reqs, verifier_name):
 
     timer = MockTimer()
     timer.set_time(SIM_EPOCH)
-    net = SimNetwork(timer, DefaultSimRandom(7), min_latency=0.001,
+    net = SimNetwork(timer, DefaultSimRandom(seed), min_latency=0.001,
                      max_latency=0.005)
     conf = Config(Max3PCBatchSize=CLIENT_BATCH, Max3PCBatchWait=0.05,
                   CHK_FREQ=10, LOG_SIZE=30, HEARTBEAT_FREQ=10 ** 6)
-    nodes = [Node(name, NAMES, timer, net.create_peer(name), config=conf)
-             for name in NAMES]
+    nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
+             for name in names]
     if verifier_name == "tpu_hub":
-        # co-resident nodes share one coalescing hub: the 4 per-node
+        # co-resident nodes share one coalescing hub: the per-node
         # dispatches of each chunk fuse into ONE latency-bound kernel
         # launch (see CoalescingVerifierHub)
         hub = create_verifier("tpu_hub")
@@ -83,6 +85,33 @@ def run_pool(reqs, verifier_name):
     else:
         for n in nodes:
             n.authnr._verifier = create_verifier(verifier_name)
+    return nodes, timer
+
+
+def drain_chunk(nodes, timer, chunk, client_id="bench-client",
+                target_size=None, max_iters=400, deadline=None):
+    """Two-phase intake of one chunk (all nodes dispatch async, then
+    harvest — one fused device round trip) + pump until every node's
+    domain ledger reaches target_size."""
+    if chunk:
+        pendings = [n.dispatch_client_batch(
+            [(dict(r), client_id) for r in chunk]) for n in nodes]
+        for n, pending in zip(nodes, pendings):
+            n.conclude_client_batch(pending)
+    for _ in range(max_iters):
+        for nd in nodes:
+            nd.service()
+        timer.run_for(0.01)
+        if target_size is not None and all(
+                nd.domain_ledger.size >= target_size for nd in nodes):
+            break
+        if deadline is not None and time.perf_counter() > deadline:
+            break
+
+
+def run_pool(reqs, verifier_name):
+    """→ (elapsed_wall_seconds, ordered_count) for ordering all reqs."""
+    nodes, timer = make_sim_pool(NAMES, verifier_name)
 
     target = len(reqs)
     t0 = time.perf_counter()
@@ -90,19 +119,7 @@ def run_pool(reqs, verifier_name):
     while i < target:
         chunk = reqs[i:i + CLIENT_BATCH]
         i += len(chunk)
-        # two-phase intake: all 4 nodes dispatch their device batches
-        # first (async), then harvest — one device round trip per chunk
-        # instead of four
-        pendings = [n.dispatch_client_batch(
-            [(dict(r), "bench-client") for r in chunk]) for n in nodes]
-        for n, pending in zip(nodes, pendings):
-            n.conclude_client_batch(pending)
-        # let the pool drain this chunk before feeding the next
-        for _ in range(400):
-            progressed = sum(nd.service() for nd in nodes)
-            timer.run_for(0.01)
-            if all(nd.last_ordered[1] * CLIENT_BATCH >= i for nd in nodes):
-                break
+        drain_chunk(nodes, timer, chunk, target_size=i)
     # drain to completion
     deadline = time.perf_counter() + 300
     while time.perf_counter() < deadline:
@@ -190,6 +207,81 @@ def micro_merkle(n_leaves=None):
     return (n_leaves, device_leaves_per_s, proof_rate, floor_leaves_per_s)
 
 
+def pool25_backlog():
+    """BASELINE config 5: 25-node simulated pool, mixed read/write
+    against a 50k-request backlog, TPU-batched verification via the
+    shared coalescing hub. The sim drains the backlog for a bounded
+    wall budget (BENCH_P25_WALL seconds) and reports sustained
+    ordered-write + served-read throughput."""
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.common.constants import GET_TXN, NYM, TARGET_NYM, VERKEY
+    from plenum_tpu.crypto.signer import SimpleSigner
+
+    n_nodes = int(os.environ.get("BENCH_P25_NODES", "25"))
+    backlog = int(os.environ.get("BENCH_P25_BACKLOG", "50000"))
+    wall_budget = float(os.environ.get("BENCH_P25_WALL", "90"))
+    read_every = 5                       # 20% reads
+    names = ["N%02d" % i for i in range(n_nodes)]
+
+    # no client_reply_handler: the headline config skips Reply-payload
+    # construction too, keeping the two pools comparable
+    nodes, timer = make_sim_pool(names, "tpu_hub", seed=25)
+    reads_served = [0]
+
+    signer = SimpleSigner(seed=b"\x26" * 32)
+    writes, reads = [], []
+    for i in range(backlog):
+        if i % read_every == 4:
+            reads.append({"identifier": signer.identifier, "reqId": i + 1,
+                          "protocolVersion": 2,
+                          "operation": {"type": GET_TXN, "ledgerId": 1,
+                                        "data": 1 + (i % 50)}})
+        else:
+            dest = "p25-%08d" % i + "x" * 10
+            req = {"identifier": signer.identifier, "reqId": i + 1,
+                   "protocolVersion": 2,
+                   "operation": {"type": NYM, TARGET_NYM: dest,
+                                 VERKEY: "~" + dest[:22]}}
+            req["signature"] = signer.sign(dict(req))
+            writes.append(req)
+
+    # warm the FUSED verification bucket (all nodes' chunks coalesce in
+    # the hub) so XLA compile stays out of the timed window
+    from plenum_tpu.crypto.fixtures import make_signed_batch
+    from plenum_tpu.ops import ed25519_jax as edj
+    wm_, ws_, wv_ = make_signed_batch(n_nodes * CLIENT_BATCH, seed=2)
+    edj.verify_batch(wm_, ws_, wv_)
+
+    t0 = time.perf_counter()
+    deadline = t0 + wall_budget
+    wi = ri = 0
+    primary = nodes[0]
+    while time.perf_counter() < deadline and (wi < len(writes)
+                                              or ri < len(reads)):
+        chunk = writes[wi:wi + CLIENT_BATCH]
+        wi += len(chunk)
+        # reads answer from any single node, no consensus round
+        rchunk = reads[ri:ri + CLIENT_BATCH // read_every]
+        ri += len(rchunk)
+        for r in rchunk:
+            primary.process_client_request(dict(r), "p25-read")
+            reads_served[0] += 1
+        drain_chunk(nodes, timer, chunk, client_id="p25",
+                    target_size=wi, deadline=deadline)
+    elapsed = time.perf_counter() - t0
+    ordered = min(nd.domain_ledger.size for nd in nodes)
+    return {
+        "nodes": n_nodes,
+        "backlog": backlog,
+        "wall_s": round(elapsed, 1),
+        "ordered_writes": ordered,
+        "reads_served": reads_served[0],
+        "write_req_per_s": round(ordered / elapsed, 1),
+        "mixed_req_per_s": round((ordered + reads_served[0]) / elapsed, 1),
+        "drained": wi >= len(writes) and ordered >= len(writes),
+    }
+
+
 def micro_bls():
     """BASELINE config 3: BLS multi-sig aggregate + verify for
     n = 4/25/100 validators (the per-commit state-proof path). Native C
@@ -263,6 +355,7 @@ def main():
     device_rate, openssl_rate, python_rate = micro_ed25519()
     mk_n, mk_rate, mk_proofs, mk_floor = micro_merkle()
     bls_results = micro_bls()
+    p25 = pool25_backlog()
 
     print(json.dumps({
         "metric": "ordered write-reqs/s, 4-node pool, TPU-batched verify"
@@ -291,6 +384,7 @@ def main():
                 "vs_hashlib": round(mk_rate / mk_floor, 2),
             },
             "bls": bls_results,
+            "pool25_backlog": p25,
         },
     }))
 

@@ -85,6 +85,11 @@ class Config:
     TAA_ACCEPTANCE_TIME_BEFORE_TAA = 120
     TAA_ACCEPTANCE_TIME_AFTER_PP_TIME = 120
 
+    # ---- blacklisting: auto-blacklist on (attributable) suspicions is
+    # OFF by default, matching the reference (node.py:2883 "TODO:
+    # Consider blacklisting nodes again"); suspicions are always logged
+    BLACKLIST_ON_SUSPICION = False
+
     # ---- storage
     domainStateStorage = "memory"
     poolStateStorage = "memory"

@@ -29,8 +29,20 @@ class Signer(ABC):
     def sign(self, msg) -> str: ...
 
 
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey as _OsslSk)
+except ImportError:          # pragma: no cover - cryptography is baked in
+    _OsslSk = None
+
+
 class SimpleSigner(Signer):
-    """identifier == full b58 verkey."""
+    """identifier == full b58 verkey.
+
+    Signing rides OpenSSL (Ed25519 is deterministic per RFC 8032, so
+    the output is bit-identical) with the pure-Python implementation as
+    the reference fallback — the libsodium role in the reference's
+    stp_core/crypto/nacl_wrappers.py."""
 
     def __init__(self, seed: Optional[bytes] = None):
         self.seed = seed or os.urandom(32)
@@ -38,6 +50,8 @@ class SimpleSigner(Signer):
             raise ValueError("seed must be 32 bytes")
         self.verraw, self._sk = ed25519.keypair_from_seed(self.seed)
         self.verstr = b58encode(self.verraw)
+        self._ossl = (_OsslSk.from_private_bytes(self.seed)
+                      if _OsslSk is not None else None)
 
     @property
     def identifier(self) -> str:
@@ -48,6 +62,8 @@ class SimpleSigner(Signer):
         return self.verstr
 
     def sign_bytes(self, data: bytes) -> bytes:
+        if self._ossl is not None:
+            return self._ossl.sign(data)
         return ed25519.sign(data, self.seed)
 
     def sign(self, msg) -> str:

@@ -101,9 +101,12 @@ class NodeBootstrap:
         wm = WriteRequestManager(dm)
         wm.register_req_handler(NymHandler(dm))
         wm.register_req_handler(NodeHandler(dm))
+        from plenum_tpu.server.freeze_handlers import (
+            GetFrozenLedgersHandler, LedgersFreezeHandler)
         wm.register_req_handler(TxnAuthorAgreementHandler(dm))
         wm.register_req_handler(TxnAuthorAgreementAmlHandler(dm))
         wm.register_req_handler(TxnAuthorAgreementDisableHandler(dm))
+        wm.register_req_handler(LedgersFreezeHandler(dm))
         wm.taa_validator = TaaAcceptanceValidator(dm, config or Config())
         wm.register_batch_handler(PoolBatchHandler(dm))
         wm.register_batch_handler(DomainBatchHandler(dm))
@@ -115,6 +118,7 @@ class NodeBootstrap:
         rm.register_req_handler(GetNymHandler(dm))
         rm.register_req_handler(GetTxnAuthorAgreementHandler(dm))
         rm.register_req_handler(GetTxnAuthorAgreementAmlHandler(dm))
+        rm.register_req_handler(GetFrozenLedgersHandler(dm))
         return wm, rm
 
 
@@ -300,6 +304,36 @@ class Node:
             config=self.config, name=name)
         self.replica.internal_bus.subscribe(
             NeedMasterCatchup, lambda msg: self.start_catchup())
+
+        # ---- suspicion reporting + blacklisting (reference
+        # reportSuspiciousNode + SimpleBlacklister): every suspicion is
+        # logged and counted; auto-blacklisting is opt-in and limited to
+        # sender-attributable evidence — see server/blacklister.py
+        from plenum_tpu.common.messages.internal_messages import (
+            RaisedSuspicion)
+        from plenum_tpu.server.blacklister import SimpleBlacklister
+        self.blacklister = SimpleBlacklister(name)
+
+        def on_suspicion(msg: RaisedSuspicion):
+            ex = msg.ex
+            if getattr(ex, "node", None):
+                self.blacklister.report_suspicion(
+                    ex.node, getattr(ex, "code", None),
+                    getattr(ex, "reason", ""),
+                    auto_blacklist=self.config.BLACKLIST_ON_SUSPICION)
+        self.replicas.subscribe_suspicions(on_suspicion)
+
+        orig_incoming = network.process_incoming
+
+        def filtering_incoming(msg, frm):
+            # connection state events must pass — monitors track peers
+            # whether blacklisted or not
+            if not isinstance(msg, (network.Connected,
+                                    network.Disconnected)) \
+                    and self.blacklister.is_blacklisted(frm):
+                return None
+            return orig_incoming(msg, frm)
+        network.process_incoming = filtering_incoming
         self.mode_participating = True
 
         # ---- restart recovery from persisted stores

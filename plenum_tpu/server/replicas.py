@@ -52,6 +52,7 @@ class Replicas:
         self.config = config or Config()
         self._on_backup_ordered = on_backup_ordered or (lambda o: None)
         self._on_backup_pp_sent = on_backup_pp_sent
+        self._suspicion_handlers: List[Callable] = []
         self._replicas: Dict[int, ReplicaService] = {0: master}
         master.internal_bus.subscribe(NewViewAccepted,
                                       self._on_master_new_view)
@@ -103,6 +104,10 @@ class Replicas:
             replica.ordering.on_pp_sent = (
                 lambda view_no, pp_seq_no, iid=inst_id:
                 self._on_backup_pp_sent(iid, view_no, pp_seq_no))
+        from plenum_tpu.common.messages.internal_messages import (
+            RaisedSuspicion)
+        for handler in self._suspicion_handlers:
+            replica.internal_bus.subscribe(RaisedSuspicion, handler)
         self._replicas[inst_id] = replica
         logger.info("%s: added backup instance %d (primary %s)",
                     self._node_name, inst_id, replica.data.primary_name)
@@ -118,6 +123,15 @@ class Replicas:
             replica.message_req.stop()
             logger.info("%s: removed backup instance %d",
                         self._node_name, inst_id)
+
+    def subscribe_suspicions(self, handler: Callable) -> None:
+        """Route RaisedSuspicion from EVERY protocol instance (master +
+        current and future backups) to the node-level reporter."""
+        from plenum_tpu.common.messages.internal_messages import (
+            RaisedSuspicion)
+        self._suspicion_handlers.append(handler)
+        for replica in self._replicas.values():
+            replica.internal_bus.subscribe(RaisedSuspicion, handler)
 
     # --------------------------------------------------------- fan-out
 

@@ -82,7 +82,27 @@ class WriteRequestManager:
         if self.taa_validator is not None and req_pp_time is not None:
             self.taa_validator.validate(request, handler.ledger_id,
                                         req_pp_time)
+        self._reject_frozen_ledger_write(request, handler.ledger_id)
         handler.dynamic_validation(request, req_pp_time)
+
+    def _reject_frozen_ledger_write(self, request: Request,
+                                    ledger_id: Optional[int]):
+        """Frozen ledgers accept no writes (reference ledgers_freeze/).
+        Base ledgers can never be frozen (static validation), so the
+        hot path skips the state lookup entirely."""
+        from plenum_tpu.common.constants import (
+            CONFIG_LEDGER_ID, VALID_LEDGER_IDS)
+        if ledger_id is None or ledger_id in VALID_LEDGER_IDS:
+            return
+        from plenum_tpu.server.freeze_handlers import get_frozen_ledgers
+        config_state = self.database_manager.get_state(CONFIG_LEDGER_ID)
+        if config_state is None:
+            return
+        if ledger_id in get_frozen_ledgers(config_state,
+                                           is_committed=False):
+            raise InvalidClientRequest(
+                request.identifier, request.reqId,
+                "ledger {} is frozen".format(ledger_id))
 
     # -------------------------------------------------------------- apply
 

@@ -1,0 +1,247 @@
+"""Blacklister (reference plenum/server/blacklister.py +
+reportSuspiciousNode) and ledger freezing (reference
+request_handlers/ledgers_freeze/).
+"""
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    GET_FROZEN_LEDGERS, LEDGERS_FREEZE, NYM, ROLE, TARGET_NYM, TRUSTEE,
+    VERKEY)
+from plenum_tpu.common.messages.internal_messages import RaisedSuspicion
+from plenum_tpu.common.messages.node_messages import Reply
+from plenum_tpu.common.txn_util import get_payload_data, init_empty_txn
+from plenum_tpu.consensus.ordering_service import Suspicions, SuspiciousNode
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.blacklister import (
+    AUTO_BLACKLIST_CODES, SimpleBlacklister)
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.sim_network import SimNetwork
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+SIM_EPOCH = 1600000000
+TRUSTEE_SIGNER = SimpleSigner(seed=bytes([95]) * 32)
+
+
+def test_simple_blacklister():
+    b = SimpleBlacklister("test")
+    assert not b.is_blacklisted("Mallory")
+    b.blacklist("Mallory")
+    assert b.is_blacklisted("Mallory")
+    b.blacklist("Mallory")                       # idempotent
+    assert not b.is_blacklisted("Alice")
+
+
+def test_only_attributable_evidence_auto_blacklists():
+    """Non-attributable codes must never auto-blacklist: under an
+    equivocating primary, honest PREPAREs mismatch each other
+    (PR_DIGEST_WRONG against honest senders), and MessageReq
+    re-attributes fetched PRE-PREPAREs to the primary."""
+    assert AUTO_BLACKLIST_CODES == {Suspicions.DUPLICATE_PPR_SENT}
+    b = SimpleBlacklister("n")
+    b.report_suspicion("Honest", Suspicions.PR_DIGEST_WRONG, "mismatch",
+                       auto_blacklist=True)
+    assert not b.is_blacklisted("Honest")
+    assert b.suspicion_counts["Honest"] == 1
+    b.report_suspicion("Equivocator", Suspicions.DUPLICATE_PPR_SENT,
+                       "two PPs", auto_blacklist=True)
+    assert b.is_blacklisted("Equivocator")
+    # default posture (reference: blacklisting disabled): log only
+    b2 = SimpleBlacklister("n2")
+    b2.report_suspicion("X", Suspicions.DUPLICATE_PPR_SENT, "two PPs",
+                        auto_blacklist=False)
+    assert not b2.is_blacklisted("X")
+
+
+def genesis_txns():
+    txn = init_empty_txn(NYM)
+    get_payload_data(txn).update({
+        TARGET_NYM: TRUSTEE_SIGNER.identifier,
+        VERKEY: TRUSTEE_SIGNER.verkey,
+        ROLE: TRUSTEE,
+    })
+    return [txn]
+
+
+@pytest.fixture
+def pool(mock_timer):
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(47))
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15)
+    replies = []
+    nodes = [Node(n, NAMES, mock_timer, net.create_peer(n), config=conf,
+                  client_reply_handler=lambda c, m: replies.append(m),
+                  genesis_txns=genesis_txns())
+             for n in NAMES]
+    return nodes, replies, mock_timer
+
+
+def pump(timer, nodes, seconds=6.0, step=0.05):
+    end = timer.get_current_time() + seconds
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(step)
+
+
+_RID = [0]
+
+
+def submit(nodes, signer, operation):
+    _RID[0] += 1
+    req = {"identifier": signer.identifier, "reqId": _RID[0],
+           "protocolVersion": 2, "operation": operation}
+    req["signature"] = signer.sign(dict(req))
+    for n in nodes:
+        n.process_client_request(dict(req), "cli")
+
+
+def test_suspicions_reported_and_filter_drops_blacklisted(pool):
+    nodes, replies, timer = pool
+    node = nodes[0]
+    # default posture: suspicions are counted, NOT auto-blacklisted
+    node.replica.internal_bus.send(RaisedSuspicion(
+        inst_id=0, ex=SuspiciousNode(
+            "Gamma", Suspicions.PPR_DIGEST_WRONG, "forged digest")))
+    assert node.blacklister.suspicion_counts["Gamma"] == 1
+    assert not node.blacklister.is_blacklisted("Gamma")
+    # explicit (operator / attributable-evidence) blacklist drops the
+    # peer's consensus traffic at the node boundary
+    node.blacklister.blacklist("Gamma")
+    from plenum_tpu.common.messages.node_messages import Prepare
+    prep = Prepare(instId=0, viewNo=0, ppSeqNo=1, ppTime=SIM_EPOCH,
+                   digest="d", stateRootHash=None, txnRootHash=None,
+                   auditTxnRootHash=None)
+    before = dict(node.replica.ordering.prepares)
+    node.network.process_incoming(prep, "Gamma")
+    assert dict(node.replica.ordering.prepares) == before
+    # ...but connection-state events still pass (monitors must see them)
+    seen = []
+    node.network.subscribe(type(node.network).Connected,
+                           lambda msg, frm: seen.append(frm))
+    node.network.process_incoming(type(node.network).Connected(), "Gamma")
+    assert seen == ["Gamma"]
+    # the pool (minus the one blacklisting node's view of Gamma) still
+    # orders: 3 honest votes reach quorum
+    dest = SimpleSigner(seed=bytes([96]) * 32)
+    submit(nodes, TRUSTEE_SIGNER,
+           {"type": NYM, TARGET_NYM: dest.identifier, VERKEY: dest.verkey})
+    pump(timer, nodes)
+    assert all(n.domain_ledger.size == 2 for n in nodes)
+
+
+def test_opt_in_auto_blacklist_on_equivocation(mock_timer):
+    """BLACKLIST_ON_SUSPICION=True + DUPLICATE_PPR_SENT (an equivocating
+    primary) auto-blacklists; suspicions from BACKUP instances reach the
+    reporter too."""
+    mock_timer.set_time(SIM_EPOCH)
+    names7 = ["A", "B", "C", "D", "E", "F", "G"]
+    net = SimNetwork(mock_timer, DefaultSimRandom(49))
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15, BLACKLIST_ON_SUSPICION=True)
+    node = Node("A", names7, mock_timer, net.create_peer("A"), config=conf,
+                client_reply_handler=lambda c, m: None)
+    assert node.replicas.num_instances == 3
+    # evidence raised on a BACKUP instance's bus
+    node.replicas[1].internal_bus.send(RaisedSuspicion(
+        inst_id=1, ex=SuspiciousNode(
+            "F", Suspicions.DUPLICATE_PPR_SENT, "conflicting PPs")))
+    assert node.blacklister.is_blacklisted("F")
+    # non-attributable code never auto-blacklists, even opted in
+    node.replicas[1].internal_bus.send(RaisedSuspicion(
+        inst_id=1, ex=SuspiciousNode(
+            "E", Suspicions.PR_DIGEST_WRONG, "mismatch")))
+    assert not node.blacklister.is_blacklisted("E")
+
+
+# --------------------------------------------------------------- freeze
+
+def read_from(node, signer, operation):
+    _RID[0] += 1
+    req = {"identifier": signer.identifier, "reqId": _RID[0],
+           "protocolVersion": 2, "operation": operation}
+    req["signature"] = signer.sign(dict(req))
+    got = []
+    node._reply_to_client, orig = (
+        lambda c, m: got.append(m), node._reply_to_client)
+    try:
+        node.process_client_request(req, "cli-read")
+    finally:
+        node._reply_to_client = orig
+    return [m for m in got if isinstance(m, Reply)][-1].result
+
+
+def test_freeze_plugin_ledger_and_read_back(pool):
+    nodes, replies, timer = pool
+    # register a plugin ledger (id 42) on every node so it appears in
+    # the audit record, then freeze it
+    from plenum_tpu.ledger.ledger import Ledger
+    from plenum_tpu.ledger.tree_hasher import TreeHasher
+    from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+    for n in nodes:
+        n.db_manager.register_new_database(
+            42, Ledger(txn_store=KeyValueStorageInMemory(),
+                       tree_hasher=TreeHasher()), None,
+            taa_acceptance_required=False)
+    # order one domain write so the audit ledger records ledger 42
+    dest = SimpleSigner(seed=bytes([97]) * 32)
+    submit(nodes, TRUSTEE_SIGNER,
+           {"type": NYM, TARGET_NYM: dest.identifier, VERKEY: dest.verkey})
+    pump(timer, nodes)
+
+    submit(nodes, TRUSTEE_SIGNER,
+           {"type": LEDGERS_FREEZE, "ledgers_ids": [42]})
+    pump(timer, nodes)
+    result = read_from(nodes[0], TRUSTEE_SIGNER,
+                       {"type": GET_FROZEN_LEDGERS})
+    assert result["data"] is not None and "42" in result["data"]
+    assert result["data"]["42"]["seq_no"] == 0
+    roots = {str(n.db_manager.get_ledger(2).root_hash) for n in nodes}
+    assert len(roots) == 1
+    # enforcement: a write aimed at the frozen ledger is rejected
+    from plenum_tpu.common.exceptions import InvalidClientRequest
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.server.request_handlers import WriteRequestHandler
+
+    class PluginHandler(WriteRequestHandler):
+        def __init__(self, dm):
+            super().__init__(dm, "plugin-write", 42)
+
+        def static_validation(self, request):
+            pass
+
+        def dynamic_validation(self, request, req_pp_time=None):
+            pass
+
+        def update_state(self, txn, prev_result, request,
+                         is_committed=False):
+            pass
+
+    node = nodes[0]
+    node.write_manager.register_req_handler(PluginHandler(node.db_manager))
+    req = Request(identifier=TRUSTEE_SIGNER.identifier, reqId=999,
+                  operation={"type": "plugin-write"})
+    with pytest.raises(InvalidClientRequest, match="frozen"):
+        node.write_manager.dynamic_validation(req, SIM_EPOCH)
+
+
+def test_freeze_guards(pool):
+    nodes, replies, timer = pool
+    config_size = nodes[0].db_manager.get_ledger(2).size
+    # base ledgers can't be frozen
+    submit(nodes, TRUSTEE_SIGNER,
+           {"type": LEDGERS_FREEZE, "ledgers_ids": [1]})
+    pump(timer, nodes, 3)
+    # non-trustee can't freeze
+    steward = SimpleSigner(seed=bytes([98]) * 32)
+    submit(nodes, steward,
+           {"type": LEDGERS_FREEZE, "ledgers_ids": [42]})
+    pump(timer, nodes, 3)
+    # never-existing ledger can't be frozen
+    submit(nodes, TRUSTEE_SIGNER,
+           {"type": LEDGERS_FREEZE, "ledgers_ids": [77]})
+    pump(timer, nodes, 3)
+    assert all(n.db_manager.get_ledger(2).size == config_size
+               for n in nodes)
