@@ -25,6 +25,8 @@ def build_and_load(name: str) -> ctypes.CDLL:
             or os.path.getmtime(so) < os.path.getmtime(src)):
         cc = os.environ.get("CC", "cc")
         tmp = "%s.%d.tmp" % (so, os.getpid())
+        # plain -O3: measured FASTER than -march=native here — the
+        # auto-vectorizer pessimizes the 64x64->128 carry chains
         cmd = [cc, "-O3", "-shared", "-fPIC", "-std=c11", "-o", tmp, src]
         logger.info("building native module: %s", " ".join(cmd))
         try:

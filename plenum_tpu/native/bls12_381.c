@@ -300,7 +300,21 @@ static void fp2_mul(fp2 *r, const fp2 *a, const fp2 *b) {
     r->c1 = t2;
 }
 
-static void fp2_sqr(fp2 *r, const fp2 *a) { fp2_mul(r, a, a); }
+static void fp2_sqr(fp2 *r, const fp2 *a) {
+    /* complex squaring: (a0+a1u)² = (a0+a1)(a0−a1) + 2a0a1·u —
+     * 2 base mults instead of fp2_mul's 3 */
+    fp s, d, t;
+    fp_add(&s, &a->c0, &a->c1);
+    fp_sub(&d, &a->c0, &a->c1);
+    fp_mul(&t, &a->c0, &a->c1);
+    fp_mul(&r->c0, &s, &d);
+    fp_add(&r->c1, &t, &t);
+}
+
+static void fp2_mul_fp(fp2 *r, const fp2 *a, const fp *b) {
+    fp_mul(&r->c0, &a->c0, b);
+    fp_mul(&r->c1, &a->c1, b);
+}
 
 static void fp2_conj(fp2 *r, const fp2 *a) {
     r->c0 = a->c0;
@@ -447,7 +461,21 @@ static void fp12_mul(fp12 *r, const fp12 *a, const fp12 *b) {
     r->c1 = t2;
 }
 
-static void fp12_sqr(fp12 *r, const fp12 *a) { fp12_mul(r, a, a); }
+static void fp12_sqr(fp12 *r, const fp12 *a) {
+    /* Karatsuba-style: (c0 + c1 w)², w² = v —
+     * 2 fp6_muls instead of fp12_mul's 3 */
+    fp6 t, s0, s1;
+    fp6_mul(&t, &a->c0, &a->c1);
+    fp6_add(&s0, &a->c0, &a->c1);
+    fp6_mul_nonres(&s1, &a->c1);
+    fp6_add(&s1, &s1, &a->c0);
+    fp6_mul(&s0, &s0, &s1);             /* (c0+c1)(c0+v c1) */
+    fp6_sub(&s0, &s0, &t);
+    fp6 vt;
+    fp6_mul_nonres(&vt, &t);
+    fp6_sub(&r->c0, &s0, &vt);
+    fp6_add(&r->c1, &t, &t);
+}
 
 static void fp12_conj(fp12 *r, const fp12 *a) {
     r->c0 = a->c0;
@@ -805,138 +833,246 @@ static void g2_mul_scalar(g2 *r, const g2 *p, const u8 *k32) {
 
 /* ------------------------------------------------------------ pairing */
 
-/* untwist constants 1/w², 1/w³ (fq12), computed once */
-static fp12 W2_INV, W3_INV;
-static int untwist_ready = 0;
+/* Optimized ate Miller loop: T stays PROJECTIVE in Fp2 on the twist (no
+ * inversions in the loop — the old affine-in-fp12 version paid one
+ * ext-gcd fp12 inversion per step), line evaluations are sparse fp12
+ * elements multiplied in via mul_by_014 (~1/4 of a full fp12_mul).
+ * Doubling/addition step formulas: eprint 2010/354 Alg 26/27 (the
+ * zkcrypto/blst lineage for this exact curve/tower). Per-step values
+ * differ from the Python reference's affine loop by subfield
+ * normalization factors, which VANISH in the final exponentiation —
+ * so pairing outputs after final_exp are bit-identical to
+ * crypto/bls12_381.py (asserted by tests/test_bls_native.py). */
 
-static void untwist_init(void) {
-    if (untwist_ready) return;
-    frob_init();
-    fp12 w, w2, w3;
-    memset(&w, 0, sizeof w);
-    memcpy(w.c1.c0.c0.l, ONE_M, sizeof ONE_M);   /* w */
-    fp12_mul(&w2, &w, &w);
-    fp12_mul(&w3, &w2, &w);
-    fp12_inv(&W2_INV, &w2);
-    fp12_inv(&W3_INV, &w3);
-    untwist_ready = 1;
+/* fp6 sparse: self * (c0 + c1 v) */
+static void fp6_mul_by_01(fp6 *r, const fp6 *s, const fp2 *c0,
+                          const fp2 *c1) {
+    fp2 a_a, b_b, t1, t2, t3, u;
+    fp2_mul(&a_a, &s->c0, c0);
+    fp2_mul(&b_b, &s->c1, c1);
+    fp2_add(&u, &s->c1, &s->c2);
+    fp2_mul(&t1, &u, c1);
+    fp2_sub(&t1, &t1, &b_b);
+    fp2_mul_nonres(&t1, &t1);
+    fp2_add(&t1, &t1, &a_a);            /* c0 s0 + ξ c1 s2 */
+    fp2_add(&u, c0, c1);
+    fp2_add(&t2, &s->c0, &s->c1);
+    fp2_mul(&t2, &t2, &u);
+    fp2_sub(&t2, &t2, &a_a);
+    fp2_sub(&t2, &t2, &b_b);            /* c0 s1 + c1 s0 */
+    fp2_mul(&t3, &s->c2, c0);
+    fp2_add(&t3, &t3, &b_b);            /* c0 s2 + c1 s1 */
+    r->c0 = t1; r->c1 = t2; r->c2 = t3;
 }
 
-static void fp12_from_fp(fp12 *r, const fp *a) {
-    memset(r, 0, sizeof *r);
-    r->c0.c0.c0 = *a;
+/* fp6 sparse: self * (c1 v) */
+static void fp6_mul_by_1(fp6 *r, const fp6 *s, const fp2 *c1) {
+    fp2 t0, t1, t2;
+    fp2_mul(&t0, &s->c2, c1);
+    fp2_mul_nonres(&t0, &t0);
+    fp2_mul(&t1, &s->c0, c1);
+    fp2_mul(&t2, &s->c1, c1);
+    r->c0 = t0; r->c1 = t1; r->c2 = t2;
 }
 
-static void fp12_from_fp2(fp12 *r, const fp2 *a) {
-    memset(r, 0, sizeof *r);
-    r->c0.c0 = *a;
+/* f *= (c0 + c1 v) + (c4 v) w — the shape of an M-twist line */
+static void fp12_mul_by_014(fp12 *f, const fp2 *c0, const fp2 *c1,
+                            const fp2 *c4) {
+    fp6 aa, bb, t, o6;
+    fp2 o;
+    fp6_mul_by_01(&aa, &f->c0, c0, c1);
+    fp6_mul_by_1(&bb, &f->c1, c4);
+    fp2_add(&o, c1, c4);
+    fp6_add(&t, &f->c1, &f->c0);
+    fp6_mul_by_01(&t, &t, c0, &o);
+    fp6_sub(&t, &t, &aa);
+    fp6_sub(&t, &t, &bb);
+    fp6_mul_nonres(&o6, &bb);
+    fp6_add(&f->c0, &o6, &aa);
+    f->c1 = t;
 }
 
-/* generic affine Miller loop over E(Fq12), mirroring the Python
- * implementation (crypto/bls12_381.py miller_loop) for cross-checking */
+typedef struct { fp2 X, Y, Z; } g2p;
+
+/* eprint 2010/354 Alg 26: projective doubling + tangent-line coeffs */
+static void miller_dbl(g2p *r, fp2 *l0, fp2 *l1, fp2 *l4) {
+    fp2 tmp0, tmp1, tmp2, tmp3, tmp4, tmp5, tmp6, zsq, t;
+    fp2_sqr(&tmp0, &r->X);
+    fp2_sqr(&tmp1, &r->Y);
+    fp2_sqr(&tmp2, &tmp1);
+    fp2_add(&t, &tmp1, &r->X);
+    fp2_sqr(&tmp3, &t);
+    fp2_sub(&tmp3, &tmp3, &tmp0);
+    fp2_sub(&tmp3, &tmp3, &tmp2);
+    fp2_add(&tmp3, &tmp3, &tmp3);
+    fp2_add(&tmp4, &tmp0, &tmp0);
+    fp2_add(&tmp4, &tmp4, &tmp0);
+    fp2_add(&tmp6, &r->X, &tmp4);
+    fp2_sqr(&tmp5, &tmp4);
+    fp2_sqr(&zsq, &r->Z);
+    fp2_sub(&r->X, &tmp5, &tmp3);
+    fp2_sub(&r->X, &r->X, &tmp3);
+    fp2_add(&t, &r->Z, &r->Y);
+    fp2_sqr(&t, &t);
+    fp2_sub(&t, &t, &tmp1);
+    fp2_sub(&r->Z, &t, &zsq);
+    fp2_sub(&t, &tmp3, &r->X);
+    fp2_mul(&r->Y, &t, &tmp4);
+    fp2_add(&tmp2, &tmp2, &tmp2);
+    fp2_add(&tmp2, &tmp2, &tmp2);
+    fp2_add(&tmp2, &tmp2, &tmp2);
+    fp2_sub(&r->Y, &r->Y, &tmp2);
+    fp2_mul(&tmp3, &tmp4, &zsq);
+    fp2_add(&tmp3, &tmp3, &tmp3);
+    fp2_neg(&tmp3, &tmp3);
+    fp2_sqr(&tmp6, &tmp6);
+    fp2_sub(&tmp6, &tmp6, &tmp0);
+    fp2_sub(&tmp6, &tmp6, &tmp5);
+    fp2_add(&tmp1, &tmp1, &tmp1);
+    fp2_add(&tmp1, &tmp1, &tmp1);
+    fp2_sub(&tmp6, &tmp6, &tmp1);
+    fp2_mul(&tmp0, &r->Z, &zsq);
+    fp2_add(&tmp0, &tmp0, &tmp0);
+    *l0 = tmp0; *l1 = tmp3; *l4 = tmp6;
+}
+
+/* eprint 2010/354 Alg 27: mixed addition + secant-line coeffs */
+static void miller_add(g2p *r, const g2 *q, fp2 *l0, fp2 *l1, fp2 *l4) {
+    fp2 zsq, ysq, t0, t1, t2, t3, t4, t5, t6, t7, t8, t9, t10, ztsq, t;
+    fp2_sqr(&zsq, &r->Z);
+    fp2_sqr(&ysq, &q->y);
+    fp2_mul(&t0, &zsq, &q->x);
+    fp2_add(&t, &q->y, &r->Z);
+    fp2_sqr(&t1, &t);
+    fp2_sub(&t1, &t1, &ysq);
+    fp2_sub(&t1, &t1, &zsq);
+    fp2_mul(&t1, &t1, &zsq);
+    fp2_sub(&t2, &t0, &r->X);
+    fp2_sqr(&t3, &t2);
+    fp2_add(&t4, &t3, &t3);
+    fp2_add(&t4, &t4, &t4);
+    fp2_mul(&t5, &t4, &t2);
+    fp2_sub(&t6, &t1, &r->Y);
+    fp2_sub(&t6, &t6, &r->Y);
+    fp2_mul(&t9, &t6, &q->x);
+    fp2_mul(&t7, &t4, &r->X);
+    fp2_sqr(&r->X, &t6);
+    fp2_sub(&r->X, &r->X, &t5);
+    fp2_sub(&r->X, &r->X, &t7);
+    fp2_sub(&r->X, &r->X, &t7);
+    fp2_add(&t, &r->Z, &t2);
+    fp2_sqr(&t, &t);
+    fp2_sub(&t, &t, &zsq);
+    fp2_sub(&r->Z, &t, &t3);
+    fp2_add(&t10, &q->y, &r->Z);
+    fp2_sub(&t8, &t7, &r->X);
+    fp2_mul(&t8, &t8, &t6);
+    fp2_mul(&t0, &r->Y, &t5);
+    fp2_add(&t0, &t0, &t0);
+    fp2_sub(&r->Y, &t8, &t0);
+    fp2_sqr(&t10, &t10);
+    fp2_sub(&t10, &t10, &ysq);
+    fp2_sqr(&ztsq, &r->Z);
+    fp2_sub(&t10, &t10, &ztsq);
+    fp2_add(&t9, &t9, &t9);
+    fp2_sub(&t9, &t9, &t10);
+    fp2_add(&t10, &r->Z, &r->Z);
+    fp2_neg(&t6, &t6);
+    fp2_add(&t1, &t6, &t6);
+    *l0 = t10; *l1 = t1; *l4 = t9;
+}
+
+/* line eval at P + sparse accumulate: f *= l4 + (l1·xp) v + (l0·yp) v w */
+static void miller_ell(fp12 *f, const fp2 *l0, const fp2 *l1,
+                       const fp2 *l4, const g1 *p) {
+    fp2 c0, c1;
+    fp2_mul_fp(&c0, l0, &p->y);
+    fp2_mul_fp(&c1, l1, &p->x);
+    fp12_mul_by_014(f, l4, &c1, &c0);
+}
+
 static void miller(fp12 *f, const g1 *p, const g2 *q) {
-    untwist_init();
     fp12_one(f);
     if (p->inf || q->inf) return;
-    fp12 xa, ya, xq, yq, xt, yt;
-    fp12_from_fp(&xa, &p->x);
-    fp12_from_fp(&ya, &p->y);
-    fp12 t;
-    fp12_from_fp2(&t, &q->x);
-    fp12_mul(&xq, &t, &W2_INV);
-    fp12_from_fp2(&t, &q->y);
-    fp12_mul(&yq, &t, &W3_INV);
-    xt = xq; yt = yq;
-
-    /* ate loop over bits of |x|, MSB-1 downward; x is negative so
-     * conjugate at the end */
+    g2p r;
+    r.X = q->x;
+    r.Y = q->y;
+    memset(&r.Z, 0, sizeof r.Z);
+    memcpy(r.Z.c0.l, ONE_M, sizeof ONE_M);
+    fp2 l0, l1, l4;
     int started = 0;
     for (int b = 63; b >= 0; b--) {
         if (!started) {
             if ((X_ABS >> b) & 1) started = 1;  /* skip leading bit */
             continue;
         }
-        /* doubling step: line through (xt, yt) tangent */
-        fp12 lam, num, den, l;
-        fp12_sqr(&num, &xt);
-        fp12 three_num, two_y;
-        /* 3xt² */
-        fp6_add(&three_num.c0, &num.c0, &num.c0);
-        fp6_add(&three_num.c1, &num.c1, &num.c1);
-        fp6_add(&three_num.c0, &three_num.c0, &num.c0);
-        fp6_add(&three_num.c1, &three_num.c1, &num.c1);
-        /* 2yt */
-        fp6_add(&two_y.c0, &yt.c0, &yt.c0);
-        fp6_add(&two_y.c1, &yt.c1, &yt.c1);
-        fp12_inv(&den, &two_y);
-        fp12_mul(&lam, &three_num, &den);
-        /* l = ya - yt - lam (xa - xt) */
-        fp12 dx, tmp;
-        fp6_sub(&dx.c0, &xa.c0, &xt.c0);
-        fp6_sub(&dx.c1, &xa.c1, &xt.c1);
-        fp12_mul(&tmp, &lam, &dx);
-        fp6_sub(&l.c0, &ya.c0, &yt.c0);
-        fp6_sub(&l.c1, &ya.c1, &yt.c1);
-        fp6_sub(&l.c0, &l.c0, &tmp.c0);
-        fp6_sub(&l.c1, &l.c1, &tmp.c1);
         fp12_sqr(f, f);
-        fp12_mul(f, f, &l);
-        /* T = 2T */
-        fp12 x3, y3;
-        fp12_sqr(&x3, &lam);
-        fp6_sub(&x3.c0, &x3.c0, &xt.c0);
-        fp6_sub(&x3.c1, &x3.c1, &xt.c1);
-        fp6_sub(&x3.c0, &x3.c0, &xt.c0);
-        fp6_sub(&x3.c1, &x3.c1, &xt.c1);
-        fp6_sub(&dx.c0, &xt.c0, &x3.c0);
-        fp6_sub(&dx.c1, &xt.c1, &x3.c1);
-        fp12_mul(&y3, &lam, &dx);
-        fp6_sub(&y3.c0, &y3.c0, &yt.c0);
-        fp6_sub(&y3.c1, &y3.c1, &yt.c1);
-        xt = x3; yt = y3;
-
+        miller_dbl(&r, &l0, &l1, &l4);
+        miller_ell(f, &l0, &l1, &l4, p);
         if ((X_ABS >> b) & 1) {
-            /* addition step: line through T and Q */
-            fp12 dy;
-            fp6_sub(&dy.c0, &yq.c0, &yt.c0);
-            fp6_sub(&dy.c1, &yq.c1, &yt.c1);
-            fp6_sub(&dx.c0, &xq.c0, &xt.c0);
-            fp6_sub(&dx.c1, &xq.c1, &xt.c1);
-            fp12_inv(&den, &dx);
-            fp12_mul(&lam, &dy, &den);
-            fp6_sub(&dx.c0, &xa.c0, &xt.c0);
-            fp6_sub(&dx.c1, &xa.c1, &xt.c1);
-            fp12_mul(&tmp, &lam, &dx);
-            fp6_sub(&l.c0, &ya.c0, &yt.c0);
-            fp6_sub(&l.c1, &ya.c1, &yt.c1);
-            fp6_sub(&l.c0, &l.c0, &tmp.c0);
-            fp6_sub(&l.c1, &l.c1, &tmp.c1);
-            fp12_mul(f, f, &l);
-            /* T = T + Q */
-            fp12 x3, y3;
-            fp12_sqr(&x3, &lam);
-            fp6_sub(&x3.c0, &x3.c0, &xt.c0);
-            fp6_sub(&x3.c1, &x3.c1, &xt.c1);
-            fp6_sub(&x3.c0, &x3.c0, &xq.c0);
-            fp6_sub(&x3.c1, &x3.c1, &xq.c1);
-            fp6_sub(&dx.c0, &xt.c0, &x3.c0);
-            fp6_sub(&dx.c1, &xt.c1, &x3.c1);
-            fp12_mul(&y3, &lam, &dx);
-            fp6_sub(&y3.c0, &y3.c0, &yt.c0);
-            fp6_sub(&y3.c1, &y3.c1, &yt.c1);
-            xt = x3; yt = y3;
+            miller_add(&r, q, &l0, &l1, &l4);
+            miller_ell(f, &l0, &l1, &l4, p);
         }
     }
     /* x < 0: f = conj(f) */
     fp12_conj(f, f);
 }
 
-static void fp12_pow_u64(fp12 *r, const fp12 *a, u64 e) {
+
+/* final exponentiation: f^(3·(q^4-q^2+1)/r) via HHT:
+ * (x-1)^2 (x+q) (x^2+q^2-1) + 3, x = -X_ABS */
+/* Granger-Scott cyclotomic squaring (valid once in the cyclotomic
+ * subgroup, i.e. after the easy part of final exp): 3 "fp4 squarings"
+ * ≈ 9 fp2 mults vs fp12_sqr's ~24 */
+static void fp4_sqr_parts(fp2 *c0, fp2 *c1, const fp2 *a, const fp2 *b) {
+    fp2 t0, t1, t2;
+    fp2_sqr(&t0, a);
+    fp2_sqr(&t1, b);
+    fp2_mul_nonres(&t2, &t1);
+    fp2_add(c0, &t2, &t0);
+    fp2_add(&t2, a, b);
+    fp2_sqr(&t2, &t2);
+    fp2_sub(&t2, &t2, &t0);
+    fp2_sub(c1, &t2, &t1);
+}
+
+static void fp12_cyc_sqr(fp12 *r, const fp12 *f) {
+    fp2 z0 = f->c0.c0, z4 = f->c0.c1, z3 = f->c0.c2;
+    fp2 z2 = f->c1.c0, z1 = f->c1.c1, z5 = f->c1.c2;
+    fp2 t0, t1, t2, t3;
+    fp4_sqr_parts(&t0, &t1, &z0, &z1);
+    fp2_sub(&z0, &t0, &z0);
+    fp2_add(&z0, &z0, &z0);
+    fp2_add(&z0, &z0, &t0);
+    fp2_add(&z1, &t1, &z1);
+    fp2_add(&z1, &z1, &z1);
+    fp2_add(&z1, &z1, &t1);
+    fp4_sqr_parts(&t0, &t1, &z2, &z3);
+    fp4_sqr_parts(&t2, &t3, &z4, &z5);
+    fp2_sub(&z4, &t0, &z4);
+    fp2_add(&z4, &z4, &z4);
+    fp2_add(&z4, &z4, &t0);
+    fp2_add(&z5, &t1, &z5);
+    fp2_add(&z5, &z5, &z5);
+    fp2_add(&z5, &z5, &t1);
+    fp2_mul_nonres(&t0, &t3);
+    fp2_add(&z2, &t0, &z2);
+    fp2_add(&z2, &z2, &z2);
+    fp2_add(&z2, &z2, &t0);
+    fp2_sub(&z3, &t2, &z3);
+    fp2_add(&z3, &z3, &z3);
+    fp2_add(&z3, &z3, &t2);
+    r->c0.c0 = z0; r->c0.c1 = z4; r->c0.c2 = z3;
+    r->c1.c0 = z2; r->c1.c1 = z1; r->c1.c2 = z5;
+}
+
+/* pow within the cyclotomic subgroup (hard part of final exp) */
+static void fp12_pow_u64_cyc(fp12 *r, const fp12 *a, u64 e) {
     fp12 acc;
     fp12_one(&acc);
     int started = 0;
     for (int b = 63; b >= 0; b--) {
-        if (started) fp12_sqr(&acc, &acc);
+        if (started) fp12_cyc_sqr(&acc, &acc);
         if ((e >> b) & 1) {
             if (!started) { acc = *a; started = 1; }
             else fp12_mul(&acc, &acc, a);
@@ -946,17 +1082,14 @@ static void fp12_pow_u64(fp12 *r, const fp12 *a, u64 e) {
     *r = acc;
 }
 
-/* f^x with x = -X_ABS, valid after the easy part (inverse = conj) */
-static void fp12_pow_x(fp12 *r, const fp12 *a) {
+static void fp12_pow_x_cyc(fp12 *r, const fp12 *a) {
     fp12 t;
-    fp12_pow_u64(&t, a, X_ABS);
+    fp12_pow_u64_cyc(&t, a, X_ABS);
     fp12_conj(r, &t);
 }
 
-/* final exponentiation: f^(3·(q^4-q^2+1)/r) via HHT:
- * (x-1)^2 (x+q) (x^2+q^2-1) + 3, x = -X_ABS */
 static void final_exp(fp12 *r, const fp12 *f) {
-    untwist_init();
+    frob_init();
     fp12 t0, t1, m;
     /* easy: f^(q^6-1) = conj(f) * f^-1 ; then ^(q^2+1) */
     fp12_conj(&t0, f);
@@ -966,30 +1099,31 @@ static void final_exp(fp12 *r, const fp12 *f) {
     fp12_frob(&t0, &t0);
     fp12_mul(&m, &t0, &m);         /* m = f^((q^6-1)(q^2+1)) */
 
-    /* hard: m^((x-1)^2 (x+q) (x^2+q^2-1)) * m^3 */
+    /* hard: m^((x-1)^2 (x+q) (x^2+q^2-1)) * m^3 — all exponentiations
+     * run in the cyclotomic subgroup (Granger-Scott squarings) */
     fp12 a, b, c;
     /* a = m^(x-1); x-1 = -(X_ABS+1) → pow by X_ABS+1 then conj */
-    fp12_pow_u64(&a, &m, X_ABS + 1);
+    fp12_pow_u64_cyc(&a, &m, X_ABS + 1);
     fp12_conj(&a, &a);
-    fp12_pow_u64(&t0, &a, X_ABS + 1);
+    fp12_pow_u64_cyc(&t0, &a, X_ABS + 1);
     fp12_conj(&a, &t0);            /* a = m^((x-1)^2) (sign squares away:
                                       (-(X+1))² = (X+1)² — conj twice = id,
                                       so conj applied twice is identity;
                                       keep both conjs for clarity) */
     /* b = a^(x+q) = a^x * frob(a) */
-    fp12_pow_x(&t0, &a);
+    fp12_pow_x_cyc(&t0, &a);
     fp12_frob(&t1, &a);
     fp12_mul(&b, &t0, &t1);
     /* c = b^(x²+q²-1) = (b^x)^x * frob²(b) * conj(b) */
-    fp12_pow_x(&t0, &b);
-    fp12_pow_x(&t0, &t0);
+    fp12_pow_x_cyc(&t0, &b);
+    fp12_pow_x_cyc(&t0, &t0);
     fp12_frob(&t1, &b);
     fp12_frob(&t1, &t1);
     fp12_mul(&c, &t0, &t1);
     fp12_conj(&t0, &b);
     fp12_mul(&c, &c, &t0);
     /* result = c * m² * m */
-    fp12_sqr(&t0, &m);
+    fp12_cyc_sqr(&t0, &m);
     fp12_mul(&t0, &t0, &m);
     fp12_mul(r, &c, &t0);
 }
