@@ -201,7 +201,12 @@ def client_ha_from_pool_genesis(base_dir: str, name: str):
 
 def build_networked_node(name: str, base_dir: str, config=None):
     """Construct a NetworkedNode from on-disk keys + genesis, with
-    durable file-backed stores under <base>/<name>/data/."""
+    durable file-backed stores under <base>/<name>/data/. Config is
+    layered from <base>/plenum_tpu_config.py + PLENUM_TPU_* env vars
+    unless one is passed explicitly."""
+    if config is None:
+        from plenum_tpu.common.config import Config
+        config = Config.load(base_dir)
     from plenum_tpu.server.networked_node import NetworkedNode
     from plenum_tpu.storage import kv_native
     from plenum_tpu.storage.kv_file import KeyValueStorageFile

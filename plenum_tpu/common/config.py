@@ -2,8 +2,10 @@
 
 Reference: plenum/config.py (~189 knobs) + stp_core/config.py. Kept as a
 simple attribute namespace; override via Config(**overrides) or attribute
-assignment (tests use the `tconf` fixture pattern).
+assignment (tests use the `tconf` fixture pattern); layered file/env
+loading via Config.load (reference plenum/common/config_util.py).
 """
+import os
 
 
 class Config:
@@ -115,6 +117,83 @@ class Config:
     def __init__(self, **overrides):
         for k, v in overrides.items():
             setattr(self, k, v)
+
+    # ------------------------------------------------ layered loading
+
+    @classmethod
+    def load(cls, base_dir: str = None, env: dict = None,
+             **overrides) -> "Config":
+        """Layered config (reference plenum/common/config_util.py
+        getConfig: package defaults ← /etc ← user dir ← env):
+
+            1. class defaults
+            2. `plenum_tpu_config.py` in base_dir (exec'd; UPPERCASE and
+               known keys become attributes)
+            3. PLENUM_TPU_<KEY>=value environment overrides (parsed as
+               Python literals, falling back to raw strings)
+            4. explicit **overrides (strongest)
+        """
+        import ast
+        conf = cls()
+        known = {k for k in dir(cls)
+                 if not k.startswith("_") and not callable(getattr(cls, k))}
+        explicit = set()
+        if base_dir:
+            path = os.path.join(base_dir, "plenum_tpu_config.py")
+            if os.path.exists(path):
+                # ONE namespace: separate globals/locals would break
+                # top-level references from genexps/functions
+                ns = {}
+                with open(path) as f:
+                    exec(compile(f.read(), path, "exec"), ns)
+                for k, v in ns.items():
+                    if k != "__builtins__" and (k in known or k.isupper()):
+                        setattr(conf, k, v)
+                        explicit.add(k)
+        env = os.environ if env is None else env
+        for k in known:
+            raw = env.get("PLENUM_TPU_" + k.upper())
+            if raw is None:
+                continue
+            setattr(conf, k, cls._parse_env(k, raw))
+            explicit.add(k)
+        for k, v in overrides.items():
+            setattr(conf, k, v)
+            explicit.add(k)
+        # derived invariant: the checkpoint window must fit the log
+        # window or 3PC stalls (no checkpoint ever stabilizes). If the
+        # operator moved CHK_FREQ without touching LOG_SIZE, re-derive
+        # the usual 3x relation; an explicit inconsistent pair is an
+        # error, not a silent stall.
+        if "CHK_FREQ" in explicit and "LOG_SIZE" not in explicit:
+            conf.LOG_SIZE = 3 * conf.CHK_FREQ
+        if conf.LOG_SIZE < conf.CHK_FREQ:
+            raise ValueError(
+                "LOG_SIZE ({}) must be >= CHK_FREQ ({}) or no checkpoint "
+                "can ever stabilize".format(conf.LOG_SIZE, conf.CHK_FREQ))
+        return conf
+
+    @staticmethod
+    def _parse_env(key: str, raw: str):
+        """Literal if possible; common booleans; otherwise raw ONLY for
+        string-typed knobs — a typo'd number must fail loudly, not ride
+        along as a truthy string."""
+        import ast
+        try:
+            return ast.literal_eval(raw)
+        except (ValueError, SyntaxError):
+            pass
+        low = raw.strip().lower()
+        if low in ("true", "yes", "on"):
+            return True
+        if low in ("false", "no", "off"):
+            return False
+        default = getattr(Config, key, None)
+        if default is None or isinstance(default, str):
+            return raw
+        raise ValueError(
+            "cannot parse PLENUM_TPU_{}={!r} as a {}".format(
+                key.upper(), raw, type(default).__name__))
 
 
 def getConfig(**overrides) -> Config:

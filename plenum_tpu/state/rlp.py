@@ -10,15 +10,46 @@ from typing import List, Tuple, Union
 RlpItem = Union[bytes, List["RlpItem"]]
 
 
+# one-byte length prefixes, precomputed (the hot path: trie refs are
+# 32-byte hashes and node bodies are usually short)
+_STR_PFX = [bytes([0x80 + n]) for n in range(56)]
+_LIST_PFX = [bytes([0xC0 + n]) for n in range(56)]
+
+
 def encode(item: RlpItem) -> bytes:
-    if isinstance(item, (bytes, bytearray)):
-        item = bytes(item)
-        if len(item) == 1 and item[0] < 0x80:
+    t = type(item)
+    if t is bytes:
+        n = len(item)
+        if n == 1 and item[0] < 0x80:
             return item
-        return _len_prefix(len(item), 0x80) + item
+        if n < 56:
+            return _STR_PFX[n] + item
+        return _len_prefix(n, 0x80) + item
+    if t is list or t is tuple:
+        parts = []
+        for x in item:
+            if type(x) is bytes:          # inline the dominant case
+                n = len(x)
+                if n == 1 and x[0] < 0x80:
+                    parts.append(x)
+                elif n < 56:
+                    parts.append(_STR_PFX[n] + x)
+                else:
+                    parts.append(_len_prefix(n, 0x80) + x)
+            else:
+                parts.append(encode(x))
+        body = b"".join(parts)
+        n = len(body)
+        if n < 56:
+            return _LIST_PFX[n] + body
+        return _len_prefix(n, 0xC0) + body
+    # subclasses (and bytearray) take the old isinstance-based path —
+    # the exact-type checks above are only a fast path, not a contract
+    # change
+    if isinstance(item, (bytes, bytearray)):
+        return encode(bytes(item))
     if isinstance(item, (list, tuple)):
-        body = b"".join(encode(x) for x in item)
-        return _len_prefix(len(body), 0xC0) + body
+        return encode(list(item))
     raise TypeError("cannot RLP-encode {}".format(type(item)))
 
 

@@ -31,12 +31,13 @@ def sha3(data: bytes) -> bytes:
     return hashlib.sha3_256(data).digest()
 
 
+_HEXVAL = {c: int(c, 16) for c in "0123456789abcdef"}
+
+
 def bytes_to_nibbles(key: bytes) -> List[int]:
-    out = []
-    for b in key:
-        out.append(b >> 4)
-        out.append(b & 0x0F)
-    return out
+    # bytes.hex() runs in C; one dict hit per nibble beats two shifts +
+    # two appends per byte (this is the hottest pure-Python trie helper)
+    return [_HEXVAL[c] for c in key.hex()]
 
 
 def hp_encode(nibbles: Sequence[int], terminal: bool) -> bytes:

@@ -122,6 +122,42 @@ def test_txn_version_controller_defaults():
     tvc.update_version(txn)                          # base: no-op
 
 
+def test_layered_config_loading(tdir):
+    """Config.load: class defaults ← config file ← env ← overrides
+    (reference plenum/common/config_util.py getConfig)."""
+    import os
+    with open(os.path.join(tdir, "plenum_tpu_config.py"), "w") as f:
+        f.write("Max3PCBatchSize = 77\nCHK_FREQ = 9\nMY_PLUGIN_KNOB = 'x'\n"
+                # top-level refs from genexps must work (single exec ns)
+                "BASE = 2\nDERIVED = list(BASE * i for i in range(3))\n")
+    conf = Config.load(tdir, env={})
+    assert conf.Max3PCBatchSize == 77
+    assert conf.CHK_FREQ == 9
+    assert conf.MY_PLUGIN_KNOB == "x"           # UPPERCASE extras kept
+    assert conf.DERIVED == [0, 2, 4]
+    # CHK_FREQ moved without LOG_SIZE: the 3x relation is re-derived so
+    # checkpoints can still stabilize
+    assert conf.LOG_SIZE == 27
+    # env layer beats the file; literals parse; lowercase bools work
+    conf = Config.load(tdir, env={"PLENUM_TPU_MAX3PCBATCHSIZE": "123",
+                                  "PLENUM_TPU_UPDATE_STATE_FRESHNESS":
+                                      "false"})
+    assert conf.Max3PCBatchSize == 123
+    assert conf.UPDATE_STATE_FRESHNESS is False
+    # unparsable value for a numeric knob fails loudly
+    with pytest.raises(ValueError):
+        Config.load(env={"PLENUM_TPU_MAX3PCBATCHSIZE": "1O00"})
+    # inconsistent explicit pair is an error, not a silent 3PC stall
+    with pytest.raises(ValueError):
+        Config.load(env={}, CHK_FREQ=500, LOG_SIZE=300)
+    # explicit overrides beat everything
+    conf = Config.load(tdir, env={"PLENUM_TPU_MAX3PCBATCHSIZE": "123"},
+                       Max3PCBatchSize=5)
+    assert conf.Max3PCBatchSize == 5
+    # no file, no env: pure defaults
+    assert Config.load(env={}).Max3PCBatchSize == Config.Max3PCBatchSize
+
+
 def test_oversize_message_dropped_not_sent():
     """A single message above the frame limit is dropped sender-side
     (reference prepare_batch: 'Batches were not created'); smaller
