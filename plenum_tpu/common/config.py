@@ -43,6 +43,11 @@ class Config:
     # ---- freshness (reference plenum/config.py STATE_FRESHNESS_UPDATE_INTERVAL)
     UPDATE_STATE_FRESHNESS = True
     STATE_FRESHNESS_UPDATE_INTERVAL = 300
+    # stale periods before non-primaries vote a view change (reference
+    # ACCEPTABLE_FRESHNESS_INTERVALS_COUNT)
+    ACCEPTABLE_FRESHNESS_INTERVALS_COUNT = 3
+    # periodic forced view changes (chaos/debug; 0 = disabled)
+    ForceViewChangeFreq = 0
     ACCEPTABLE_DEVIATION_PREPREPARE_SECS = 300
 
     # ---- merkle hashing (TreeHasher TPU seam, ledger/tree_hasher.py)

@@ -38,3 +38,10 @@ class FreshnessChecker:
 
     def get_last_update(self, ledger_id: int) -> float:
         return self._last_updated[ledger_id]
+
+    def reset_all(self, now: float):
+        """Restart the staleness clocks — on resuming participation
+        (catchup done, new view) the old timestamps reflect the node's
+        own absence, not the primary's negligence."""
+        for lid in self._last_updated:
+            self._last_updated[lid] = max(self._last_updated[lid], now)

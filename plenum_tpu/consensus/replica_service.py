@@ -76,9 +76,18 @@ class ReplicaService:
             self.vc_trigger = ViewChangeTriggerService(
                 data=self._data, timer=timer, bus=self.internal_bus,
                 network=network, config=self.config)
+            from plenum_tpu.consensus.monitoring import (
+                ForcedViewChangeService, FreshnessMonitorService)
+            self.freshness_monitor = FreshnessMonitorService(
+                data=self._data, timer=timer, bus=self.internal_bus,
+                freshness_checker=freshness_checker, config=self.config)
+            self.forced_vc = ForcedViewChangeService(
+                timer=timer, bus=self.internal_bus, config=self.config)
         else:
             self.view_changer = None
             self.vc_trigger = None
+            self.freshness_monitor = None
+            self.forced_vc = None
         from plenum_tpu.consensus.message_req_service import MessageReqService
         self.message_req = MessageReqService(
             data=self._data, timer=timer, bus=self.internal_bus,

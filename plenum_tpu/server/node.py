@@ -263,6 +263,13 @@ class Node:
             config=self.config)
         self.replica.internal_bus.subscribe(
             NewViewAccepted, lambda msg: self.monitor.reset())
+        # the ordering pause during a view change must not read as
+        # primary freshness-negligence right after it
+        self.replica.internal_bus.subscribe(
+            NewViewAccepted,
+            lambda msg: self.freshness_checker is not None
+            and self.freshness_checker.reset_all(
+                self.timer.get_current_time()))
         # a new view invalidates any stored backup-primary position
         self.replica.internal_bus.subscribe(
             NewViewAccepted,
@@ -846,6 +853,11 @@ class Node:
         for replica in self.replicas:
             replica.data.node_mode_participating = True
         self.replica.ordering.on_catchup_finished()
+        if self.freshness_checker is not None:
+            # stale timestamps reflect OUR absence, not the primary's
+            # negligence — restart the watchdog clocks or a freshly
+            # caught-up node votes out a healthy primary
+            self.freshness_checker.reset_all(self.timer.get_current_time())
         logger.info("%s catchup finished; last_ordered=%s", self.name,
                     self.replica.data.last_ordered_3pc)
 

@@ -109,6 +109,77 @@ def test_freshness_batches_stop_when_traffic_flows(pool):
         assert now - checker.get_last_update(lid) < FRESHNESS + 2, lid
 
 
+def test_freshness_monitor_votes_vc_when_primary_shirks(pool):
+    """A primary alive enough to dodge the connection monitor but not
+    sending freshness batches gets voted out: block its PrePrepares so
+    state signatures go stale, and the pool moves to view 1 (reference
+    freshness_monitor_service.py)."""
+    from plenum_tpu.common.messages.node_messages import PrePrepare
+    nodes, timer = pool
+    primary = nodes[0].master_primary_name
+    # the primary's PRE-PREPAREs vanish at every receiver: no batches
+    # ordered, so no freshness updates — but the primary stays connected
+    for n in nodes:
+        orig = n.network.process_incoming
+
+        def dropping(msg, frm, orig=orig):
+            if isinstance(msg, PrePrepare) and frm == primary:
+                return None
+            return orig(msg, frm)
+        n.network.process_incoming = dropping
+    # stale threshold = 3 * FRESHNESS = 90s; give it time to trip + VC
+    pump(timer, nodes, FRESHNESS * 5, step=0.5)
+    views = {n.view_no for n in nodes}
+    assert views == {1}, views
+    assert all(n.master_primary_name != primary for n in nodes)
+
+
+def test_caught_up_node_does_not_vote_out_healthy_primary(pool):
+    """After catchup, the freshness clocks restart: the node's own
+    absence must not read as primary negligence (a rolling restart
+    would otherwise evict a healthy primary)."""
+    nodes, timer = pool
+    node = nodes[1]
+    # simulate a long absence: clocks say nothing ordered for ages
+    for lid in node.freshness_checker.ledger_ids:
+        node.freshness_checker._last_updated[lid] -= FRESHNESS * 100
+    age_before = timer.get_current_time() - min(
+        node.freshness_checker.get_last_update(lid)
+        for lid in node.freshness_checker.ledger_ids)
+    assert age_before > 3 * FRESHNESS
+    node._on_catchup_finished()
+    age_after = timer.get_current_time() - min(
+        node.freshness_checker.get_last_update(lid)
+        for lid in node.freshness_checker.ledger_ids)
+    assert age_after == 0
+    assert node.replica.freshness_monitor._is_state_fresh_enough()
+
+
+def test_forced_view_change_service():
+    """ForceViewChangeFreq > 0 periodically votes view changes
+    (reference forced_view_change_service.py; off by default)."""
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.common.messages.internal_messages import (
+        VoteForViewChange)
+    from plenum_tpu.consensus.monitoring import ForcedViewChangeService
+    from plenum_tpu.runtime.bus import InternalBus
+    from plenum_tpu.testing.mock_timer import MockTimer
+    timer = MockTimer()
+    bus = InternalBus()
+    votes = []
+    bus.subscribe(VoteForViewChange, lambda msg: votes.append(msg))
+    svc = ForcedViewChangeService(timer, bus, Config(ForceViewChangeFreq=10))
+    timer.run_for(35)
+    assert len(votes) == 3
+    svc.cleanup()
+    # disabled by default (fresh timer/bus: no residue from above)
+    timer2, bus2, votes2 = MockTimer(), InternalBus(), []
+    bus2.subscribe(VoteForViewChange, lambda msg: votes2.append(msg))
+    ForcedViewChangeService(timer2, bus2, Config())
+    timer2.run_for(100)
+    assert votes2 == []
+
+
 def test_view_change_still_works_with_freshness(pool):
     """Freshness batches must not confuse view change re-ordering."""
     nodes, timer = pool
