@@ -151,6 +151,7 @@ class StackBase:
         # decoded inbound messages: (msg_dict, frm_name)
         self.rx: deque = deque()
         self._tasks: Set[asyncio.Task] = set()
+        self._stopped = False
         self.msg_len_limit = self.config.MSG_LEN_LIMIT
 
     # ------------------------------------------------------------ server
@@ -164,12 +165,20 @@ class StackBase:
         logger.info("%s listening on %s:%d", self.name, *self.ha)
 
     async def stop(self):
+        self._stopped = True
         if self._server is not None:
             self._server.close()
             self._server = None
         for t in list(self._tasks):
             t.cancel()
         self._tasks.clear()
+        # server.close() does NOT cancel established connection handlers
+        # — a "stopped" stack whose read loops keep answering heartbeats
+        # is a zombie peers never detect as dead
+        self._close_connections()
+
+    def _close_connections(self):
+        """Subclasses close every live connection they hold."""
 
     def _spawn(self, coro) -> asyncio.Task:
         task = asyncio.get_event_loop().create_task(coro)
@@ -232,6 +241,13 @@ class NodeStack(StackBase):
         for info in registry.values():
             if info.name != self.name:
                 self.add_remote(info)
+
+    def _close_connections(self):
+        for conn in list(self._incoming.values()):
+            conn.close()
+        self._incoming.clear()
+        for remote in self.remotes.values():
+            remote.disconnect()
 
     # ------------------------------------------------------- membership
 
@@ -359,6 +375,10 @@ class NodeStack(StackBase):
     def service_lifecycle(self):
         """Reconnects + heartbeats; call every prod tick (reference
         keep_in_touch.py:36 serviceLifecycle)."""
+        if self._stopped:
+            # a prod after stop() must not re-dial peers and resurrect
+            # the zombie stop() just killed
+            return
         now = time.monotonic()
         for remote in self.remotes.values():
             if remote.is_connected:
@@ -533,6 +553,12 @@ class ClientStack(StackBase):
         self._clients: Dict[str, Connection] = {}
         self._order: deque = deque()  # client ids, accept order
         self._counter = 0
+
+    def _close_connections(self):
+        for conn in list(self._clients.values()):
+            conn.close()
+        self._clients.clear()
+        self._order.clear()
 
     async def _on_accept(self, reader, writer):
         try:
