@@ -29,7 +29,8 @@ from plenum_tpu.common.messages.client_request import ClientMessageValidator
 from plenum_tpu.common.messages.node_messages import (
     Ordered, Propagate, Reject, Reply, RequestAck, RequestNack)
 from plenum_tpu.common.request import Request
-from plenum_tpu.common.txn_util import get_payload_data, get_seq_no
+from plenum_tpu.common.txn_util import (
+    get_payload_data, get_seq_no, get_txn_time)
 from plenum_tpu.consensus.replica_service import ReplicaService
 from plenum_tpu.ledger.ledger import Ledger
 from plenum_tpu.runtime.timer import TimerService
@@ -818,6 +819,24 @@ class Node:
         """Apply one caught-up txn: ledger append + state update
         (reference postTxnFromCatchupAddedToLedger node.py:1748)."""
         self.metrics.add_event(MetricsName.CATCHUP_TXNS_RECEIVED, 1)
+        if ledger_id == AUDIT_LEDGER_ID:
+            # every audit txn records each ledger's state root at its
+            # batch: feed the ts store so state-at-a-time reads resolve
+            # inside caught-up history too (live nodes get these from
+            # TsStoreBatchHandler at commit)
+            ts_store = self.db_manager.get_store("state_ts")
+            txn_time = get_txn_time(txn)
+            if ts_store is not None and txn_time is not None:
+                from plenum_tpu.server.batch_handlers import (
+                    AUDIT_TXN_STATE_ROOT)
+                roots = get_payload_data(txn).get(
+                    AUDIT_TXN_STATE_ROOT) or {}
+                for lid_str, root_b58 in roots.items():
+                    lid = int(lid_str)
+                    ledger = self.db_manager.get_ledger(lid)
+                    if ledger is not None:
+                        ts_store.set(txn_time,
+                                     ledger.strToHash(root_b58), lid)
         from plenum_tpu.common.txn_util import get_payload_digest, get_type
         ledger = self.db_manager.get_ledger(ledger_id)
         ledger.add(dict(txn))

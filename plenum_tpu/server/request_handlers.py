@@ -323,10 +323,32 @@ class GetNymHandler(ReadRequestHandler):
         if not isinstance(nym, str) or not nym:
             raise InvalidClientRequest(request.identifier, request.reqId,
                                        "GET_NYM must have a dest")
-        data, seq_no, update_time = decode_state_value(
-            self.state.get(nym_to_state_key(nym), isCommitted=True))
-        proof = self.state.generate_state_proof(nym_to_state_key(nym),
-                                                serialize=True)
+        key = nym_to_state_key(nym)
+        ts = request.operation.get("timestamp")
+        if ts is not None and (isinstance(ts, bool)
+                               or not isinstance(ts, (int, float))):
+            raise InvalidClientRequest(
+                request.identifier, request.reqId,
+                "timestamp must be a number")
+        if ts is not None:
+            # state-at-a-time: resolve the committed root at (or before)
+            # the timestamp via the ts store; the MPT keeps history, so
+            # old roots stay readable and provable (reference
+            # state_ts_store + get_nym_handler timestamp path)
+            ts_store = self.database_manager.get_store("state_ts")
+            root = (ts_store.get_equal_or_prev(ts, self.ledger_id)
+                    if ts_store is not None else None)
+            if root is None:
+                data, seq_no, proof = None, None, None
+            else:
+                data, seq_no, _ = decode_state_value(
+                    self.state.get_for_root_hash(root, key))
+                proof = self.state.generate_state_proof(
+                    key, root=root, serialize=True)
+        else:
+            data, seq_no, _ = decode_state_value(
+                self.state.get(key, isCommitted=True))
+            proof = self.state.generate_state_proof(key, serialize=True)
         return {
             TXN_TYPE: "105",
             "identifier": request.identifier,

@@ -287,6 +287,80 @@ def test_ts_store_backfilled_from_audit_on_restart(pool, tdir):
     assert store.get_equal_or_prev(now, 2) == expected
 
 
+def test_get_nym_at_timestamp(pool):
+    """State-at-a-time reads: GET_NYM with a timestamp resolves through
+    the ts store to the HISTORICAL root — a key written later reads as
+    absent at the earlier time, present now, both with proofs."""
+    nodes, replies, timer = pool
+    setup_taa(nodes, timer)
+    # an initial domain batch so a domain root exists at t_before
+    first = SimpleSigner(seed=bytes([98]) * 32)
+    submit(nodes, TRUSTEE_SIGNER,
+           {"type": NYM, TARGET_NYM: first.identifier,
+            VERKEY: first.verkey},
+           taa_acceptance=acceptance())
+    pump(timer, nodes)
+    t_before = timer.get_current_time()
+    pump(timer, nodes, 10)      # let sim time move past t_before
+    dest = SimpleSigner(seed=bytes([99]) * 32)
+    submit(nodes, TRUSTEE_SIGNER,
+           {"type": NYM, TARGET_NYM: dest.identifier, VERKEY: dest.verkey},
+           taa_acceptance=acceptance())
+    pump(timer, nodes)
+    node = nodes[0]
+    now = timer.get_current_time()
+    # present now, with proof
+    res = read_from(node, TRUSTEE_SIGNER,
+                    {"type": "105", TARGET_NYM: dest.identifier,
+                     "timestamp": int(now)})
+    assert res["data"] is not None and res["state_proof"] is not None
+    # absent at the earlier timestamp (root predates the write)
+    res = read_from(node, TRUSTEE_SIGNER,
+                    {"type": "105", TARGET_NYM: dest.identifier,
+                     "timestamp": int(t_before)})
+    assert res["data"] is None
+    assert res["state_proof"] is not None   # proof of absence at old root
+    # before any batch at all: no root known
+    res = read_from(node, TRUSTEE_SIGNER,
+                    {"type": "105", TARGET_NYM: dest.identifier,
+                     "timestamp": SIM_EPOCH - 50})
+    assert res["data"] is None and res["state_proof"] is None
+
+
+def test_timestamp_reads_cover_caught_up_history(pool):
+    """A node that received batches via CATCHUP must answer
+    state-at-a-time reads inside the caught-up window identically to a
+    node that ordered them live (the audit txns it applies carry each
+    batch's roots and times)."""
+    nodes, replies, timer = pool
+    setup_taa(nodes, timer)
+    dest = SimpleSigner(seed=bytes([101]) * 32)
+    submit(nodes, TRUSTEE_SIGNER,
+           {"type": NYM, TARGET_NYM: dest.identifier, VERKEY: dest.verkey},
+           taa_acceptance=acceptance())
+    pump(timer, nodes)
+    t_mid = timer.get_current_time()
+    pump(timer, nodes, 5)
+    # a genesis-only node receives the audit history via the catchup
+    # hook (the leecher's application path for caught-up txns)
+    node = nodes[0]
+    from plenum_tpu.testing.mock_timer import MockTimer
+    t2 = MockTimer(); t2.set_time(SIM_EPOCH)
+    net2 = SimNetwork(t2, DefaultSimRandom(1))
+    fresh = Node("Echo", NAMES, t2, net2.create_peer("Echo"),
+                 config=Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2,
+                               CHK_FREQ=5, LOG_SIZE=15),
+                 genesis_txns=genesis_txns())
+    from plenum_tpu.common.constants import AUDIT_LEDGER_ID
+    audit = node.db_manager.get_ledger(AUDIT_LEDGER_ID)
+    for seq in range(1, audit.size + 1):
+        fresh._on_catchup_txn(AUDIT_LEDGER_ID, audit.getBySeqNo(seq))
+    store = fresh.db_manager.get_store("state_ts")
+    live_store = node.db_manager.get_store("state_ts")
+    assert store.get_equal_or_prev(t_mid, DOMAIN_LEDGER_ID) == \
+        live_store.get_equal_or_prev(t_mid, DOMAIN_LEDGER_ID)
+
+
 def test_ts_store_tracks_committed_roots(pool):
     nodes, replies, timer = pool
     setup_taa(nodes, timer)
