@@ -45,7 +45,7 @@ from plenum_tpu.server.request_handlers import (
     GetNymHandler, GetTxnHandler, NodeHandler, NymHandler,
     decode_state_value, nym_to_state_key)
 from plenum_tpu.server.write_request_manager import (
-    ReadRequestManager, WriteRequestManager)
+    ActionRequestManager, ReadRequestManager, WriteRequestManager)
 from plenum_tpu.state.pruning_state import PruningState
 from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
@@ -152,6 +152,7 @@ class Node:
                                                      self.config)
         self.write_manager, self.read_manager = \
             NodeBootstrap.init_managers(self.db_manager, self.config)
+        self.action_manager = ActionRequestManager()
 
         # ---- genesis (skipped on restart: the persisted ledgers already
         # contain it) — must precede membership derivation, which reads
@@ -595,6 +596,9 @@ class Node:
         if self.read_manager.is_valid_type(request.txn_type):
             self._process_read(request, client_id)
             return
+        if self.action_manager.is_valid_type(request.txn_type):
+            self._process_action(request, client_id)
+            return
         self._process_write(request, client_id)
 
     def process_client_batch(self, msgs: List[Tuple[dict, str]]):
@@ -621,6 +625,9 @@ class Node:
                 continue
             if self.read_manager.is_valid_type(request.txn_type):
                 self._process_read(request, client_id)
+                continue
+            if self.action_manager.is_valid_type(request.txn_type):
+                self._process_action(request, client_id)
                 continue
             parsed.append((request, client_id))
         if not parsed:
@@ -675,6 +682,30 @@ class Node:
             reqId=request.reqId or 0))
         self.monitor.request_received(request.key)
         self.propagator.propagate(request, client_id)
+
+    def _process_action(self, request: Request, client_id: str):
+        """Authenticated action: validated + executed locally, no
+        consensus round (reference node.py:2085 process_action). Rides
+        the SAME authenticator registry as writes — actions are the
+        privileged requests that most need every registered policy."""
+        try:
+            self.action_manager.static_validation(request)
+            self.req_authenticator.authenticate(request)
+        except Exception as e:
+            self._reply_to_client(client_id, RequestNack(
+                identifier=request.identifier or "unknown",
+                reqId=request.reqId or 0, reason=str(e)))
+            return
+        self._reply_to_client(client_id, RequestAck(
+            identifier=request.identifier, reqId=request.reqId))
+        try:
+            self.action_manager.dynamic_validation(request)
+            result = self.action_manager.process_action(request)
+            self._reply_to_client(client_id, Reply(result=result))
+        except Exception as e:
+            self._reply_to_client(client_id, Reject(
+                identifier=request.identifier or "unknown",
+                reqId=request.reqId or 0, reason=str(e)))
 
     def _process_read(self, request: Request, client_id: str):
         try:

@@ -68,6 +68,23 @@ class ReadRequestHandler(RequestHandler):
     def get_result(self, request: Request) -> dict: ...
 
 
+class ActionRequestHandler(RequestHandler):
+    """Non-ledger actions: validated and executed locally, no consensus
+    (reference handler_interfaces/action_request_handler.py)."""
+
+    def __init__(self, database_manager: DatabaseManager, txn_type: str):
+        super().__init__(database_manager, txn_type, ledger_id=None)
+
+    def static_validation(self, request: Request):
+        pass
+
+    def dynamic_validation(self, request: Request):
+        pass
+
+    @abstractmethod
+    def process_action(self, request: Request) -> dict: ...
+
+
 # --------------------------------------------------------------- helpers
 
 def nym_to_state_key(nym: str) -> bytes:

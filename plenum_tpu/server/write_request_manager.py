@@ -195,6 +195,40 @@ class WriteRequestManager:
         return len(self._applied_batches)
 
 
+class ActionRequestManager:
+    """Actions bypass consensus: authenticated + validated, executed
+    locally on the receiving node, answered directly (reference
+    plenum/server/request_managers/action_request_manager.py —
+    downstream ledgers register concrete handlers like POOL_RESTART;
+    the framework ships the seam)."""
+
+    def __init__(self):
+        self.request_handlers: Dict[str, object] = {}
+
+    def register_action_handler(self, handler):
+        self.request_handlers[handler.txn_type] = handler
+
+    def is_valid_type(self, txn_type: str) -> bool:
+        return txn_type in self.request_handlers
+
+    def _handler(self, request: Request):
+        handler = self.request_handlers.get(request.txn_type)
+        if handler is None:
+            raise InvalidClientRequest(
+                request.identifier, request.reqId,
+                "unknown action type {}".format(request.txn_type))
+        return handler
+
+    def static_validation(self, request: Request):
+        self._handler(request).static_validation(request)
+
+    def dynamic_validation(self, request: Request):
+        self._handler(request).dynamic_validation(request)
+
+    def process_action(self, request: Request) -> dict:
+        return self._handler(request).process_action(request)
+
+
 class ReadRequestManager:
     def __init__(self):
         self.request_handlers: Dict[str, ReadRequestHandler] = {}

@@ -122,6 +122,85 @@ def test_txn_version_controller_defaults():
     tvc.update_version(txn)                          # base: no-op
 
 
+def test_action_requests_bypass_consensus(mock_timer):
+    """Action framework (reference action_request_manager.py): an
+    authenticated action validates, executes LOCALLY on the receiving
+    node (no ordering), and replies; failures Reject; bad signatures
+    Nack; the ledger never moves."""
+    from plenum_tpu.common.messages.node_messages import (
+        Reject, Reply, RequestAck, RequestNack)
+    from plenum_tpu.common.exceptions import UnauthorizedClientRequest
+    from plenum_tpu.server.request_handlers import ActionRequestHandler
+
+    mock_timer.set_time(SIM_EPOCH)
+    net = SimNetwork(mock_timer, DefaultSimRandom(83))
+    got = []
+    names4 = NAMES7[:4]
+    nodes = [Node(n, names4, mock_timer, net.create_peer(n),
+                  config=Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2,
+                                CHK_FREQ=5, LOG_SIZE=15),
+                  client_reply_handler=lambda c, m: got.append(m))
+             for n in names4]
+    node = nodes[0]
+    trustee = SimpleSigner(seed=bytes([160]) * 32)
+    node.authnr.addIdr(trustee.identifier, trustee.verkey)
+
+    class DemoRestart(ActionRequestHandler):
+        def __init__(self, dm):
+            super().__init__(dm, "demo_restart")
+            self.fired = []
+
+        def dynamic_validation(self, request):
+            if request.operation.get("when") == "never":
+                raise UnauthorizedClientRequest(
+                    request.identifier, request.reqId, "refused")
+
+        def process_action(self, request):
+            self.fired.append(request.operation.get("when"))
+            return {"identifier": request.identifier,
+                    "reqId": request.reqId, "scheduled": True}
+
+    handler = DemoRestart(node.db_manager)
+    node.action_manager.register_action_handler(handler)
+
+    def send(op, signer=trustee):
+        req = {"identifier": signer.identifier, "reqId": len(got) + 1,
+               "protocolVersion": 2, "operation": op}
+        req["signature"] = signer.sign(dict(req))
+        node.process_client_request(req, "cli")
+
+    send({"type": "demo_restart", "when": "now"})
+    assert handler.fired == ["now"]
+    assert any(isinstance(m, RequestAck) for m in got)
+    assert any(isinstance(m, Reply) and m.result.get("scheduled")
+               for m in got)
+    # BATCHED intake routes actions identically (the bench/e2e path)
+    got.clear()
+    req = {"identifier": trustee.identifier, "reqId": 50,
+           "protocolVersion": 2,
+           "operation": {"type": "demo_restart", "when": "batched"}}
+    req["signature"] = trustee.sign(dict(req))
+    node.process_client_batch([(req, "cli")])
+    assert handler.fired == ["now", "batched"]
+    assert any(isinstance(m, Reply) for m in got)
+    # no consensus round: nothing ordered anywhere
+    assert all(n.last_ordered[1] == 0 for n in nodes)
+    # validation failure -> Reject
+    got.clear()
+    send({"type": "demo_restart", "when": "never"})
+    assert handler.fired == ["now", "batched"]
+    assert any(isinstance(m, Reject) for m in got)
+    # bad signature -> Nack, never executed
+    got.clear()
+    req = {"identifier": trustee.identifier, "reqId": 99,
+           "protocolVersion": 2,
+           "operation": {"type": "demo_restart", "when": "later"}}
+    req["signature"] = "1" * 88
+    node.process_client_request(req, "cli")
+    assert any(isinstance(m, RequestNack) for m in got)
+    assert handler.fired == ["now", "batched"]
+
+
 def test_layered_config_loading(tdir):
     """Config.load: class defaults ← config file ← env ← overrides
     (reference plenum/common/config_util.py getConfig)."""
