@@ -3,6 +3,24 @@
 Reference: plenum/common/messages/node_messages.py:26-525 — message op names
 and field wire names are kept for parity (they are protocol facts, the
 "what"; the implementation around them is new).
+
+Deliberately dropped reference classes (superseded, not missing):
+
+- ``ViewChangeDone`` / ``CurrentState`` (node_messages.py:~500) — the
+  *legacy pre-2.0* view-change protocol. This framework implements only
+  the reference's own replacement (the "plenum 2.0" consensus used by
+  ``ReplicaService``): ``ViewChange`` / ``ViewChangeAck`` / ``NewView``
+  below, matching view_change_service.py. Carrying both protocols is
+  the dual-path legacy the reference itself was migrating off.
+- ``FutureViewChangeDone`` / ``ViewChangeStartMessage`` /
+  ``ViewChangeContinueMessage`` — internal shims of that same legacy
+  protocol (node restart mid-ViewChangeDone); our restart path recovers
+  via the audit ledger + catchup instead (server/node.py restart flow).
+- ``PoolLedgerTxns`` — legacy client push of pool txns; clients learn
+  the pool via catchup (LedgerStatus/CatchupReq on the client stack).
+- ``BlacklistMsg`` — defined but vestigial in the reference (blacklists
+  are node-local; nothing ever processes a received BlacklistMsg).
+  Suspicion accounting lives in server/blacklister.py.
 """
 from plenum_tpu.common.messages.fields import (
     AnyField, AnyMapField, AnyValueField, BatchIDField, BlsMultiSignatureField,

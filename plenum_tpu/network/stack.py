@@ -20,6 +20,20 @@ authenticate writes). Wire frames are 4-byte length-prefixed msgpack;
 outboxes coalesce per tick into Ed25519-signed BATCH envelopes; receive
 side is quota-bounded per service() call (backpressure for the
 single-threaded prod loop).
+
+Deliberately superseded reference components (not missing):
+
+- ``ClientMessageProvider`` (stp_zmq/client_message_provider.py:14), the
+  bounded retry deque for replies to disconnected clients. Client ids
+  here are per-connection, so a queued reply could never be re-routed to
+  a reconnect; instead the node re-serves committed Replies from its
+  payload-digest index when the client re-sends the request
+  (server/node.py `_committed_reply`) — the reference's own durable
+  recovery path, minus the lossy in-memory queue in front of it.
+- ``PortDispenser`` (stp_core/network/port_dispenser.py:11), the
+  file-locked port allocator for parallel test runs. Rung-3 tests bind
+  OS-assigned ports (``HA("127.0.0.1", 0)``, tests/test_network_stack.py)
+  and read the bound port back, which cannot collide by construction.
 """
 from __future__ import annotations
 
