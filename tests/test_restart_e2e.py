@@ -155,6 +155,60 @@ def test_restart_rebuilds_stale_state_store(durable_pool):
         .state.committedHeadHash == good_root
 
 
+def test_restart_across_view_change(durable_pool):
+    """The risky interaction the rung-2 suites cover separately, combined:
+    the view-0 PRIMARY crashes (stores persist), the survivors view-change
+    to view 1 and keep ordering, then the old primary restarts FROM DISK —
+    it must adopt the new view from the audit ledger during catchup, not
+    resume believing it is primary of view 0, and then participate in
+    view-1 ordering (reference: plenum/test/view_change/ +
+    node_catchup restart suites)."""
+    nodes, sinks, net, timer, base = durable_pool
+    clients = [SimpleSigner(seed=bytes([90 + i]) * 32) for i in range(2)]
+    for i, c in enumerate(clients):
+        submit_to_all(nodes, signed_nym_request(c, req_id=500 + i))
+    pump(timer, nodes, 8)
+    assert all(n.domain_ledger.size == 2 for n in nodes)
+
+    primary = next(n for n in nodes if n.replica.data.is_primary)
+    victim_name = primary.name
+    net.remove_peer(victim_name)
+    live = [n for n in nodes if n is not primary]
+    # the victim is never service()d again, so it can emit nothing; only
+    # its on-disk stores matter from here (crash semantics)
+    del primary
+
+    # survivors detect the disconnect, move to view 1, keep ordering
+    pump(timer, live, 20)
+    assert all(n.view_no == 1 for n in live)
+    late = SimpleSigner(seed=b"\x77" * 32)
+    for n in live:
+        n.process_client_request(
+            dict(signed_nym_request(late, req_id=510)), "late-client")
+    pump(timer, live, 8)
+    assert all(n.domain_ledger.size == 3 for n in live)
+
+    # restart the old primary from disk: recovers its view-0 history...
+    restarted = build_node(victim_name, net, timer, base, ClientSink())
+    assert restarted.domain_ledger.size == 2
+    assert restarted.view_no == 0
+
+    # ...then catches up, adopts view 1 from the audit trail, rejoins
+    all_nodes = live + [restarted]
+    restarted.start_catchup()
+    pump(timer, all_nodes, 20)
+    assert restarted.domain_ledger.size == 3
+    assert restarted.view_no == 1
+    assert not restarted.replica.data.is_primary
+
+    fresh = SimpleSigner(seed=b"\x78" * 32)
+    submit_to_all(all_nodes, signed_nym_request(fresh, req_id=520))
+    pump(timer, all_nodes, 10)
+    assert all(n.domain_ledger.size == 4 for n in all_nodes)
+    assert len({n.audit_ledger.root_hash for n in all_nodes}) == 1
+    assert len({n.domain_ledger.root_hash for n in all_nodes}) == 1
+
+
 def test_whole_pool_restart(durable_pool):
     """Every node stops and restarts from disk; the pool resumes
     ordering with no catchup needed (identical persisted histories)."""

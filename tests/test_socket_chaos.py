@@ -1,0 +1,117 @@
+"""Rung-3 connection-chaos fuzz: a 4-node pool over REAL localhost
+sockets keeps ordering while a seeded adversary repeatedly severs live
+TCP connections. The keep-in-touch loop (network/stack.py
+service_lifecycle) must re-dial, retransmission rides the reference's
+recovery ladder (client retry via committed-reply index + MessageReq
+self-heal), and every node must converge on identical roots.
+
+Reference analog: stp_zmq reconnect tests + plenum/test's pool
+disconnect/reconnect suites (zstack.py:651 connect retries).
+"""
+import asyncio
+import random
+
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.network.keys import NodeKeys
+from plenum_tpu.network.stack import HA, ClientConnection, RemoteInfo
+from plenum_tpu.server.networked_node import NetworkedNode
+
+from tests.test_node_e2e import signed_nym_request
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_pool_survives_connection_churn(seed):
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.1, CHK_FREQ=5,
+                  LOG_SIZE=15, HEARTBEAT_FREQ=1,
+                  # churn must not be mistaken for a dead primary
+                  ToleratePrimaryDisconnection=30, NEW_VIEW_TIMEOUT=30)
+    rng = random.Random(seed)
+    n_writes = 10
+
+    async def main():
+        keys = {n: NodeKeys(bytes([i + 90]) * 32)
+                for i, n in enumerate(NAMES)}
+        nodes = {}
+        registry = {}
+        for name in NAMES:
+            node = NetworkedNode(
+                name, {n: RemoteInfo(n, HA("127.0.0.1", 1),
+                                     keys[n].verkey_raw) for n in NAMES},
+                keys[name], HA("127.0.0.1", 0), HA("127.0.0.1", 0),
+                config=conf)
+            await node.start_async()
+            nodes[name] = node
+            registry[name] = RemoteInfo(name, node.nodestack.ha,
+                                        keys[name].verkey_raw)
+        for node in nodes.values():
+            for info in registry.values():
+                if info.name != node.name:
+                    node.nodestack.update_remote(info)
+        everyone = list(nodes.values())
+
+        async def pump(seconds, until=None):
+            end = asyncio.get_event_loop().time() + seconds
+            while asyncio.get_event_loop().time() < end:
+                for n in everyone:
+                    await n.prod()
+                if until is not None and until():
+                    return True
+                await asyncio.sleep(0.01)
+            return until() if until is not None else True
+
+        assert await pump(10, until=lambda: all(
+            len(n.nodestack.connecteds) == 3 for n in everyone))
+
+        client = ClientConnection(nodes["Beta"].clientstack.ha,
+                                  expected_verkey=keys["Beta"].verkey_raw)
+        await client.connect()
+        signer = SimpleSigner(seed=b"\x51" * 32)
+
+        def write(req_id):
+            dest = SimpleSigner(seed=req_id.to_bytes(32, "big"))
+            client.send(signed_nym_request(signer, dest_signer=dest,
+                                           req_id=req_id))
+
+        def sever_random_links():
+            """Cut 1-2 random live outgoing connections (not Beta's
+            client link): the dialer's lifecycle loop must re-establish
+            them with backoff."""
+            victims = rng.sample(NAMES, rng.choice([1, 2]))
+            for vname in victims:
+                remotes = list(nodes[vname].nodestack.remotes.values())
+                live = [r for r in remotes if r.is_connected]
+                if live:
+                    rng.choice(live).disconnect()
+
+        sent = 0
+        for round_no in range(n_writes):
+            write(round_no + 1)
+            sent += 1
+            sever_random_links()
+            await pump(rng.uniform(0.1, 0.4))
+
+        # all writes order everywhere despite the churn
+        assert await pump(60, until=lambda: all(
+            n.node.domain_ledger.size == sent for n in everyone)), \
+            {n.name: n.node.domain_ledger.size for n in everyone}
+        assert len({str(n.node.domain_ledger.root_hash)
+                    for n in everyone}) == 1
+        assert len({str(n.node.audit_ledger.root_hash)
+                    for n in everyone}) == 1
+        # no spurious view change: churn stayed below the tolerance
+        assert all(n.node.view_no == 0 for n in everyone)
+        # links healed
+        assert await pump(10, until=lambda: all(
+            len(n.nodestack.connecteds) == 3 for n in everyone))
+
+        client.close()
+        for n in everyone:
+            await n.nodestack.stop()
+            await n.clientstack.stop()
+
+    asyncio.run(main())
