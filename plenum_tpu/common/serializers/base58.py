@@ -27,7 +27,26 @@ def _enc10(r: int) -> str:
     return got
 
 
+# hash-sized payloads repeat heavily (audit-path nodes shared by every
+# Reply in a batch; roots re-encoded per peer), so memoize those
+_ENC32 = {}
+
+
 def b58encode(data: bytes) -> str:
+    if type(data) is bytes and len(data) == 32:
+        got = _ENC32.get(data)
+        if got is not None:
+            return got
+        out = _b58encode_raw(data)
+        if len(_ENC32) >= 1 << 16:
+            for stale in list(_ENC32)[:1 << 15]:
+                del _ENC32[stale]
+        _ENC32[data] = out
+        return out
+    return _b58encode_raw(data)
+
+
+def _b58encode_raw(data: bytes) -> str:
     n = int.from_bytes(data, 'big')
     blocks = []
     while n >= _B58_10:

@@ -27,10 +27,16 @@ class Serializer(ABC):
 def _sort_deep(data: Any) -> Any:
     """Recursively order dict keys (incl. inside lists/tuples) so msgpack
     output is bit-identical regardless of insertion order — consensus
-    digests and merkle roots depend on it."""
-    if isinstance(data, dict):
-        return {k: _sort_deep(data[k]) for k in sorted(data.keys())}
-    if isinstance(data, (list, tuple)):
+    digests and merkle roots depend on it. exact-type checks + scalar
+    fast path: this runs on every wire/ledger serialization."""
+    t = type(data)
+    if t is dict:
+        return {k: _sort_deep(data[k]) for k in sorted(data)}
+    if t is list or t is tuple:
+        return [_sort_deep(v) for v in data]
+    if isinstance(data, dict):  # dict subclass (e.g. MessageBase views)
+        return {k: _sort_deep(data[k]) for k in sorted(data)}
+    if isinstance(data, (list, tuple)):  # list/tuple subclass (NamedTuple)
         return [_sort_deep(v) for v in data]
     return data
 

@@ -179,6 +179,15 @@ class CoalescingVerifierHub:
         gen.items.extend(items)
         return _HubPending(self, gen, lo, len(gen.items))
 
+    def flush(self) -> None:
+        """Close the current generation and START its (async) device
+        launch now, instead of waiting for the first collect. Callers
+        that know a coalescing window just ended (all co-resident nodes
+        dispatched their chunk) use this to overlap the device round
+        trip with the consensus work that follows; pending handles
+        already issued for this generation stay valid."""
+        self._flush(self._gen)
+
     def _flush(self, gen: _HubGeneration) -> None:
         if gen.pending is not None:
             return

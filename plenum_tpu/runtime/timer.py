@@ -5,11 +5,10 @@ Reference: plenum/common/timer.py:13 (TimerService), :27 (QueueTimer),
 MockTimer (plenum_tpu/testing/mock_timer.py) makes the whole consensus layer
 deterministically testable with no real time or sockets (SURVEY.md §4 rung 2).
 """
+import heapq
 import time
 from abc import ABC, abstractmethod
-from typing import Callable, NamedTuple
-
-from sortedcontainers import SortedList
+from typing import Callable
 
 
 class TimerService(ABC):
@@ -26,49 +25,81 @@ class TimerService(ABC):
         """Cancel all scheduled occurrences of callback."""
 
 
-class TimerEvent(NamedTuple):
-    # ordering is always via SortedList's explicit timestamp key — never
-    # compare TimerEvents directly (callbacks aren't orderable)
-    timestamp: float
-    callback: Callable
-
-
 class QueueTimer(TimerService):
     """Production timer: events fire from `service()` which the owning loop
-    calls every prod tick (reference plenum/common/timer.py:27)."""
+    calls every prod tick (reference plenum/common/timer.py:27).
+
+    Heap entries are ``[timestamp, seq, callback]``: the seq breaks ties so
+    equal-timestamp events fire FIFO and callbacks are never compared.
+    cancel() tombstones entries in place (callback → None); peeks/pops skip
+    tombstones lazily, keeping every operation O(log n) on the timer-driven
+    hot loop (this is the single clock under all consensus services)."""
 
     def __init__(self, get_current_time: Callable[[], float] = time.perf_counter):
         self._get_current_time = get_current_time
-        self._events = SortedList(key=lambda ev: ev.timestamp)
+        self._heap = []
+        self._seq = 0
+        self._live = 0
 
     def queue_size(self) -> int:
-        return len(self._events)
+        return self._live
 
     def get_current_time(self) -> float:
         return self._get_current_time()
 
     def schedule(self, delay: float, callback: Callable) -> None:
-        self._events.add(TimerEvent(timestamp=self.get_current_time() + delay,
-                                    callback=callback))
+        self._seq += 1
+        heapq.heappush(self._heap,
+                       [self.get_current_time() + delay, self._seq, callback])
+        self._live += 1
 
     def cancel(self, callback: Callable) -> None:
-        for ev in [ev for ev in self._events if ev.callback == callback]:
-            self._events.remove(ev)
+        for entry in self._heap:
+            if entry[2] == callback:
+                entry[2] = None
+                self._live -= 1
+        # schedule/cancel churn (watchdogs rescheduled per message) can
+        # leave long-delay tombstones resident for their full horizon;
+        # compact when they outnumber live entries so cancel() scans and
+        # heap pushes stay proportional to real load
+        if len(self._heap) > 2 * self._live + 8:
+            self._heap = [e for e in self._heap if e[2] is not None]
+            heapq.heapify(self._heap)
+
+    def _peek(self):
+        """Next live entry ([timestamp, seq, callback]) or None."""
+        heap = self._heap
+        while heap and heap[0][2] is None:
+            heapq.heappop(heap)
+        return heap[0] if heap else None
+
+    def _pop(self):
+        """Remove and return the next live entry, or None."""
+        entry = self._peek()
+        if entry is not None:
+            heapq.heappop(self._heap)
+            self._live -= 1
+        return entry
 
     def service(self) -> int:
         """Fire all due events; returns count fired."""
         count = 0
         now = self.get_current_time()
-        while self._events and self._events[0].timestamp <= now:
-            ev = self._events.pop(0)
-            ev.callback()
+        while True:
+            entry = self._peek()
+            if entry is None or entry[0] > now:
+                break
+            heapq.heappop(self._heap)
+            self._live -= 1
+            entry[2]()
             count += 1
         return count
 
     def next_wakeup_in(self):
-        if not self._events:
+        entry = self._peek()
+        if entry is None:
             return None
-        return max(0.0, self._events[0].timestamp - self.get_current_time())
+        return max(0.0, entry[0] - self.get_current_time())
 
 
 class RepeatingTimer:

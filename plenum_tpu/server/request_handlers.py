@@ -111,6 +111,10 @@ class NymHandler(WriteRequestHandler):
 
     def __init__(self, database_manager: DatabaseManager):
         super().__init__(database_manager, NYM, DOMAIN_LEDGER_ID)
+        # (head_root, state_key) → raw state value, carried from
+        # dynamic_validation to the immediately following update_state so
+        # the hot apply path walks the trie once per request, not twice
+        self._lookup_memo = None
 
     def static_validation(self, request: Request):
         op = request.operation
@@ -125,8 +129,10 @@ class NymHandler(WriteRequestHandler):
 
     def dynamic_validation(self, request: Request, req_pp_time=None):
         op = request.operation
-        existing, _, _ = decode_state_value(self.state.get(
-            nym_to_state_key(op[TARGET_NYM]), isCommitted=False))
+        key = nym_to_state_key(op[TARGET_NYM])
+        raw = self.state.get(key, isCommitted=False)
+        self._lookup_memo = (self.state.headHash, key, raw)
+        existing, _, _ = decode_state_value(raw)
         is_creation = existing is None
         if is_creation:
             # new nym with a privileged role needs a privileged author
@@ -162,8 +168,14 @@ class NymHandler(WriteRequestHandler):
                      is_committed: bool = False):
         data = get_payload_data(txn)
         nym = data[TARGET_NYM]
-        existing, _, _ = decode_state_value(
-            self.state.get(nym_to_state_key(nym), isCommitted=False))
+        key = nym_to_state_key(nym)
+        memo = self._lookup_memo
+        if memo is not None and memo[1] == key and \
+                memo[0] == self.state.headHash:
+            raw = memo[2]
+        else:
+            raw = self.state.get(key, isCommitted=False)
+        existing, _, _ = decode_state_value(raw)
         value = dict(existing or {})
         value["identifier"] = get_from(txn)
         if ROLE in data:

@@ -61,56 +61,61 @@ def _len_prefix(length: int, offset: int) -> bytes:
 
 
 def decode(data: bytes) -> RlpItem:
-    item, rest = _decode_one(bytes(data))
-    if rest:
+    data = bytes(data)
+    item, pos = _decode_at(data, 0, len(data))
+    if pos != len(data):
         raise ValueError("trailing RLP bytes")
     return item
 
 
-def _decode_one(data: bytes) -> Tuple[RlpItem, bytes]:
-    if not data:
+def _decode_at(data: bytes, pos: int, end: int) -> Tuple[RlpItem, int]:
+    """Decode one item at offset `pos`, bounded by `end`; returns
+    (item, next_pos). Offset-based so only final payloads are sliced —
+    the old remainder-slicing decoder copied O(n²) bytes on branch
+    nodes (this is the hottest path in the MPT)."""
+    if pos >= end:
         raise ValueError("empty RLP")
-    b0 = data[0]
+    b0 = data[pos]
     if b0 < 0x80:
-        return data[:1], data[1:]
+        return data[pos:pos + 1], pos + 1
     if b0 < 0xB8:  # short string
         n = b0 - 0x80
-        _check(data, 1 + n)
-        if n == 1 and data[1] < 0x80:
+        nxt = pos + 1 + n
+        if nxt > end:
+            raise ValueError("truncated RLP")
+        if n == 1 and data[pos + 1] < 0x80:
             raise ValueError("non-canonical RLP single byte")
-        return data[1:1 + n], data[1 + n:]
+        return data[pos + 1:nxt], nxt
     if b0 < 0xC0:  # long string
-        ln = b0 - 0xB7
-        n = _read_len(data, ln, 56)
-        return data[1 + ln:1 + ln + n], data[1 + ln + n:]
+        body, nxt = _read_len_at(data, pos, b0 - 0xB7, 56, end)
+        return data[body:nxt], nxt
     if b0 < 0xF8:  # short list
         n = b0 - 0xC0
-        _check(data, 1 + n)
-        return _decode_list(data[1:1 + n]), data[1 + n:]
-    ln = b0 - 0xF7  # long list
-    n = _read_len(data, ln, 56)
-    return _decode_list(data[1 + ln:1 + ln + n]), data[1 + ln + n:]
+        nxt = pos + 1 + n
+        if nxt > end:
+            raise ValueError("truncated RLP")
+        body = pos + 1
+    else:  # long list
+        body, nxt = _read_len_at(data, pos, b0 - 0xF7, 56, end)
+    out = []
+    p = body
+    while p < nxt:
+        item, p = _decode_at(data, p, nxt)
+        out.append(item)
+    return out, nxt
 
 
-def _read_len(data: bytes, ln: int, minimum: int) -> int:
-    _check(data, 1 + ln)
-    if data[1] == 0:
+def _read_len_at(data: bytes, pos: int, ln: int, minimum: int,
+                 end: int) -> Tuple[int, int]:
+    """→ (payload_start, payload_end) for a long-form item at pos."""
+    if pos + 1 + ln > end:
+        raise ValueError("truncated RLP")
+    if data[pos + 1] == 0:
         raise ValueError("leading zero in RLP length")
-    n = int.from_bytes(data[1:1 + ln], "big")
+    n = int.from_bytes(data[pos + 1:pos + 1 + ln], "big")
     if n < minimum:
         raise ValueError("non-canonical RLP length")
-    _check(data, 1 + ln + n)
-    return n
-
-
-def _decode_list(body: bytes) -> List[RlpItem]:
-    out = []
-    while body:
-        item, body = _decode_one(body)
-        out.append(item)
-    return out
-
-
-def _check(data: bytes, need: int):
-    if len(data) < need:
+    start = pos + 1 + ln
+    if start + n > end:
         raise ValueError("truncated RLP")
+    return start, start + n

@@ -61,7 +61,9 @@ def hp_decode(data: bytes) -> Tuple[List[int], bool]:
 
 
 class Trie:
-    _DECODE_CACHE_MAX = 4096
+    # ~1-1.5KB per decoded branch node → tens of MB per trie at the cap;
+    # large enough to hold a full batch's spine working set
+    _DECODE_CACHE_MAX = 1 << 16
 
     def __init__(self, store, root_hash: Optional[bytes] = None):
         """store: KeyValueStorage-like (get/put raising KeyError on miss)."""
@@ -88,9 +90,7 @@ class Trie:
                 except KeyError:
                     raise KeyError("missing trie node {}".format(ref.hex()))
                 cached = rlp.decode(raw)
-                if len(self._decoded) >= self._DECODE_CACHE_MAX:
-                    self._decoded.clear()
-                self._decoded[ref] = cached
+                self._cache_decoded(ref, cached)
             # shallow copy: _update/_delete overwrite node slots in place
             return list(cached) if isinstance(cached, list) else cached
         return rlp.decode(ref)
@@ -104,7 +104,20 @@ class Trie:
             return node
         h = sha3(encoded)
         self._store.put(h, encoded)
+        # seed the decode cache: the next walk will load this node right
+        # back (freshly written spine nodes ARE the hot set). Shallow
+        # copy — callers overwrite slots of the list they passed in.
+        self._cache_decoded(h, list(node))
         return h
+
+    def _cache_decoded(self, ref: bytes, node) -> None:
+        """Insert into the decode cache, evicting the older half at the
+        cap (dicts iterate in insertion order) so neither the load nor
+        the persist path can grow it unbounded."""
+        if len(self._decoded) >= self._DECODE_CACHE_MAX:
+            for stale in list(self._decoded)[:self._DECODE_CACHE_MAX // 2]:
+                del self._decoded[stale]
+        self._decoded[ref] = node
 
     def _root_node(self):
         if self.root_hash == BLANK_ROOT:

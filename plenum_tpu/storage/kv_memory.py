@@ -1,14 +1,18 @@
-"""In-memory KV store (reference: storage/kv_in_memory.py)."""
-from typing import Iterable, Tuple
+"""In-memory KV store (reference: storage/kv_in_memory.py).
 
-from sortedcontainers import SortedDict
+Backed by a plain dict: put/get ride the per-trie-node hot path (every
+MPT spine persist lands here), so writes must be O(1) C-dict ops.
+Ordered range scans are only needed by catchup/recovery iterators, so
+keys are sorted lazily per iterator() call instead of on every put.
+"""
+from typing import Iterable, Tuple
 
 from plenum_tpu.storage.kv_store import KeyValueStorage, to_bytes
 
 
 class KeyValueStorageInMemory(KeyValueStorage):
     def __init__(self, *args, **kwargs):
-        self._dict = SortedDict()
+        self._dict = {}
         self._closed = False
 
     def put(self, key, value):
@@ -36,10 +40,12 @@ class KeyValueStorageInMemory(KeyValueStorage):
     def iterator(self, start=None, end=None, include_value=True):
         start = to_bytes(start) if start is not None else None
         end = to_bytes(end) if end is not None else None
-        keys = self._dict.irange(minimum=start, maximum=end)
+        keys = sorted(k for k in self._dict
+                      if (start is None or k >= start)
+                      and (end is None or k <= end))
         if include_value:
             return ((k, self._dict[k]) for k in keys)
-        return iter(list(keys))
+        return iter(keys)
 
     def drop(self):
         self._dict.clear()

@@ -14,10 +14,13 @@ class MockTimer(QueueTimer):
     def set_time(self, value: float):
         """Advance to `value`, firing every due event in timestamp order.
         Events scheduled while firing are honored if they fall before value."""
-        while self._events and self._events[0].timestamp <= value:
-            ev = self._events.pop(0)
-            self._current_time = max(self._current_time, ev.timestamp)
-            ev.callback()
+        while True:
+            entry = self._peek()
+            if entry is None or entry[0] > value:
+                break
+            self._pop()
+            self._current_time = max(self._current_time, entry[0])
+            entry[2]()
         self._current_time = max(self._current_time, value)
 
     def sleep(self, seconds: float):
@@ -25,13 +28,16 @@ class MockTimer(QueueTimer):
 
     def advance(self):
         """Fire just the next scheduled event (if any)."""
-        if self._events:
-            ev = self._events.pop(0)
-            self._current_time = max(self._current_time, ev.timestamp)
-            ev.callback()
+        entry = self._pop()
+        if entry is not None:
+            self._current_time = max(self._current_time, entry[0])
+            entry[2]()
 
     def advance_until(self, value: float):
-        while self._events and self._events[0].timestamp <= value:
+        while True:
+            entry = self._peek()
+            if entry is None or entry[0] > value:
+                break
             self.advance()
 
     def run_for(self, seconds: float):
@@ -45,11 +51,12 @@ class MockTimer(QueueTimer):
         for _ in range(max_iterations):
             if condition():
                 return
-            if not self._events:
+            entry = self._peek()
+            if entry is None:
                 raise TimeoutError(
                     "Condition not reached and no more timer events at t={}"
                     .format(self._current_time))
-            if deadline is not None and self._events[0].timestamp > deadline:
+            if deadline is not None and entry[0] > deadline:
                 raise TimeoutError(
                     "Condition not reached before t={}".format(deadline))
             self.advance()
