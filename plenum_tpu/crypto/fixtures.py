@@ -10,20 +10,22 @@ def make_signed_batch(count: int, seed: int = 0, unique: int = None,
                       msg_prefix: bytes = b"fixture"
                       ) -> Tuple[List[bytes], List[bytes], List[bytes]]:
     """→ (msgs, sigs, verkeys), `unique` distinct keypairs tiled to
-    `count` entries (signing is pure-Python; tiling keeps fixture
-    generation cheap while device work is identical per entry)."""
-    from plenum_tpu.crypto import ed25519 as ed
+    `count` entries. Keygen+signing ride OpenSSL when available (RFC
+    8032 Ed25519 is deterministic, so outputs are bit-identical to the
+    pure-Python reference path) — at count=10k+ the pure-Python path
+    costs minutes, the OpenSSL one milliseconds."""
+    from plenum_tpu.crypto.signer import SimpleSigner
 
     unique = min(count, unique or count)
     rng = np.random.RandomState(seed)
     msgs, sigs, vks = [], [], []
     for i in range(unique):
         kseed = bytes(rng.randint(0, 256, 32, dtype=np.uint8))
-        vk, _ = ed.keypair_from_seed(kseed)
+        signer = SimpleSigner(seed=kseed)   # OpenSSL path w/ py fallback
         msg = msg_prefix + b"-%d" % i
         msgs.append(msg)
-        sigs.append(ed.sign(msg, kseed))
-        vks.append(vk)
+        sigs.append(signer.sign_bytes(msg))
+        vks.append(signer.verraw)
     reps = (count + unique - 1) // unique
     return ((msgs * reps)[:count], (sigs * reps)[:count],
             (vks * reps)[:count])

@@ -48,10 +48,17 @@ class SimpleSigner(Signer):
         self.seed = seed or os.urandom(32)
         if len(self.seed) != 32:
             raise ValueError("seed must be 32 bytes")
-        self.verraw, self._sk = ed25519.keypair_from_seed(self.seed)
-        self.verstr = b58encode(self.verraw)
         self._ossl = (_OsslSk.from_private_bytes(self.seed)
                       if _OsslSk is not None else None)
+        if self._ossl is not None:
+            # same derivation as the pure-Python path, ~100x faster
+            from cryptography.hazmat.primitives.serialization import (
+                Encoding, PublicFormat)
+            self.verraw = self._ossl.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw)
+        else:                                          # pragma: no cover
+            self.verraw, _ = ed25519.keypair_from_seed(self.seed)
+        self.verstr = b58encode(self.verraw)
 
     @property
     def identifier(self) -> str:

@@ -5,3 +5,20 @@ verification, BLS12-381 aggregation — are expressed as pure batched JAX
 functions in this package, dispatched from the host-side consensus loop
 behind pluggable provider seams (SURVEY.md §2.9).
 """
+import os
+
+
+def enable_persistent_compilation_cache(path: str = None) -> str:
+    """Point XLA's persistent compilation cache at `path` (default:
+    <repo>/.jax_cache). The big verify buckets take 30-110s to compile;
+    with the cache, every process after the first loads them in
+    milliseconds. Must use jax.config (the JAX_COMPILATION_CACHE_DIR
+    env var alone does not activate the cache on all backends)."""
+    import jax
+    if path is None:
+        path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path

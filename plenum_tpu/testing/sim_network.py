@@ -5,6 +5,7 @@ Reference: plenum/test/simulation/sim_network.py:98 (SimNetwork),
 :14-40 (Discard/Deliver/Stash processors). Seeded by DefaultSimRandom so
 partition/latency fuzzing of view change + ordering is replayable.
 """
+import heapq
 import logging
 from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
@@ -81,6 +82,19 @@ class SimNetwork:
         self._down: set = set()
         self.processors: List[Processor] = []
         self.sent_count = 0
+        # in-flight messages keyed by absolute deadline; ONE timer event
+        # (the pump) drains everything due instead of one closure+event
+        # per message — at n nodes each request generates O(n^2) sends
+        # and the per-event cost dominated the 25-node sim. Latency
+        # draws and delivery times are unchanged, so seeded runs are
+        # bit-identical.
+        self._pending: List = []         # [deadline, seq, PendingMessage]
+        self._seq = 0
+        # generation-tagged arming: exactly one LIVE pump; superseded
+        # ones return immediately (re-arming blindly made every stale
+        # pump spawn another — an event storm at 25 nodes)
+        self._pump_gen = 0
+        self._pump_deadline: Optional[float] = None
 
     def create_peer(self, name: str, send_handler=None) -> ExternalBus:
         """send_handler overrides the simulated transport for this peer
@@ -154,19 +168,48 @@ class SimNetwork:
                     continue
                 self.sent_count += 1
                 msg = PendingMessage(message, frm, d)
-                if any(p.process(msg) for p in self.processors):
+                if self.processors and any(p.process(msg)
+                                           for p in self.processors):
                     continue
                 self._schedule_delivery(msg)
         return handle
 
     def _schedule_delivery(self, msg: PendingMessage):
         delay = self._random.float(self._min_latency, self._max_latency)
-        def deliver():
-            bus = self._buses.get(msg.dst)
-            if bus is None or msg.dst in self._down or msg.frm in self._down:
-                return
-            payload = msg.message
-            if self._serde is not None:
-                payload = self._serde(payload)
-            bus.process_incoming(payload, msg.frm)
-        self._timer.schedule(delay, deliver)
+        deadline = self._timer.get_current_time() + delay
+        self._seq += 1
+        heapq.heappush(self._pending, (deadline, self._seq, msg))
+        if self._pump_deadline is None or deadline < self._pump_deadline:
+            self._arm(deadline)
+
+    def _arm(self, deadline: float):
+        self._pump_gen += 1
+        gen = self._pump_gen
+        self._pump_deadline = deadline
+        delay = max(0.0, deadline - self._timer.get_current_time())
+        self._timer.schedule(delay, lambda: self._pump(gen))
+
+    def _pump(self, gen: int):
+        """Deliver every due in-flight message, then re-arm for the next
+        deadline. Only the latest-armed pump runs; superseded ones are
+        no-ops."""
+        if gen != self._pump_gen:
+            return
+        self._pump_deadline = None
+        now = self._timer.get_current_time()
+        pending = self._pending
+        while pending and pending[0][0] <= now:
+            _, _, msg = heapq.heappop(pending)
+            self._deliver(msg)
+        if pending and (self._pump_deadline is None
+                        or pending[0][0] < self._pump_deadline):
+            self._arm(pending[0][0])
+
+    def _deliver(self, msg: PendingMessage):
+        bus = self._buses.get(msg.dst)
+        if bus is None or msg.dst in self._down or msg.frm in self._down:
+            return
+        payload = msg.message
+        if self._serde is not None:
+            payload = self._serde(payload)
+        bus.process_incoming(payload, msg.frm)
