@@ -113,6 +113,37 @@ def drain_chunk(nodes, timer, chunk, client_id="bench-client",
             break
 
 
+def pipelined_intake(nodes, timer, chunks, client_id, deadline=None,
+                     per_chunk=None):
+    """Shared pipelined intake loop (headline + pool25 configs):
+    dispatch + flush chunk i's fused verification launch, pump chunk
+    i-1's consensus rounds UNDER that launch, then harvest and inject.
+    `per_chunk` (if given) runs between flush and pump — pool25 serves
+    its read traffic there. Returns the injected-request count."""
+    hub = nodes[0].authnr._verifier
+    injected = 0
+    for chunk in chunks:
+        if deadline is not None and time.perf_counter() > deadline:
+            break
+        handles = [n.dispatch_client_batch(
+            [(dict(r), client_id) for r in chunk]) for n in nodes] \
+            if chunk else None
+        if hasattr(hub, "flush"):
+            hub.flush()
+        if per_chunk is not None:
+            per_chunk()
+        if injected:
+            drain_chunk(nodes, timer, None, target_size=injected,
+                        deadline=deadline)
+        if handles:
+            for n, h in zip(nodes, handles):
+                n.conclude_client_batch(h)
+            injected += len(chunk)
+    drain_chunk(nodes, timer, None, target_size=injected,
+                deadline=deadline)
+    return injected
+
+
 def run_pool(reqs, verifier_name):
     """→ (elapsed_wall_seconds, ordered_count) for ordering all reqs.
 
@@ -128,22 +159,7 @@ def run_pool(reqs, verifier_name):
     t0 = time.perf_counter()
     chunks = [reqs[i:i + CLIENT_BATCH]
               for i in range(0, target, CLIENT_BATCH)]
-    hub = nodes[0].authnr._verifier
-    injected = 0            # reqs concluded + injected into the replicas
-    for chunk in chunks:
-        # 1. dispatch + flush: chunk i's fused launch starts on-device
-        handles = [n.dispatch_client_batch(
-            [(dict(r), "bench-client") for r in chunk]) for n in nodes]
-        if hasattr(hub, "flush"):
-            hub.flush()
-        # 2. pump chunk i-1's consensus rounds — overlaps launch i
-        if injected:
-            drain_chunk(nodes, timer, None, target_size=injected)
-        # 3. harvest launch i (result is ready or nearly so) + inject
-        for n, h in zip(nodes, handles):
-            n.conclude_client_batch(h)
-        injected += len(chunk)
-    drain_chunk(nodes, timer, None, target_size=injected)
+    pipelined_intake(nodes, timer, chunks, client_id="bench-client")
     # drain to completion
     deadline = time.perf_counter() + 300
     while time.perf_counter() < deadline:
@@ -281,37 +297,20 @@ def pool25_backlog():
 
     t0 = time.perf_counter()
     deadline = t0 + wall_budget
-    wi = ri = 0
-    injected = 0
     primary = nodes[0]
-    hub = nodes[0].authnr._verifier
-    while time.perf_counter() < deadline and (wi < len(writes)
-                                              or ri < len(reads)):
-        # pipelined intake, same shape as the headline config: dispatch
-        # + flush chunk i, pump chunk i-1's consensus under its launch,
-        # then harvest
-        chunk = writes[wi:wi + batch]
-        wi += len(chunk)
-        handles = [n.dispatch_client_batch(
-            [(dict(r), "p25") for r in chunk]) for n in nodes] \
-            if chunk else None
-        if hasattr(hub, "flush"):
-            hub.flush()
+    ri_state = [0]
+
+    def serve_reads():
         # reads answer from any single node, no consensus round
-        rchunk = reads[ri:ri + batch // read_every]
-        ri += len(rchunk)
+        rchunk = reads[ri_state[0]:ri_state[0] + batch // read_every]
+        ri_state[0] += len(rchunk)
         for r in rchunk:
             primary.process_client_request(dict(r), "p25-read")
             reads_served[0] += 1
-        if injected:
-            drain_chunk(nodes, timer, None, target_size=injected,
-                        deadline=deadline)
-        if handles:
-            for n, h in zip(nodes, handles):
-                n.conclude_client_batch(h)
-            injected = wi
-    drain_chunk(nodes, timer, None, target_size=injected,
-                deadline=deadline)
+
+    wchunks = [writes[i:i + batch] for i in range(0, len(writes), batch)]
+    pipelined_intake(nodes, timer, wchunks, client_id="p25",
+                     deadline=deadline, per_chunk=serve_reads)
     elapsed = time.perf_counter() - t0
     ordered = min(nd.domain_ledger.size for nd in nodes)
     return {
@@ -322,7 +321,7 @@ def pool25_backlog():
         "reads_served": reads_served[0],
         "write_req_per_s": round(ordered / elapsed, 1),
         "mixed_req_per_s": round((ordered + reads_served[0]) / elapsed, 1),
-        "drained": wi >= len(writes) and ordered >= len(writes),
+        "drained": ordered >= len(writes),
     }
 
 

@@ -17,6 +17,25 @@ from plenum_tpu.consensus.quorums import Quorums
 logger = logging.getLogger(__name__)
 
 
+def _strict_deep_eq(a, b) -> bool:
+    """Deep equality that also requires identical types at every node —
+    digest-faithful for the canonical serializers (which encode True,
+    1, and 1.0 differently while Python `==` conflates them)."""
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, dict):
+        if len(a) != len(b):
+            return False
+        for k, v in a.items():
+            if k not in b or not _strict_deep_eq(v, b[k]):
+                return False
+        return True
+    if isinstance(a, (list, tuple)):
+        return len(a) == len(b) and all(
+            _strict_deep_eq(x, y) for x, y in zip(a, b))
+    return a == b
+
+
 class ReqState:
     def __init__(self, request: Request):
         self.request = request
@@ -55,7 +74,12 @@ class Requests(dict):
 
     def lookup_payload(self, payload: dict) -> Optional[Request]:
         """Cheap pre-digest lookup: the stored Request if `payload` is
-        bit-for-bit the request we already hold, else None."""
+        bit-for-bit the request we already hold, else None. Equality is
+        TYPE-STRICT deep comparison — the digest's canonical
+        serialization distinguishes True/1/1.0, so plain dict equality
+        (which conflates them) would let a byzantine re-gossip count as
+        a vote for the original digest; any mismatch falls back to the
+        full digest path."""
         digest = self._by_ref.get((payload.get("identifier"),
                                    payload.get("reqId")))
         if digest is None:
@@ -65,7 +89,7 @@ class Requests(dict):
             return None
         if state.payload is None:
             state.payload = state.request.as_dict()
-        if state.payload == payload:
+        if _strict_deep_eq(state.payload, payload):
             return state.request
         return None
 
