@@ -37,7 +37,7 @@ class ReplicaService:
                  bls_bft_replica=None,
                  internal_bus: Optional[InternalBus] = None,
                  checkpoint_digest_source: Optional[Callable] = None,
-                 freshness_checker=None):
+                 freshness_checker=None, vc_vote_store=None):
         self.name = name
         self.config = config or Config()
         self.internal_bus = internal_bus or InternalBus()
@@ -75,7 +75,8 @@ class ReplicaService:
                 primaries_selector=self.selector)
             self.vc_trigger = ViewChangeTriggerService(
                 data=self._data, timer=timer, bus=self.internal_bus,
-                network=network, config=self.config)
+                network=network, config=self.config,
+                vote_store=vc_vote_store)
             from plenum_tpu.consensus.monitoring import (
                 ForcedViewChangeService, FreshnessMonitorService)
             self.freshness_monitor = FreshnessMonitorService(

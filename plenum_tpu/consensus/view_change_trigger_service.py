@@ -25,16 +25,63 @@ GENERIC_SUSPICION_CODE = 25
 
 
 class InstanceChangeCache:
-    """view_no -> voter -> vote timestamp, with TTL expiry."""
+    """view_no -> voter -> vote timestamp, with TTL expiry.
 
-    def __init__(self, timer: TimerService, ttl: float):
+    Optionally persisted to a KV store (reference
+    instance_change_provider.py backs its cache with nodeStatusDB): a
+    node that restarts mid-vote-collection neither forgets peers' still-
+    fresh votes nor re-counts expired ones — the TTL applies to the
+    reloaded timestamps unchanged. Timestamps must come from a
+    WALL-CLOCK timer (time.time, or a MockTimer pinned to an epoch) for
+    persistence to be meaningful across restarts; reloaded votes whose
+    age is negative (a process-relative clock like perf_counter, or a
+    clock jump) are dropped rather than trusted forever."""
+
+    _KEY = b"instance_change_votes"
+
+    def __init__(self, timer: TimerService, ttl: float, store=None):
         self._timer = timer
         self._ttl = ttl
+        self._store = store
         self._votes: Dict[int, Dict[str, float]] = {}
+        if store is not None:
+            try:
+                import json
+                raw = store.get(self._KEY)
+                now = timer.get_current_time()
+                for v, voters in json.loads(bytes(raw).decode()).items():
+                    fresh = {voter: ts for voter, ts in voters.items()
+                             if 0 <= now - ts <= ttl}
+                    if fresh:
+                        self._votes[int(v)] = fresh
+            except KeyError:
+                pass
+            except Exception:
+                logger.exception("corrupt instance-change vote cache; "
+                                 "starting empty")
+
+    def _save(self):
+        if self._store is None:
+            return
+        # global sweep first: votes for scattered views that never reach
+        # quorum must not accumulate forever (each lives at most TTL)
+        now = self._timer.get_current_time()
+        for v in list(self._votes):
+            voters = self._votes[v]
+            for voter in [x for x, ts in voters.items()
+                          if now - ts > self._ttl]:
+                del voters[voter]
+            if not voters:
+                del self._votes[v]
+        import json
+        self._store.put(self._KEY, json.dumps(
+            {str(v): voters for v, voters in self._votes.items()}
+        ).encode())
 
     def add_vote(self, view_no: int, voter: str):
         self._votes.setdefault(view_no, {})[voter] = \
             self._timer.get_current_time()
+        self._save()
 
     def votes(self, view_no: int) -> int:
         self._expire(view_no)
@@ -47,25 +94,32 @@ class InstanceChangeCache:
     def _expire(self, view_no: int):
         now = self._timer.get_current_time()
         votes = self._votes.get(view_no, {})
-        for voter in [v for v, ts in votes.items()
-                      if now - ts > self._ttl]:
+        stale = [v for v, ts in votes.items() if now - ts > self._ttl]
+        for voter in stale:
             del votes[voter]
+        if stale:
+            self._save()
 
     def clear_below(self, view_no: int):
-        for v in [v for v in self._votes if v <= view_no]:
+        cleared = [v for v in self._votes if v <= view_no]
+        for v in cleared:
             del self._votes[v]
+        if cleared:
+            self._save()
 
 
 class ViewChangeTriggerService:
     def __init__(self, data: ConsensusSharedData, timer: TimerService,
-                 bus, network, config: Optional[Config] = None):
+                 bus, network, config: Optional[Config] = None,
+                 vote_store=None):
         self._data = data
         self._timer = timer
         self._bus = bus
         self._network = network
         self._config = config or Config()
         self._cache = InstanceChangeCache(
-            timer, self._config.OUTDATED_INSTANCE_CHANGES_CHECK_INTERVAL)
+            timer, self._config.OUTDATED_INSTANCE_CHANGES_CHECK_INTERVAL,
+            store=vote_store)
         bus.subscribe(VoteForViewChange, self.process_vote_for_view_change)
         network.subscribe(InstanceChange, self.process_instance_change)
 

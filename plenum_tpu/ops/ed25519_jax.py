@@ -669,11 +669,11 @@ def verify_batch_async(msgs: Sequence[bytes], sigs: Sequence[bytes],
     return ok, valid, n
 
 
-# Backend selection: the Pallas whole-verify kernel (~1.5x the XLA
-# expression at large batches on TPU v5e — its VMEM-resident limb
-# registers avoid the per-fmul HBM round trips) for batches that fill
-# at least one block on a TPU; the XLA kernel otherwise (small batches,
-# CPU tests, or any Pallas lowering failure → permanent fallback).
+# Backend selection: the Pallas whole-verify kernel (its VMEM-resident
+# limb registers avoid the per-fmul HBM round trips) for batches of 4+
+# blocks on a TPU — the measured crossover, see _dispatch_kernel; the
+# XLA kernel otherwise (smaller batches, CPU tests, or any Pallas
+# failure → permanent fallback).
 _PALLAS_STATE = {"enabled": None}
 
 
@@ -698,7 +698,11 @@ _PALLAS_VALIDATED = set()      # grid sizes whose execution has completed
 
 def _dispatch_kernel(ay, asign, ry, rsign, s_words, k_words):
     from plenum_tpu.ops import ed25519_pallas as edp
-    if ay.shape[0] >= edp.BLOCK and _pallas_available():
+    # 4+ blocks: the measured crossover — at 1-2 blocks the XLA kernel's
+    # grid has more to pipeline and wins (4096: 273ms XLA vs 331ms
+    # pallas); from 4 blocks the pallas kernel is ~1.4x faster (8192:
+    # 292ms vs 420ms full-path)
+    if ay.shape[0] >= 4 * edp.BLOCK and _pallas_available():
         n_blocks = -(-ay.shape[0] // edp.BLOCK)
         try:
             ok = edp.verify_kernel(ay, asign, ry, rsign,

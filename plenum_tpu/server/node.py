@@ -233,7 +233,10 @@ class Node:
             name, validators, timer, network, executor=self.executor,
             config=self.config, bls_bft_replica=bls_bft_replica,
             checkpoint_digest_source=self._audit_root_at,
-            freshness_checker=self.freshness_checker)
+            freshness_checker=self.freshness_checker,
+            # IC votes persist to nodeStatusDB (reference
+            # instance_change_provider): restart keeps fresh votes
+            vc_vote_store=self.node_status_db)
 
         # ---- RBFT redundant instances: f backups benchmark the master
         from plenum_tpu.server.replicas import (

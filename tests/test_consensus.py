@@ -283,3 +283,26 @@ def test_ordering_with_lossy_network(seed, mock_timer):
     shortest = min(len(l) for l in logs)
     for l in logs:
         assert l[:shortest] == logs[0][:shortest]
+
+
+def test_instance_change_votes_persist_across_restart(mock_timer, tmp_path):
+    """IC votes ride nodeStatusDB (reference instance_change_provider):
+    a restart keeps still-fresh votes, and the TTL applies to the
+    reloaded timestamps."""
+    from plenum_tpu.consensus.view_change_trigger_service import (
+        InstanceChangeCache)
+    from plenum_tpu.storage.kv_file import KeyValueStorageFile
+
+    mock_timer.set_time(1000)
+    store = KeyValueStorageFile(str(tmp_path), "node_status_db")
+    cache = InstanceChangeCache(mock_timer, ttl=100, store=store)
+    cache.add_vote(1, "Alpha")
+    cache.add_vote(1, "Beta")
+    store.close()
+
+    store2 = KeyValueStorageFile(str(tmp_path), "node_status_db")
+    reloaded = InstanceChangeCache(mock_timer, ttl=100, store=store2)
+    assert reloaded.votes(1) == 2
+    assert reloaded.has_vote_from(1, "Alpha")
+    mock_timer.set_time(1200)          # past the TTL
+    assert reloaded.votes(1) == 0
