@@ -1,4 +1,6 @@
 from plenum_tpu.client.wallet import Wallet, WalletStorageHelper
 from plenum_tpu.client.client import PoolClient
+from plenum_tpu.client.network_client import NetworkedPoolClient
 
-__all__ = ["Wallet", "WalletStorageHelper", "PoolClient"]
+__all__ = ["Wallet", "WalletStorageHelper", "PoolClient",
+           "NetworkedPoolClient"]

@@ -638,11 +638,16 @@ class ClientConnection:
 
     async def connect(self):
         reader, writer = await asyncio.open_connection(*self.ha)
-        initiator = Initiator(None, expected_peer_vk=self._expected_vk)
-        m2 = await _handshake_frames(reader, writer, True,
-                                     payload=initiator.message1())
-        m3 = initiator.consume_message2(m2)
-        await _handshake_frames(reader, writer, False, payload=m3)
+        try:
+            initiator = Initiator(None, expected_peer_vk=self._expected_vk)
+            m2 = await _handshake_frames(reader, writer, True,
+                                         payload=initiator.message1())
+            m3 = initiator.consume_message2(m2)
+            await _handshake_frames(reader, writer, False, payload=m3)
+        except BaseException:
+            # a failed handshake must not leak the socket
+            writer.close()
+            raise
         self.conn = Connection(reader, writer, initiator.session(), "client")
         self._reader_task = asyncio.get_event_loop().create_task(
             self._read_loop())
@@ -651,6 +656,9 @@ class ClientConnection:
         while self.conn is not None and self.conn.alive:
             payload = await self.conn.read_frame(Config.MSG_LEN_LIMIT)
             if payload is None:
+                # peer went away: mark the link dead so owners polling
+                # `conn.alive` (NetworkedPoolClient.pump) can redial
+                self.conn.close()
                 break
             try:
                 self.rx.append(serializer.deserialize(payload))

@@ -1,0 +1,118 @@
+"""Rung-3 client: NetworkedPoolClient against a live 4-node socket pool
+— one node's listener is DOWN at dial time (the client starts with 3
+links and still confirms on f+1 matching Replies), the listener then
+comes back and pump()'s backoff redial heals the 4th link; a killed
+live link is detected via the EOF → close path and redialed too.
+"""
+import asyncio
+
+import pytest
+
+from plenum_tpu.client import NetworkedPoolClient, Wallet
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.network.keys import NodeKeys
+from plenum_tpu.network.stack import HA, RemoteInfo
+from plenum_tpu.server.networked_node import NetworkedNode
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+
+
+def test_networked_pool_client_end_to_end():
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.1, CHK_FREQ=5,
+                  LOG_SIZE=15, HEARTBEAT_FREQ=2)
+
+    async def main():
+        keys = {n: NodeKeys(bytes([i + 110]) * 32)
+                for i, n in enumerate(NAMES)}
+        nodes, registry = {}, {}
+        for name in NAMES:
+            node = NetworkedNode(
+                name, {n: RemoteInfo(n, HA("127.0.0.1", 1),
+                                     keys[n].verkey_raw) for n in NAMES},
+                keys[name], HA("127.0.0.1", 0), HA("127.0.0.1", 0),
+                config=conf)
+            await node.start_async()
+            nodes[name] = node
+            registry[name] = RemoteInfo(name, node.nodestack.ha,
+                                        keys[name].verkey_raw)
+        for node in nodes.values():
+            for info in registry.values():
+                if info.name != node.name:
+                    node.nodestack.update_remote(info)
+        everyone = list(nodes.values())
+
+        async def pump_nodes(seconds, until=None):
+            end = asyncio.get_event_loop().time() + seconds
+            while asyncio.get_event_loop().time() < end:
+                for n in everyone:
+                    await n.prod()
+                if until is not None and until():
+                    return True
+                await asyncio.sleep(0.01)
+            return until() if until is not None else True
+
+        assert await pump_nodes(10, until=lambda: all(
+            len(n.nodestack.connecteds) == 3 for n in everyone))
+
+        wallet = Wallet("w1")
+        wallet.add_identifier(signer=SimpleSigner(seed=b"\x71" * 32))
+        addrs = {name: (nodes[name].clientstack.ha,
+                        keys[name].verkey_raw) for name in NAMES}
+
+        # Delta's client listener is DOWN when the client dials
+        await nodes["Delta"].clientstack.stop()
+        client = NetworkedPoolClient(wallet, addrs, resubmit_interval=2.0)
+        client.RECONNECT_BACKOFF = 0.1
+        await client.start()
+        assert len(client._conns) == 3
+
+        dest = SimpleSigner(seed=b"\x72" * 32)
+        req = client.submit({"type": NYM, TARGET_NYM: dest.identifier,
+                             VERKEY: dest.verkey})
+
+        async def drive():
+            # nodes and client pump cooperatively on one loop
+            while True:
+                for n in everyone:
+                    await n.prod()
+                await asyncio.sleep(0.005)
+
+        driver = asyncio.get_event_loop().create_task(drive())
+        try:
+            result = await client.run_until_confirmed(req, timeout=30)
+            assert result["txnMetadata"]["seqNo"] >= 1
+            assert all(n.node.domain_ledger.size == 1 for n in everyone)
+
+            # listener returns on the same port → backoff redial heals
+            await nodes["Delta"].clientstack.start()
+            await asyncio.sleep(0.15)        # past RECONNECT_BACKOFF
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                await client.pump()
+                if len(client._conns) == 4:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(client._conns) == 4
+
+            # a KILLED live link is noticed (EOF → close) and redialed
+            client._conns["Alpha"].conn.close()
+            await asyncio.sleep(0.05)
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                await client.pump()
+                if "Alpha" in client._conns and \
+                        client._conns["Alpha"].conn.alive:
+                    break
+                await asyncio.sleep(0.02)
+            assert client._conns["Alpha"].conn.alive
+        finally:
+            driver.cancel()
+
+        await client.stop()
+        for n in everyone:
+            await n.nodestack.stop()
+            await n.clientstack.stop()
+
+    asyncio.run(main())
