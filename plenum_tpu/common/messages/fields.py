@@ -9,6 +9,7 @@ import ipaddress
 import json
 import re
 from abc import ABC, abstractmethod
+from datetime import datetime
 from typing import Iterable, Optional
 
 from plenum_tpu.common.serializers.base58 import b58decode
@@ -288,6 +289,31 @@ class ChooseField(FieldValidator):
         if val not in self._possible_values:
             return 'expected one of {}, unknown value {}'.format(
                 self._possible_values, val)
+
+
+class ConstantField(FieldValidator):
+    """Exactly one permitted value (reference fields.py ConstantField)."""
+
+    def __init__(self, value, **kwargs):
+        super().__init__(**kwargs)
+        self._value = value
+
+    def _specific_validation(self, val):
+        if val != self._value:
+            return 'has to be equal {}'.format(self._value)
+
+
+class DatetimeStringField(FieldValidator):
+    """ISO-8601 datetime string (reference fields.py
+    DatetimeStringField — TAA acceptance-mechanism timestamps)."""
+
+    def _specific_validation(self, val):
+        if not isinstance(val, str):
+            return 'expected types str, got {}'.format(type(val).__name__)
+        try:
+            datetime.fromisoformat(val)
+        except ValueError:
+            return 'datetime {} is not valid ISO 8601'.format(val)
 
 
 class HexField(FieldValidator):

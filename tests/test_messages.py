@@ -173,3 +173,15 @@ def test_client_message_validator():
         v.validate({'reqId': 1, 'operation': {'dest': 'x'}})  # no type
     with pytest.raises(InvalidClientRequest):
         v.validate({'reqId': -1, 'operation': {'type': NYM}})
+
+
+def test_constant_and_datetime_fields():
+    from plenum_tpu.common.messages.fields import (
+        ConstantField, DatetimeStringField)
+    c = ConstantField("1.0")
+    assert c.validate("1.0") is None
+    assert c.validate("2.0")
+    d = DatetimeStringField()
+    assert d.validate("2026-07-30T12:00:00+00:00") is None
+    assert d.validate("not-a-date")
+    assert d.validate(123)
