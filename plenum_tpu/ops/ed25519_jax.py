@@ -670,10 +670,10 @@ def verify_batch_async(msgs: Sequence[bytes], sigs: Sequence[bytes],
 
 
 # Backend selection: the Pallas whole-verify kernel (its VMEM-resident
-# limb registers avoid the per-fmul HBM round trips) for batches of 4+
-# blocks on a TPU — the measured crossover, see _dispatch_kernel; the
-# XLA kernel otherwise (smaller batches, CPU tests, or any Pallas
-# failure → permanent fallback).
+# limb registers avoid the per-fmul HBM round trips) for any batch
+# filling a block on a TPU — ~2x the XLA expression at every block
+# count, see _dispatch_kernel; the XLA kernel otherwise (smaller
+# batches, CPU tests, or any Pallas failure → permanent fallback).
 _PALLAS_STATE = {"enabled": None}
 
 
@@ -698,11 +698,10 @@ _PALLAS_VALIDATED = set()      # grid sizes whose execution has completed
 
 def _dispatch_kernel(ay, asign, ry, rsign, s_words, k_words):
     from plenum_tpu.ops import ed25519_pallas as edp
-    # 4+ blocks: the measured crossover — at 1-2 blocks the XLA kernel's
-    # grid has more to pipeline and wins (4096: 273ms XLA vs 331ms
-    # pallas); from 4 blocks the pallas kernel is ~1.4x faster (8192:
-    # 292ms vs 420ms full-path)
-    if ay.shape[0] >= 4 * edp.BLOCK and _pallas_available():
+    # at R=32 blocks the pallas kernel wins from ONE block up (4096:
+    # 99ms vs 190ms XLA; 16384: 236ms vs 518ms); below a block the XLA
+    # kernel serves (small batches don't fill the tile grid)
+    if ay.shape[0] >= edp.BLOCK and _pallas_available():
         n_blocks = -(-ay.shape[0] // edp.BLOCK)
         try:
             ok = edp.verify_kernel(ay, asign, ry, rsign,

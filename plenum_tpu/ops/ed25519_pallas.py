@@ -40,12 +40,14 @@ _NB_SUB = np.asarray(edj._NB_SUB)      # [16, 20] int32 (constant table)
 _NB_ADD = np.asarray(edj._NB_ADD)
 _NB_T2D = np.asarray(edj._NB_T2D)
 
-BLOCK_R = 16         # sublanes per batch block (16x128 = 2048 sigs/block:
-                     # the largest whose ~13MB VMEM working set — table
-                     # 16 entries x 80 limb-tiles dominates — still fits;
-                     # 32 exceeds the 16MB scoped-vmem limit)
+BLOCK_R = 32         # sublanes per batch block (32x128 = 4096 sigs/block;
+                     # needs the raised vmem limit below — the window
+                     # table, 16 entries x 80 limb-tiles, dominates)
 BLOCK_L = 128        # lanes
 BLOCK = BLOCK_R * BLOCK_L
+VMEM_LIMIT_BYTES = 100 * 1024 * 1024   # v5e has 128MB VMEM; the default
+                                       # 16MB scoped limit is what an
+                                       # R=32 working set (~26MB) trips
 
 
 # ------------------------------------------------- field ops on limb lists
@@ -419,6 +421,8 @@ def _build_verify(n_blocks: int, interpret: bool = False):
             out_specs=sign_spec,
             out_shape=jax.ShapeDtypeStruct(
                 (1, nb8, BLOCK_L), jnp.int32),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=VMEM_LIMIT_BYTES),
             interpret=interpret,
         )(to_blocks(ay), to_blocks(asign[:, None].astype(jnp.int32)),
           to_blocks(ry), to_blocks(rsign[:, None].astype(jnp.int32)),
