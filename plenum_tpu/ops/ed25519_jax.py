@@ -701,7 +701,7 @@ def _dispatch_kernel(ay, asign, ry, rsign, s_words, k_words):
     # at R=32 blocks the pallas kernel wins from ONE block up (4096:
     # 99ms vs 190ms XLA; 16384: 236ms vs 518ms); below a block the XLA
     # kernel serves (small batches don't fill the tile grid)
-    if ay.shape[0] >= edp.BLOCK and _pallas_available():
+    while _pallas_available() and ay.shape[0] >= edp.BLOCK:
         n_blocks = -(-ay.shape[0] // edp.BLOCK)
         try:
             ok = edp.verify_kernel(ay, asign, ry, rsign,
@@ -717,6 +717,18 @@ def _dispatch_kernel(ay, asign, ry, rsign, s_words, k_words):
             return ok
         except Exception:                        # pragma: no cover
             logger = __import__("logging").getLogger(__name__)
+            if edp.BLOCK_R > 16:
+                # R=32 needs ~26MB VMEM: a smaller-VMEM TPU generation
+                # should step down to the R=16 kernel (fits the 16MB
+                # default) before giving up on Pallas entirely
+                edp.BLOCK_R //= 2
+                edp.BLOCK = edp.BLOCK_R * edp.BLOCK_L
+                edp._build_verify.cache_clear()
+                _PALLAS_VALIDATED.clear()
+                logger.exception(
+                    "pallas verify failed; retrying with BLOCK_R=%d",
+                    edp.BLOCK_R)
+                continue
             logger.exception("pallas verify failed; falling back to XLA")
             _PALLAS_STATE["enabled"] = False
     return _verify_kernel(ay, asign, ry, rsign, s_words, k_words)
