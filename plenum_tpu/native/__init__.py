@@ -19,15 +19,39 @@ def build_and_load(name: str) -> ctypes.CDLL:
 
     The compile targets a pid-unique temp file that is os.rename()d into
     place, so concurrent processes never dlopen a half-written library."""
+    so = _build(name, [])
+    return ctypes.CDLL(so)
+
+
+def build_and_import(name: str):
+    """Compile `<name>.c` as a CPython EXTENSION module (Python.h) and
+    import it — for native code that builds Python objects directly
+    (the RLP codec) rather than crossing a ctypes ABI. The cached .so
+    carries the interpreter's ABI tag so a Python upgrade rebuilds
+    instead of dlopening a stale wrong-ABI binary."""
+    import importlib.machinery
+    import importlib.util
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so = _build(name, ["-I", sysconfig.get_paths()["include"]],
+                suffix=suffix)
+    loader = importlib.machinery.ExtensionFileLoader(name, so)
+    spec = importlib.util.spec_from_file_location(name, so, loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
+def _build(name: str, extra_flags, suffix: str = ".so") -> str:
     src = os.path.join(_DIR, name + ".c")
-    so = os.path.join(_DIR, name + ".so")
+    so = os.path.join(_DIR, name + suffix)
     if (not os.path.exists(so)
             or os.path.getmtime(so) < os.path.getmtime(src)):
         cc = os.environ.get("CC", "cc")
         tmp = "%s.%d.tmp" % (so, os.getpid())
         # plain -O3: measured FASTER than -march=native here — the
         # auto-vectorizer pessimizes the 64x64->128 carry chains
-        cmd = [cc, "-O3", "-shared", "-fPIC", "-std=c11", "-o", tmp, src]
+        cmd = [cc, "-O3", "-shared", "-fPIC", "-std=c11"] + \
+            list(extra_flags) + ["-o", tmp, src]
         logger.info("building native module: %s", " ".join(cmd))
         try:
             subprocess.run(cmd, check=True, capture_output=True)
@@ -35,4 +59,4 @@ def build_and_load(name: str) -> ctypes.CDLL:
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
-    return ctypes.CDLL(so)
+    return so

@@ -4,10 +4,20 @@ The reference uses RLP for MPT nodes (state/util/fast_rlp.py). Wire
 compatibility with Ethereum is not a goal, but RLP is compact, canonical,
 and self-delimiting, so trie hashes are well-defined. Supports bytes and
 (nested) lists of bytes — all a trie node needs.
+
+This file is the REFERENCE implementation; the native CPython extension
+(native/rlp_c.c — the role the reference delegates to its C rlp/leveldb
+dependencies) replaces `encode`/`decode` at import when a compiler is
+available. Tests cross-check the two (tests/test_state.py).
 """
 from typing import List, Tuple, Union
 
 RlpItem = Union[bytes, List["RlpItem"]]
+
+# both backends bound nesting identically (DoS guard; trie nodes are
+# depth <= 2) — backends MUST agree on what is decodable or nodes with
+# and without a C compiler would diverge on wire-input validity
+MAX_DEPTH = 64
 
 
 # one-byte length prefixes, precomputed (the hot path: trie refs are
@@ -16,7 +26,9 @@ _STR_PFX = [bytes([0x80 + n]) for n in range(56)]
 _LIST_PFX = [bytes([0xC0 + n]) for n in range(56)]
 
 
-def encode(item: RlpItem) -> bytes:
+def _encode_py(item: RlpItem, _depth: int = 0) -> bytes:
+    if _depth > MAX_DEPTH:
+        raise ValueError("RLP nesting too deep")
     t = type(item)
     if t is bytes:
         n = len(item)
@@ -37,7 +49,7 @@ def encode(item: RlpItem) -> bytes:
                 else:
                     parts.append(_len_prefix(n, 0x80) + x)
             else:
-                parts.append(encode(x))
+                parts.append(_encode_py(x, _depth + 1))
         body = b"".join(parts)
         n = len(body)
         if n < 56:
@@ -47,9 +59,9 @@ def encode(item: RlpItem) -> bytes:
     # the exact-type checks above are only a fast path, not a contract
     # change
     if isinstance(item, (bytes, bytearray)):
-        return encode(bytes(item))
+        return _encode_py(bytes(item), _depth)
     if isinstance(item, (list, tuple)):
-        return encode(list(item))
+        return _encode_py(list(item), _depth)
     raise TypeError("cannot RLP-encode {}".format(type(item)))
 
 
@@ -62,17 +74,20 @@ def _len_prefix(length: int, offset: int) -> bytes:
 
 def decode(data: bytes) -> RlpItem:
     data = bytes(data)
-    item, pos = _decode_at(data, 0, len(data))
+    item, pos = _decode_at(data, 0, len(data), 0)
     if pos != len(data):
         raise ValueError("trailing RLP bytes")
     return item
 
 
-def _decode_at(data: bytes, pos: int, end: int) -> Tuple[RlpItem, int]:
+def _decode_at(data: bytes, pos: int, end: int,
+               depth: int = 0) -> Tuple[RlpItem, int]:
     """Decode one item at offset `pos`, bounded by `end`; returns
     (item, next_pos). Offset-based so only final payloads are sliced —
     the old remainder-slicing decoder copied O(n²) bytes on branch
     nodes (this is the hottest path in the MPT)."""
+    if depth > MAX_DEPTH:
+        raise ValueError("RLP nesting too deep")
     if pos >= end:
         raise ValueError("empty RLP")
     b0 = data[pos]
@@ -100,7 +115,7 @@ def _decode_at(data: bytes, pos: int, end: int) -> Tuple[RlpItem, int]:
     out = []
     p = body
     while p < nxt:
-        item, p = _decode_at(data, p, nxt)
+        item, p = _decode_at(data, p, nxt, depth + 1)
         out.append(item)
     return out, nxt
 
@@ -119,3 +134,20 @@ def _read_len_at(data: bytes, pos: int, ln: int, minimum: int,
     if start + n > end:
         raise ValueError("truncated RLP")
     return start, start + n
+
+
+# keep the pure-Python pair importable regardless of backend (the
+# implementations self-recurse, so the native override below cannot
+# hijack their internals)
+encode_py = _encode_py
+decode_py = decode
+encode = _encode_py
+
+try:                                   # pragma: no branch
+    from plenum_tpu.native import build_and_import
+    _c = build_and_import("rlp_c")
+    encode = _c.encode
+    decode = _c.decode
+    BACKEND = "native"
+except Exception:                      # pragma: no cover - cc missing
+    BACKEND = "python"

@@ -204,3 +204,41 @@ def test_state_proof_roundtrip():
     proof = st.generate_state_proof(b"did:alpha")
     assert PruningState.verify_state_proof(
         st.committedHeadHash, b"did:alpha", b'{"verkey":"abc"}', proof)
+
+
+def test_native_rlp_matches_reference():
+    """The C codec (native/rlp_c.c) must be bit-identical to the
+    pure-Python reference for trie-shaped nodes and reject the same
+    non-canonical encodings."""
+    import random
+    from plenum_tpu.state import rlp
+
+    # without this the test compares Python against itself, vacuously
+    assert rlp.BACKEND == "native", \
+        "C codec failed to build; rlp fell back to python"
+
+    rng = random.Random(7)
+
+    def rand_item(depth=0):
+        if depth > 3 or rng.random() < 0.6:
+            n = rng.choice([0, 1, 5, 31, 32, 55, 56, 200])
+            return bytes(rng.randrange(256) for _ in range(n))
+        return [rand_item(depth + 1) for _ in range(rng.randrange(0, 18))]
+
+    def norm(x):
+        return [norm(v) for v in x] if isinstance(x, list) else bytes(x)
+
+    for _ in range(300):
+        item = rand_item()
+        blob = rlp.encode_py(item)
+        assert rlp.encode(item) == blob
+        assert norm(rlp.decode(blob)) == norm(rlp.decode_py(blob))
+
+    for bad in (b"", b"\x81\x05", b"\xb8\x37" + b"x" * 55, b"\x80x",
+                b"\xb8\x00", b"\xc1"):
+        for codec in (rlp.decode, rlp.decode_py):
+            try:
+                codec(bad)
+                assert False, ("accepted non-canonical RLP", bad)
+            except ValueError:
+                pass
