@@ -68,12 +68,12 @@ static int put_length(Out *o, Py_ssize_t n, unsigned char offset)
     return out_put(o, (const char *)(tmp + 9 - nb), nb);
 }
 
+#define RLP_MAX_DEPTH 64   /* LIST nesting bound; MUST equal rlp.py's
+                            * MAX_DEPTH (backends must agree on what is
+                            * encodable/decodable) */
+
 static int encode_item(Out *o, PyObject *item, int depth)
 {
-    if (depth > 64) {
-        PyErr_SetString(PyExc_ValueError, "RLP nesting too deep");
-        return -1;
-    }
     if (PyBytes_CheckExact(item)) {
         Py_ssize_t n = PyBytes_GET_SIZE(item);
         const char *p = PyBytes_AS_STRING(item);
@@ -84,12 +84,21 @@ static int encode_item(Out *o, PyObject *item, int depth)
         return out_put(o, p, n);
     }
     if (PyList_CheckExact(item) || PyTuple_CheckExact(item)) {
-        /* encode children into a scratch buffer, then prefix */
+        if (depth >= RLP_MAX_DEPTH) {
+            PyErr_SetString(PyExc_ValueError, "RLP nesting too deep");
+            return -1;
+        }
+        /* encode children into a scratch buffer, then prefix.
+         * Re-fetch size/item each iteration and hold a strong ref:
+         * encoding a subclass child runs arbitrary Python code that
+         * may mutate (realloc) the parent list under us. */
         Out body = {NULL, 0, 0};
-        Py_ssize_t cnt = PySequence_Fast_GET_SIZE(item);
-        PyObject **kids = PySequence_Fast_ITEMS(item);
-        for (Py_ssize_t i = 0; i < cnt; i++) {
-            if (encode_item(&body, kids[i], depth + 1) < 0) {
+        for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(item); i++) {
+            PyObject *kid = PySequence_Fast_GET_ITEM(item, i);
+            Py_INCREF(kid);
+            int rc = encode_item(&body, kid, depth + 1);
+            Py_DECREF(kid);
+            if (rc < 0) {
                 PyMem_Free(body.buf);
                 return -1;
             }
@@ -197,10 +206,6 @@ static PyObject *decode_list(const unsigned char *d, Py_ssize_t *pos,
 static PyObject *decode_at(const unsigned char *d, Py_ssize_t *pos,
                            Py_ssize_t end, int depth)
 {
-    if (depth > 64) {
-        PyErr_SetString(PyExc_ValueError, "RLP nesting too deep");
-        return NULL;
-    }
     if (*pos >= end) {
         PyErr_SetString(PyExc_ValueError, "empty RLP");
         return NULL;
@@ -235,6 +240,11 @@ static PyObject *decode_at(const unsigned char *d, Py_ssize_t *pos,
         PyObject *r = PyBytes_FromStringAndSize((const char *)d + *pos, n);
         *pos += n;
         return r;
+    }
+    /* list forms: only lists carry nesting depth (mirrors rlp.py) */
+    if (depth >= RLP_MAX_DEPTH) {
+        PyErr_SetString(PyExc_ValueError, "RLP nesting too deep");
+        return NULL;
     }
     if (b0 < 0xF8) {        /* short list */
         Py_ssize_t n = b0 - 0xC0;

@@ -14,9 +14,12 @@ from typing import List, Tuple, Union
 
 RlpItem = Union[bytes, List["RlpItem"]]
 
-# both backends bound nesting identically (DoS guard; trie nodes are
-# depth <= 2) — backends MUST agree on what is decodable or nodes with
-# and without a C compiler would diverge on wire-input validity
+# both backends bound LIST nesting identically (DoS guard; trie nodes
+# are depth <= 2): a list nested more than MAX_DEPTH levels deep is
+# invalid to encode AND to decode, in the C and Python codecs alike —
+# the backends MUST agree on validity or nodes with and without a C
+# compiler would diverge, and encode must never produce what decode
+# rejects. Bytes leaves carry no depth of their own.
 MAX_DEPTH = 64
 
 
@@ -27,8 +30,6 @@ _LIST_PFX = [bytes([0xC0 + n]) for n in range(56)]
 
 
 def _encode_py(item: RlpItem, _depth: int = 0) -> bytes:
-    if _depth > MAX_DEPTH:
-        raise ValueError("RLP nesting too deep")
     t = type(item)
     if t is bytes:
         n = len(item)
@@ -38,6 +39,8 @@ def _encode_py(item: RlpItem, _depth: int = 0) -> bytes:
             return _STR_PFX[n] + item
         return _len_prefix(n, 0x80) + item
     if t is list or t is tuple:
+        if _depth >= MAX_DEPTH:
+            raise ValueError("RLP nesting too deep")
         parts = []
         for x in item:
             if type(x) is bytes:          # inline the dominant case
@@ -86,8 +89,6 @@ def _decode_at(data: bytes, pos: int, end: int,
     (item, next_pos). Offset-based so only final payloads are sliced —
     the old remainder-slicing decoder copied O(n²) bytes on branch
     nodes (this is the hottest path in the MPT)."""
-    if depth > MAX_DEPTH:
-        raise ValueError("RLP nesting too deep")
     if pos >= end:
         raise ValueError("empty RLP")
     b0 = data[pos]
@@ -104,6 +105,8 @@ def _decode_at(data: bytes, pos: int, end: int,
     if b0 < 0xC0:  # long string
         body, nxt = _read_len_at(data, pos, b0 - 0xB7, 56, end)
         return data[body:nxt], nxt
+    if 0xC0 <= b0 and depth >= MAX_DEPTH:
+        raise ValueError("RLP nesting too deep")
     if b0 < 0xF8:  # short list
         n = b0 - 0xC0
         nxt = pos + 1 + n

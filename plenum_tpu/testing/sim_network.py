@@ -69,6 +69,43 @@ class Stash(Processor):
         return msgs
 
 
+class Tap(Processor):
+    """Record matching messages WITHOUT consuming them (wire-level spy:
+    the bus subscriptions capture bound methods at construction, so
+    attribute-level spies can't see handler traffic — observe the wire
+    instead)."""
+
+    def __init__(self, frm=None, dst=None, message_types=None):
+        self._filters = dict(frm=frm, dst=dst, message_types=message_types)
+        self.seen: List[PendingMessage] = []
+
+    def process(self, msg: PendingMessage) -> bool:
+        if self._matches(msg, **self._filters):
+            self.seen.append(msg)
+        return False
+
+
+class Delay(Processor):
+    """Deliver matching messages `extra` seconds late (reference
+    delayer combinators, plenum/test/delayers.py — ppDelay/cDelay/
+    icDelay are this with a message_types filter). Each delayed message
+    still draws its own base latency, so two equally delayed messages
+    may reorder exactly like two undelayed ones; only identical
+    deadlines keep FIFO (the seq tie-break)."""
+
+    def __init__(self, network: "SimNetwork", extra: float,
+                 frm=None, dst=None, message_types=None):
+        self._network = network
+        self.extra = extra
+        self._filters = dict(frm=frm, dst=dst, message_types=message_types)
+
+    def process(self, msg: PendingMessage) -> bool:
+        if not self._matches(msg, **self._filters):
+            return False
+        self._network._schedule_delivery(msg, extra=self.extra)
+        return True
+
+
 class SimNetwork:
     def __init__(self, timer: MockTimer, random: Optional[SimRandom] = None,
                  serialize_deserialize: Callable[[Any], Any] = None,
@@ -174,8 +211,9 @@ class SimNetwork:
                 self._schedule_delivery(msg)
         return handle
 
-    def _schedule_delivery(self, msg: PendingMessage):
-        delay = self._random.float(self._min_latency, self._max_latency)
+    def _schedule_delivery(self, msg: PendingMessage, extra: float = 0.0):
+        delay = self._random.float(self._min_latency, self._max_latency) \
+            + extra
         deadline = self._timer.get_current_time() + delay
         self._seq += 1
         heapq.heappush(self._pending, (deadline, self._seq, msg))

@@ -1,0 +1,76 @@
+"""Delayer + spy fault-injection fixtures over the real pipeline
+(reference plenum/test/delayers.py + testable.py patterns): delayed
+COMMITs and PRE-PREPAREs must not break ordering — the 3PC pipeline
+absorbs skew, and MessageReq self-heals what arrives too late.
+"""
+import pytest
+
+from plenum_tpu.common.messages.node_messages import (
+    Commit, MessageRep, PrePrepare)
+from plenum_tpu.testing.sim_network import Delay, Tap
+from plenum_tpu.testing.spy import spy_on, unspy
+
+from tests.test_node_e2e import (
+    pump, signed_nym_request, submit_to_all)
+from tests.test_view_change_e2e import pool, live_roots_agree  # noqa: F401
+from plenum_tpu.crypto.signer import SimpleSigner
+
+
+def test_delayed_commits_still_order(pool):
+    """COMMITs to one node run 2s late: it orders behind the others but
+    converges with identical roots (reference cDelay tests)."""
+    nodes, sinks, net, timer = pool
+    victim = nodes[3]
+    net.add_processor(Delay(net, 2.0, dst=[victim.name],
+                            message_types=[Commit]))
+    clients = [SimpleSigner(seed=bytes([140 + i]) * 32) for i in range(3)]
+    for i, c in enumerate(clients):
+        submit_to_all(nodes, signed_nym_request(c, req_id=900 + i))
+    pump(timer, nodes, 4)
+    others = [n for n in nodes if n is not victim]
+    assert all(n.domain_ledger.size == 3 for n in others)
+    # the victim catches up once the delayed COMMITs land
+    pump(timer, nodes, 6)
+    assert victim.domain_ledger.size == 3
+    assert live_roots_agree(nodes)
+
+
+def test_delayed_preprepare_heals_via_message_req(pool):
+    """A node whose PRE-PREPARE arrives very late sees PREPAREs first;
+    the stash + MessageReq machinery recovers ordering (reference
+    ppDelay tests). The wire Tap proves the solicited MESSAGE_RESPONSE
+    actually delivered the PP — not timing luck: the direct PP is held
+    back longer than the whole test runs."""
+    nodes, sinks, net, timer = pool
+    primary = next(n for n in nodes if n.replica.data.is_primary)
+    victim = next(n for n in nodes if n is not primary)
+    tap = Tap(dst=[victim.name], message_types=[MessageRep])
+    net.add_processor(tap)
+    net.add_processor(Delay(net, 60.0, frm=[primary.name],
+                            dst=[victim.name],
+                            message_types=[PrePrepare]))
+    client = SimpleSigner(seed=b"\x91" * 32)
+    submit_to_all(nodes, signed_nym_request(client, req_id=950))
+    pump(timer, nodes, 10)
+    assert victim.domain_ledger.size == 1, victim.domain_ledger.size
+    assert any(m.message.msg_type == "PREPREPARE" for m in tap.seen), \
+        [m.message.msg_type for m in tap.seen]
+    assert live_roots_agree(nodes)
+
+
+def test_spy_records_and_restores():
+    class Obj:
+        def f(self, x):
+            if x < 0:
+                raise ValueError("neg")
+            return x * 2
+
+    o = Obj()
+    log = spy_on(o, "f")
+    assert o.f(3) == 6
+    with pytest.raises(ValueError):
+        o.f(-1)
+    assert log.count() == 2
+    assert log[0].result == 6 and log[1].error is not None
+    unspy(o, "f")
+    assert not hasattr(o.f, "_spy_log")
