@@ -72,7 +72,8 @@ class ReplicaService:
             self.view_changer = ViewChangeService(
                 data=self._data, timer=timer, bus=self.internal_bus,
                 network=network, stasher=self.stasher, config=self.config,
-                primaries_selector=self.selector)
+                primaries_selector=self.selector,
+                digest_source=checkpoint_digest_source)
             self.vc_trigger = ViewChangeTriggerService(
                 data=self._data, timer=timer, bus=self.internal_bus,
                 network=network, config=self.config,

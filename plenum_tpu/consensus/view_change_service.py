@@ -22,8 +22,8 @@ from typing import Dict, List, Optional, Tuple
 
 from plenum_tpu.common.config import Config
 from plenum_tpu.common.messages.internal_messages import (
-    NeedViewChange, NewViewAccepted, NewViewCheckpointsApplied,
-    VoteForViewChange, ViewChangeStarted)
+    NeedMasterCatchup, NeedViewChange, NewViewAccepted,
+    NewViewCheckpointsApplied, VoteForViewChange, ViewChangeStarted)
 from plenum_tpu.common.messages.node_messages import (
     Checkpoint, NewView, ViewChange, ViewChangeAck)
 from plenum_tpu.common.serializers.serialization import serialize_msg_for_signing
@@ -52,27 +52,40 @@ class NewViewBuilder:
         self._data = data
 
     def calc_checkpoint(self, vcs: List[ViewChange]) -> Optional[dict]:
-        """Highest checkpoint claimed stable by a weak quorum (f+1) and
-        not ahead of a strong quorum's progress."""
-        candidates = []
+        """Highest checkpoint claimed by a weak quorum (f+1) and not
+        ahead of a strong quorum's progress.
+
+        Candidates are keyed by (seqNoEnd, digest) — NOT whole-dict
+        equality: a CHK_FREQ-aligned checkpoint and a caught-up node's
+        virtual checkpoint at the same position differ in bookkeeping
+        fields (viewNo/seqNoStart) while agreeing on the part that
+        matters. The returned dict is built canonically from the key, so
+        the primary and every validator compute the identical value and
+        ties cannot split on iteration order."""
+        votes: Dict[tuple, int] = defaultdict(int)
         for vc in vcs:
+            seen = set()
             for chk in vc.checkpoints:
-                if chk not in candidates:
-                    candidates.append(chk)
+                key = (chk["seqNoEnd"], chk["digest"])
+                if key not in seen:
+                    seen.add(key)
+                    votes[key] += 1
         best = None
-        for chk in candidates:
-            end = chk["seqNoEnd"]
+        for (end, digest), have in votes.items():
             # at least f+1 replicas have this checkpoint
-            have = sum(1 for vc in vcs if chk in vc.checkpoints)
             if not self._data.quorums.weak.is_reached(have):
                 continue
             # at least n-f replicas can reach it (stable ≤ end)
             reachable = sum(1 for vc in vcs if vc.stableCheckpoint <= end)
             if not self._data.quorums.strong.is_reached(reachable):
                 continue
-            if best is None or end > best["seqNoEnd"]:
-                best = chk
-        return best
+            if best is None or (end, digest) > best:
+                best = (end, digest)
+        if best is None:
+            return None
+        return Checkpoint(instId=self._data.inst_id, viewNo=0,
+                          seqNoStart=best[0], seqNoEnd=best[0],
+                          digest=best[1]).as_dict()
 
     def calc_batches(self, checkpoint: Optional[dict],
                      vcs: List[ViewChange]) -> Optional[List[BatchID]]:
@@ -137,7 +150,7 @@ class ViewChangeService:
     def __init__(self, data: ConsensusSharedData, timer: TimerService,
                  bus, network, stasher: Optional[StashingRouter] = None,
                  config: Optional[Config] = None,
-                 primaries_selector=None):
+                 primaries_selector=None, digest_source=None):
         self._data = data
         self._timer = timer
         self._bus = bus
@@ -146,6 +159,9 @@ class ViewChangeService:
         self._selector = primaries_selector or \
             RoundRobinConstantNodesPrimariesSelector(data.validators)
         self._builder = NewViewBuilder(data)
+        # MUST be the same source CheckpointService uses (audit root in
+        # production), so virtual checkpoints match across nodes
+        self._digest_source = digest_source or (lambda s: "chk-%d" % s)
 
         self._stasher = stasher or StashingRouter(limit=10000,
                                                   buses=[bus, network])
@@ -193,12 +209,28 @@ class ViewChangeService:
         self._try_finish()
 
     def _build_view_change_msg(self) -> ViewChange:
+        checkpoints = [c.as_dict() for c in self._data.checkpoints]
+        # VIRTUAL checkpoint at our last-ordered position: after catchup
+        # a rejoining node's stable checkpoint sits at the caught-up seq
+        # with no CHK_FREQ-aligned checkpoint anywhere to match it, which
+        # would veto every candidate in NewViewBuilder.calc_checkpoint
+        # (its stable > candidate end) and deadlock the view change.
+        # Every node advertising its current position — digest from the
+        # SHARED source (audit root) — guarantees caught-up nodes present
+        # identical candidates. Fixed viewNo/seqNoStart so dict equality
+        # holds across nodes regardless of when each ordered the batch.
+        last = self._data.last_ordered_3pc[1]
+        if not any(c.get("seqNoEnd") == last for c in checkpoints):
+            checkpoints.append(Checkpoint(
+                instId=self._data.inst_id, viewNo=0, seqNoStart=last,
+                seqNoEnd=last,
+                digest=self._digest_source(last)).as_dict())
         return ViewChange(
             viewNo=self._data.view_no,
             stableCheckpoint=self._data.stable_checkpoint,
             prepared=[list(b) for b in self._data.prepared],
             preprepared=[list(b) for b in self._data.preprepared],
-            checkpoints=[c.as_dict() for c in self._data.checkpoints],
+            checkpoints=checkpoints,
         )
 
     def _schedule_new_view_timeout(self):
@@ -358,3 +390,14 @@ class ViewChangeService:
             batches=[batch_id_from(b) for b in nv.batches]))
         logger.info("%s completed view change to view %d",
                     self._data.name, view_no)
+        if checkpoint is not None and \
+                checkpoint["seqNoEnd"] > self._data.last_ordered_3pc[1]:
+            # the agreed checkpoint is ahead of what we ordered: the
+            # re-order set starts after it, so the gap is only
+            # recoverable by catchup — adopting silently would skip
+            # those batches forever and fork our state
+            logger.info("%s behind new-view checkpoint (%d > %d) — "
+                        "catching up", self._data.name,
+                        checkpoint["seqNoEnd"],
+                        self._data.last_ordered_3pc[1])
+            self._bus.send(NeedMasterCatchup())

@@ -207,13 +207,23 @@ class PrimaryConnectionMonitorService:
         self._data = data
         self._timer = timer
         self._bus = bus
+        self._network = network
         self._config = config or Config()
         self._primary_disconnected_at: Optional[float] = None
         network.subscribe(ExternalBus.Connected, self._connection_changed)
         network.subscribe(ExternalBus.Disconnected, self._connection_changed)
+        # events alone miss the join-while-primary-dead case: a node
+        # that starts (or changes views) with the primary already absent
+        # never receives a Disconnected event — poll current state too
         self._check_timer = RepeatingTimer(
             timer, max(1.0, self._config.ToleratePrimaryDisconnection / 4),
             self._check)
+
+    def _primary_absent(self) -> bool:
+        primary = self._data.primary_name
+        return (primary is not None
+                and primary != self._data.name
+                and primary not in self._network.connecteds)
 
     def stop(self):
         self._check_timer.stop()
@@ -229,6 +239,14 @@ class PrimaryConnectionMonitorService:
 
     def _check(self):
         if self._primary_disconnected_at is None:
+            if self._primary_absent():
+                # primary was already gone when we (re)started — begin
+                # the tolerance clock now
+                self._primary_disconnected_at = \
+                    self._timer.get_current_time()
+            return
+        if not self._primary_absent():
+            self._primary_disconnected_at = None
             return
         if self._data.is_primary:
             return

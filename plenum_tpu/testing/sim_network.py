@@ -141,10 +141,13 @@ class SimNetwork:
         bus = ExternalBus(send_handler=send_handler or
                           self._make_send_handler(name))
         self._buses[name] = bus
+        # downed peers are NOT connected to the newcomer (a node joining
+        # while the primary is dead must see it as disconnected)
         for peer, other in self._buses.items():
-            if peer != name:
+            if peer != name and peer not in self._down:
                 other.update_connecteds(other.connecteds | {name})
-        bus.update_connecteds(set(p for p in self._buses if p != name))
+        bus.update_connecteds(set(p for p in self._buses
+                                  if p != name and p not in self._down))
         return bus
 
     def remove_peer(self, name: str):

@@ -306,3 +306,66 @@ def test_instance_change_votes_persist_across_restart(mock_timer, tmp_path):
     assert reloaded.has_vote_from(1, "Alpha")
     mock_timer.set_time(1200)          # past the TTL
     assert reloaded.votes(1) == 0
+
+
+def test_new_view_checkpoint_merges_real_and_virtual():
+    """calc_checkpoint must count a CHK_FREQ-aligned checkpoint and a
+    caught-up node's virtual checkpoint at the same (seqNoEnd, digest)
+    as ONE candidate (they differ in bookkeeping fields), and its
+    output must be canonical — identical no matter which variant each
+    node advertised (review round-2 findings)."""
+    from plenum_tpu.common.messages.node_messages import (
+        Checkpoint, ViewChange)
+    from plenum_tpu.consensus.consensus_shared_data import (
+        ConsensusSharedData)
+    from plenum_tpu.consensus.view_change_service import NewViewBuilder
+
+    data = ConsensusSharedData("A", ["A", "B", "C", "D"], 0, True)
+    builder = NewViewBuilder(data)
+
+    real = Checkpoint(instId=0, viewNo=3, seqNoStart=0, seqNoEnd=10,
+                      digest="root-10").as_dict()
+    virtual = Checkpoint(instId=0, viewNo=0, seqNoStart=10, seqNoEnd=10,
+                         digest="root-10").as_dict()
+
+    def vc(chk, stable):
+        return ViewChange(viewNo=4, stableCheckpoint=stable,
+                          prepared=[], preprepared=[], checkpoints=[chk])
+
+    # 2 real + 2 virtual advertisers: weak quorum (f+1 = 2) is reached
+    # only if the variants merge; all four can reach seq 10
+    vcs = [vc(real, 0), vc(real, 0), vc(virtual, 10), vc(virtual, 10)]
+    chosen = builder.calc_checkpoint(vcs)
+    assert chosen is not None and chosen["seqNoEnd"] == 10
+    assert chosen["digest"] == "root-10"
+    # canonical: recomputing from ANY ordering yields the same dict
+    assert builder.calc_checkpoint(list(reversed(vcs))) == chosen
+
+
+def test_new_view_checkpoint_respects_laggard_quorum():
+    """A checkpoint ahead of what a strong quorum can reach must not be
+    chosen, and with no valid candidate the builder returns None."""
+    from plenum_tpu.common.messages.node_messages import (
+        Checkpoint, ViewChange)
+    from plenum_tpu.consensus.consensus_shared_data import (
+        ConsensusSharedData)
+    from plenum_tpu.consensus.view_change_service import NewViewBuilder
+
+    data = ConsensusSharedData("A", ["A", "B", "C", "D"], 0, True)
+    builder = NewViewBuilder(data)
+    chk10 = Checkpoint(instId=0, viewNo=0, seqNoStart=10, seqNoEnd=10,
+                       digest="root-10").as_dict()
+    chk0 = Checkpoint(instId=0, viewNo=0, seqNoStart=0, seqNoEnd=0,
+                      digest="root-0").as_dict()
+
+    def vc(chks, stable):
+        return ViewChange(viewNo=4, stableCheckpoint=stable,
+                          prepared=[], preprepared=[], checkpoints=chks)
+
+    # only one node is at 10 (stable=10); the rest are at 0: candidate
+    # 10 lacks weak quorum, candidate 0 fails reachability (the node at
+    # stable=10 cannot go back) -> strong quorum 3 of 4 ok though: n=4,
+    # f=1, strong=3 -> 3 nodes with stable<=0 reach it
+    vcs = [vc([chk10], 10), vc([chk0], 0), vc([chk0], 0), vc([chk0], 0)]
+    chosen = builder.calc_checkpoint(vcs)
+    assert chosen is not None and chosen["seqNoEnd"] == 0

@@ -196,3 +196,56 @@ def _node_txn(alias):
         DATA: {ALIAS: alias, SERVICES: [VALIDATOR]},
     })
     return txn
+
+
+def test_node_joins_during_view_change(pool):
+    """The risky interaction: Epsilon is committed as a validator, the
+    VIEW-0 PRIMARY then dies BEFORE Epsilon joins — the survivors run
+    the view change under the new n=5 quorums (commit quorum 4 of the
+    4 live nodes), and Epsilon joins mid-flight, catches up, adopts the
+    new view, and its votes count toward subsequent ordering."""
+    nodes, sinks, net, timer = pool
+    client = SimpleSigner(seed=b"\x41" * 32)
+    submit_to_all(nodes, signed_nym_request(client, req_id=1))
+    pump(timer, nodes, 6)
+    assert all(n.domain_ledger.size == 6 for n in nodes)
+
+    req = signed_node_request(STEWARDS[0], "Epsilon", [VALIDATOR],
+                              req_id=2)
+    submit_to_all(nodes, req)
+    pump(timer, nodes, 6)
+    assert all(n.replica.data.quorums.n == 5 for n in nodes)
+    target_size = nodes[0].domain_ledger.size
+
+    # kill the primary: 3 of the 4 seed nodes remain and the view-change
+    # quorum is n-f = 4 of 5 — completing the change REQUIRES the
+    # not-yet-started Epsilon to join and vote
+    primary = next(n for n in nodes if n.replica.data.is_primary)
+    net.disconnect(primary.name)
+    live = [n for n in nodes if n is not primary]
+    pump(timer, live, 8)   # disconnect detected, votes cast
+
+    # Epsilon starts while the view change is in flight
+    sink = ClientSink()
+    epsilon = build_node("Epsilon", NAMES + ["Epsilon"], net, timer, sink)
+    epsilon.start_catchup()
+    everyone = live + [epsilon]
+    pump(timer, everyone, 25)
+    # the view may escalate past 1 (NEW_VIEW timeouts while only 3 of
+    # the 4-vote quorum existed); what matters is that everyone —
+    # including the newcomer — AGREES on a post-change view
+    views = {n.view_no for n in everyone}
+    assert len(views) == 1 and views.pop() >= 1, \
+        {n.name: n.view_no for n in everyone}
+    assert epsilon.domain_ledger.size == target_size
+
+    late = SimpleSigner(seed=b"\x42" * 32)
+    for n in everyone:
+        n.process_client_request(
+            dict(signed_nym_request(late, req_id=3)), "late")
+    pump(timer, everyone, 10)
+    # n=5 commit quorum is 4: with the old primary still dead, ordering
+    # REQUIRES Epsilon's votes — progress proves it participates
+    assert all(n.domain_ledger.size == target_size + 1 for n in everyone)
+    assert len({n.domain_ledger.root_hash for n in everyone}) == 1
+    assert len({n.audit_ledger.root_hash for n in everyone}) == 1
