@@ -392,16 +392,20 @@ def main():
         wm, ws, wv = make_signed_batch(4 * chunk, seed=1)
         edj.verify_batch(wm, ws, wv)
 
-    # best-of-2 for BOTH sides: symmetric, and box-load noise between
-    # the two timed runs stops dominating the reported ratio
-    def best_of(verifier_name, n=2):
-        runs = [run_pool(reqs, verifier_name) for _ in range(n)]
+    # INTERLEAVED best-of-2: back-to-back tpu-then-cpu blocks let
+    # box-load drift bias the ratio whichever way the wind blows —
+    # alternating runs exposes both pools to the same load profile
+    def best_of(runs, side):
         complete = [r for r in runs if r[1] >= POOL_REQS]
-        assert complete, (verifier_name, runs)
+        assert complete, (side, runs)
         return min(complete, key=lambda r: r[0] / r[1])
 
-    tpu_elapsed, tpu_ordered = best_of("tpu_hub")
-    cpu_elapsed, cpu_ordered = best_of("cpu")
+    tpu_runs, cpu_runs = [], []
+    for _ in range(2):
+        tpu_runs.append(run_pool(reqs, "tpu_hub"))
+        cpu_runs.append(run_pool(reqs, "cpu"))
+    tpu_elapsed, tpu_ordered = best_of(tpu_runs, "tpu_hub")
+    cpu_elapsed, cpu_ordered = best_of(cpu_runs, "cpu")
     tpu_rate = tpu_ordered / tpu_elapsed
     cpu_rate = cpu_ordered / cpu_elapsed
 

@@ -717,6 +717,16 @@ class OrderingService:
                           key=lambda b: b.pp_seq_no):
             if (self.view_no, bid.pp_seq_no) in self.prePrepares:
                 continue  # already re-applied
+            if self.is_master and \
+                    bid.pp_seq_no > self._last_applied_seq + 1 and \
+                    bid.pp_seq_no > self._data.last_ordered_3pc[1] + 1:
+                # gap below this batch (we accepted a NEW_VIEW checkpoint
+                # ahead of our own ordering): applying would run it onto
+                # state missing its predecessors and loop on root
+                # mismatches — wait for catchup (on_catchup_finished
+                # resumes us). _last_applied_seq advances per re-apply,
+                # so sequential re-ordering of many batches is unaffected.
+                break
             pp = self.old_view_preprepares.get(
                 (bid.pp_view_no, bid.pp_seq_no, bid.pp_digest))
             if pp is None:
@@ -855,6 +865,11 @@ class OrderingService:
 
     def on_catchup_finished(self):
         self._stasher.process_all_stashed(STASH_CATCH_UP)
+        # a node that accepted a NEW_VIEW while behind its checkpoint
+        # paused re-ordering (the gap below the re-order set is only
+        # coverable by catchup) — resume now that the gap is filled
+        if self._new_view_bids_to_reorder:
+            self._reapply_ready_batches()
 
     def on_view_change_completed(self):
         self._stasher.process_all_stashed(STASH_VIEW_3PC)

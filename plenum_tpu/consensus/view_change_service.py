@@ -70,13 +70,28 @@ class NewViewBuilder:
                 if key not in seen:
                     seen.add(key)
                     votes[key] += 1
+        def can_participate_from(vc: ViewChange, end: int) -> bool:
+            """stable ≤ end: the node re-orders forward from `end`.
+            stable > end: the node is PAST the candidate — it already
+            ordered everything up to its stable, and re-application
+            skips seqs ≤ its last_ordered (ordering_service
+            already_ordered guard), so it participates by skipping. A
+            caught-up node at an unaligned position therefore never
+            vetoes lower candidates (that veto deadlocked pools whose
+            members caught up to distinct positions)."""
+            if vc.stableCheckpoint <= end:
+                return True
+            return max((c["seqNoEnd"] for c in vc.checkpoints),
+                       default=vc.stableCheckpoint) >= end
+
         best = None
         for (end, digest), have in votes.items():
             # at least f+1 replicas have this checkpoint
             if not self._data.quorums.weak.is_reached(have):
                 continue
-            # at least n-f replicas can reach it (stable ≤ end)
-            reachable = sum(1 for vc in vcs if vc.stableCheckpoint <= end)
+            # at least n-f replicas can participate after it
+            reachable = sum(1 for vc in vcs
+                            if can_participate_from(vc, end))
             if not self._data.quorums.strong.is_reached(reachable):
                 continue
             if best is None or (end, digest) > best:

@@ -343,8 +343,9 @@ def test_new_view_checkpoint_merges_real_and_virtual():
 
 
 def test_new_view_checkpoint_respects_laggard_quorum():
-    """A checkpoint ahead of what a strong quorum can reach must not be
-    chosen, and with no valid candidate the builder returns None."""
+    """A node PAST a candidate does not veto it (it participates by
+    skipping already-ordered seqs), and a candidate nobody shares
+    (weak quorum unmet) is never chosen."""
     from plenum_tpu.common.messages.node_messages import (
         Checkpoint, ViewChange)
     from plenum_tpu.consensus.consensus_shared_data import (
@@ -362,10 +363,10 @@ def test_new_view_checkpoint_respects_laggard_quorum():
         return ViewChange(viewNo=4, stableCheckpoint=stable,
                           prepared=[], preprepared=[], checkpoints=chks)
 
-    # only one node is at 10 (stable=10); the rest are at 0: candidate
-    # 10 lacks weak quorum, candidate 0 fails reachability (the node at
-    # stable=10 cannot go back) -> strong quorum 3 of 4 ok though: n=4,
-    # f=1, strong=3 -> 3 nodes with stable<=0 reach it
+    # only one node is at 10: candidate 10 lacks weak quorum (1 < 2);
+    # candidate 0 has weak quorum (3) and everyone can participate from
+    # it — the three nodes at stable 0 re-order forward, the node at 10
+    # skips what it already ordered
     vcs = [vc([chk10], 10), vc([chk0], 0), vc([chk0], 0), vc([chk0], 0)]
     chosen = builder.calc_checkpoint(vcs)
     assert chosen is not None and chosen["seqNoEnd"] == 0
