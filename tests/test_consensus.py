@@ -260,7 +260,7 @@ def test_wrong_digest_preprepare_rejected(mock_timer):
 
 # ----------------------------------------------------- randomized (seeded)
 
-@pytest.mark.parametrize("seed", [101, 202, 303])
+@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606, 707])
 def test_ordering_with_lossy_network(seed, mock_timer):
     """With 20% random message loss the pool still converges (quorums +
     retransmission-free design tolerance: batches only need n-f)."""

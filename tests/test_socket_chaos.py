@@ -24,7 +24,7 @@ from tests.test_node_e2e import signed_nym_request
 NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
 
 
-@pytest.mark.parametrize("seed", [1, 7])
+@pytest.mark.parametrize("seed", [1, 7, 13, 29])
 def test_pool_survives_connection_churn(seed):
     conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.1, CHK_FREQ=5,
                   LOG_SIZE=15, HEARTBEAT_FREQ=1,
