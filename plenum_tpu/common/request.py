@@ -12,6 +12,10 @@ from plenum_tpu.common.constants import (
     SIGNATURES, TAA_ACCEPTANCE)
 from plenum_tpu.common.serializers.serialization import serialize_msg_for_signing
 
+from plenum_tpu.native import try_load_ext
+
+_fp = try_load_ext("fastpath")
+
 
 class Request:
     def __init__(self,
@@ -47,9 +51,19 @@ class Request:
         return self._payload_digest
 
     def getDigest(self) -> str:
+        if _fp is not None:
+            try:
+                return _fp.digest_hex(self.signingState())
+            except TypeError:
+                pass
         return sha256(serialize_msg_for_signing(self.signingState())).hexdigest()
 
     def getPayloadDigest(self) -> str:
+        if _fp is not None:
+            try:
+                return _fp.digest_hex(self.signingPayloadState())
+            except TypeError:
+                pass
         return sha256(serialize_msg_for_signing(
             self.signingPayloadState())).hexdigest()
 

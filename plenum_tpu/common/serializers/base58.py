@@ -37,13 +37,13 @@ def b58encode(data: bytes) -> str:
         got = _ENC32.get(data)
         if got is not None:
             return got
-        out = _b58encode_raw(data)
+        out = _encode_backend(data)
         if len(_ENC32) >= 1 << 16:
             for stale in list(_ENC32)[:1 << 15]:
                 del _ENC32[stale]
         _ENC32[data] = out
         return out
-    return _b58encode_raw(data)
+    return _encode_backend(data)
 
 
 def _b58encode_raw(data: bytes) -> str:
@@ -67,7 +67,7 @@ def _b58encode_raw(data: bytes) -> str:
     return '1' * pad + body
 
 
-def b58decode(s) -> bytes:
+def _b58decode_py(s) -> bytes:
     if isinstance(s, bytes):
         s = s.decode('ascii')
     n = 0
@@ -84,6 +84,19 @@ def b58decode(s) -> bytes:
         else:
             break
     return b'\x00' * pad + full
+
+
+# native backend when the compiler is available (byte-identical output;
+# tests/test_fastpath_native.py cross-checks both directions)
+from plenum_tpu.native import try_load_ext as _try_load_ext
+
+_fp = _try_load_ext("fastpath")
+if _fp is not None:
+    _encode_backend = _fp.b58encode
+    b58decode = _fp.b58decode
+else:
+    _encode_backend = _b58encode_raw
+    b58decode = _b58decode_py
 
 
 def is_b58(s, length: int = None) -> bool:

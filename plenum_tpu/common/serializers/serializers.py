@@ -13,6 +13,10 @@ import msgpack
 
 from plenum_tpu.common.serializers.base58 import b58encode, b58decode
 
+from plenum_tpu.native import try_load_ext
+
+_fp = try_load_ext("fastpath")
+
 
 class Serializer(ABC):
     @abstractmethod
@@ -47,6 +51,11 @@ class MsgPackSerializer(Serializer):
     across nodes (consensus digests depend on it)."""
 
     def serialize(self, data: Any, to_bytes=True) -> bytes:
+        if _fp is not None:
+            try:
+                return _fp.canonical_msgpack(data)
+            except TypeError:
+                pass  # non-str keys etc. — the Python path decides
         return msgpack.packb(_sort_deep(data), use_bin_type=True)
 
     def deserialize(self, data: Any) -> Any:
@@ -61,6 +70,11 @@ class OrderedJsonSerializer(Serializer):
     bit-identically on every node)."""
 
     def serialize(self, data: Any, to_bytes=True):
+        if to_bytes and _fp is not None:
+            try:
+                return _fp.canonical_json_ascii(data)
+            except TypeError:
+                pass
         out = json.dumps(data, sort_keys=True, separators=(',', ':'))
         return out.encode('utf-8') if to_bytes else out
 

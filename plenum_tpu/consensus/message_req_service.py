@@ -45,9 +45,6 @@ class MessageReqService:
         self._gap_timer = RepeatingTimer(timer, check_interval,
                                          self._check_gaps)
 
-    def stop(self):
-        self._gap_timer.stop()
-
     # ------------------------------------------------------ gap detection
 
     def _check_gaps(self):
@@ -156,7 +153,13 @@ class MessageReqService:
                            self._data.name, frm, e)
 
     def stop(self):
-        """Detach network subscriptions (backup replica removal)."""
+        """Stop the gap timer and detach network subscriptions.
+
+        Called on backup replica removal (server/replicas.py); without the
+        timer stop, a removed backup would leak a live RepeatingTimer that
+        keeps firing _check_gaps on the shared TimerService forever.
+        """
+        self._gap_timer.stop()
         for unsub in self._unsubscribers:
             try:
                 unsub()

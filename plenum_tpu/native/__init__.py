@@ -60,3 +60,32 @@ def _build(name: str, extra_flags, suffix: str = ".so") -> str:
             if os.path.exists(tmp):
                 os.unlink(tmp)
     return so
+
+
+_loaded = {}
+
+
+def load_ext(name: str):
+    """build_and_import with a process-wide cache, so every consumer of a
+    shared extension (fastpath is imported by serializers, request,
+    propagator, base58) gets the same module object and the stale-check
+    runs once."""
+    mod = _loaded.get(name)
+    if mod is None:
+        mod = _loaded[name] = build_and_import(name)
+    return mod
+
+
+def try_load_ext(name: str):
+    """load_ext, or None when no compiler / build failure — the standard
+    guard for optional native fast paths (callers fall back to their
+    Python implementation). Central so a future kill-switch or build
+    diagnostics change lands in one place."""
+    if os.environ.get("PLENUM_TPU_NO_NATIVE"):
+        return None
+    try:
+        return load_ext(name)
+    except Exception:                  # pragma: no cover - cc missing
+        logger.info("native module %s unavailable; using Python fallback",
+                    name, exc_info=True)
+        return None

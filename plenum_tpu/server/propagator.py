@@ -17,7 +17,7 @@ from plenum_tpu.consensus.quorums import Quorums
 logger = logging.getLogger(__name__)
 
 
-def _strict_deep_eq(a, b) -> bool:
+def _strict_deep_eq_py(a, b) -> bool:
     """Deep equality that also requires identical types at every node —
     digest-faithful for the canonical serializers (which encode True,
     1, and 1.0 differently while Python `==` conflates them)."""
@@ -27,13 +27,26 @@ def _strict_deep_eq(a, b) -> bool:
         if len(a) != len(b):
             return False
         for k, v in a.items():
-            if k not in b or not _strict_deep_eq(v, b[k]):
+            if k not in b or not _strict_deep_eq_py(v, b[k]):
                 return False
         return True
     if isinstance(a, (list, tuple)):
         return len(a) == len(b) and all(
-            _strict_deep_eq(x, y) for x, y in zip(a, b))
+            _strict_deep_eq_py(x, y) for x, y in zip(a, b))
     return a == b
+
+
+from plenum_tpu.native import try_load_ext
+
+_fp = try_load_ext("fastpath")
+if _fp is not None:
+    def _strict_deep_eq(a, b, _c=_fp.deep_eq):
+        try:
+            return _c(a, b)
+        except TypeError:  # structure too deep for the C guard
+            return _strict_deep_eq_py(a, b)
+else:
+    _strict_deep_eq = _strict_deep_eq_py
 
 
 class ReqState:

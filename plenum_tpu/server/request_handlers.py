@@ -27,6 +27,10 @@ from plenum_tpu.common.txn_util import (
     get_from, get_payload_data, get_seq_no, get_txn_time)
 from plenum_tpu.server.database_manager import DatabaseManager
 
+from plenum_tpu.native import try_load_ext
+
+_fp = try_load_ext("fastpath")
+
 
 class RequestHandler(ABC):
     def __init__(self, database_manager: DatabaseManager, txn_type: str,
@@ -92,8 +96,13 @@ def nym_to_state_key(nym: str) -> bytes:
 
 
 def encode_state_value(value: dict, seq_no, txn_time) -> bytes:
-    return json.dumps({"val": value, "lsn": seq_no, "lut": txn_time},
-                      sort_keys=True, separators=(",", ":")).encode()
+    payload = {"val": value, "lsn": seq_no, "lut": txn_time}
+    if _fp is not None:
+        try:
+            return _fp.canonical_json_ascii(payload)
+        except TypeError:
+            pass
+    return json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
 
 
 def decode_state_value(data: bytes):

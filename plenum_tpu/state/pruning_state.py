@@ -14,6 +14,11 @@ from typing import List, Optional
 from plenum_tpu.common.serializers.base58 import b58encode
 from plenum_tpu.state.trie import BLANK_ROOT, Trie, verify_proof
 
+try:
+    from plenum_tpu.state.trie_native import NativeTrie as _TrieBackend
+except Exception:                      # pragma: no cover - cc missing
+    _TrieBackend = Trie
+
 
 class State(ABC):
     @abstractmethod
@@ -59,7 +64,7 @@ class PruningState(State):
             committed = bytes(kv.get(self.rootHashKey))
         except KeyError:
             committed = BLANK_ROOT
-        self._trie = Trie(kv, committed)
+        self._trie = _TrieBackend(kv, committed)
         self._committed_root = committed
 
     # ------------------------------------------------------------ writes
@@ -102,7 +107,7 @@ class PruningState(State):
 
     @property
     def committedHead(self):
-        return Trie(self._kv, self._committed_root)
+        return _TrieBackend(self._kv, self._committed_root)
 
     @property
     def headHash(self) -> bytes:

@@ -1,0 +1,171 @@
+"""Byte-exactness of the native fastpath module vs the Python
+implementations it replaces. Consensus digests, merkle roots, and wire
+frames depend on these being bit-identical across nodes — a node built
+with the C path must agree with one on the Python fallback.
+"""
+import hashlib
+import json
+import random
+import string
+
+import msgpack
+import pytest
+
+from plenum_tpu.native import build_and_import
+from plenum_tpu.common.serializers.serializers import _sort_deep
+from plenum_tpu.common.serializers import base58 as b58py
+
+fp = build_and_import("fastpath")
+
+
+def py_canonical_json(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(',', ':'),
+                      ensure_ascii=False).encode()
+
+
+def py_canonical_msgpack(obj) -> bytes:
+    return msgpack.packb(_sort_deep(obj), use_bin_type=True)
+
+
+def random_scalar(rng, for_json):
+    kind = rng.randrange(8 if for_json else 9)
+    if kind == 0:
+        return None
+    if kind == 1:
+        return rng.choice([True, False])
+    if kind == 2:
+        return rng.randrange(-2 ** 40, 2 ** 40)
+    if kind == 3:
+        # boundary ints exercise every msgpack width
+        return rng.choice([0, 1, -1, 31, 32, 127, 128, 255, 256, -32, -33,
+                           -128, -129, -32768, -32769, 2 ** 16 - 1, 2 ** 16,
+                           2 ** 32 - 1, 2 ** 32, 2 ** 63 - 1, -2 ** 63,
+                           2 ** 64 - 1])
+    if kind == 4:
+        return rng.choice([0.0, -0.5, 1.5, 3.141592653589793, 1e300,
+                           123456.789, -2.2250738585072014e-308])
+    if kind == 5:
+        n = rng.randrange(0, 40)
+        return ''.join(rng.choice(string.printable) for _ in range(n))
+    if kind == 6:
+        # non-ascii + escapes + long strings (str8/str16 widths)
+        return rng.choice(['ключ', '日本語', 'a"b\\c\n\t\x01\x1f',
+                           'x' * 31, 'y' * 32, 'z' * 255, 'w' * 256,
+                           'v' * 70000])
+    if kind == 7:
+        return rng.choice(string.ascii_letters) * rng.randrange(1, 5)
+    return bytes(rng.randrange(256)
+                 for _ in range(rng.choice([0, 1, 31, 255, 256, 300])))
+
+
+def random_tree(rng, depth, for_json):
+    if depth <= 0 or rng.random() < 0.4:
+        return random_scalar(rng, for_json)
+    if rng.random() < 0.5:
+        return {str(rng.randrange(1000)) + rng.choice(['', 'Ключ', '_k']):
+                random_tree(rng, depth - 1, for_json)
+                for _ in range(rng.randrange(0, 18))}
+    return [random_tree(rng, depth - 1, for_json)
+            for _ in range(rng.randrange(0, 18))]
+
+
+def test_canonical_json_matches_python():
+    rng = random.Random(7)
+    for _ in range(300):
+        obj = random_tree(rng, 4, for_json=True)
+        assert fp.canonical_json(obj) == py_canonical_json(obj), obj
+
+
+def test_canonical_json_ascii_matches_python():
+    rng = random.Random(77)
+    for _ in range(300):
+        obj = random_tree(rng, 4, for_json=True)
+        expect = json.dumps(obj, sort_keys=True,
+                            separators=(',', ':')).encode()
+        assert fp.canonical_json_ascii(obj) == expect, obj
+    # astral-plane code points exercise the surrogate-pair escape
+    obj = {"k": "\U0001f600 mixed ascii é"}
+    expect = json.dumps(obj, sort_keys=True, separators=(',', ':')).encode()
+    assert fp.canonical_json_ascii(obj) == expect
+
+
+def test_canonical_json_rejects_nonstr_keys():
+    with pytest.raises(TypeError):
+        fp.canonical_json({1: 2})
+
+
+def test_digest_hex_matches():
+    rng = random.Random(8)
+    for _ in range(100):
+        obj = random_tree(rng, 3, for_json=True)
+        expect = hashlib.sha256(py_canonical_json(obj)).hexdigest()
+        assert fp.digest_hex(obj) == expect
+
+
+def test_canonical_msgpack_matches_python():
+    rng = random.Random(9)
+    for _ in range(300):
+        obj = random_tree(rng, 4, for_json=False)
+        assert fp.canonical_msgpack(obj) == py_canonical_msgpack(obj), obj
+
+
+def test_msgpack_digest_hex_matches():
+    rng = random.Random(10)
+    for _ in range(50):
+        obj = random_tree(rng, 3, for_json=False)
+        expect = hashlib.sha256(py_canonical_msgpack(obj)).hexdigest()
+        assert fp.msgpack_digest_hex(obj) == expect
+
+
+def test_msgpack_large_collections():
+    big_list = list(range(70000))
+    assert fp.canonical_msgpack(big_list) == py_canonical_msgpack(big_list)
+    big_map = {"k%05d" % i: i for i in range(70000)}
+    assert fp.canonical_msgpack(big_map) == py_canonical_msgpack(big_map)
+
+
+def test_deep_eq_type_strict():
+    assert fp.deep_eq({"a": [1, {"b": "x"}]}, {"a": [1, {"b": "x"}]})
+    # == conflates these; the canonical serializers do not
+    assert not fp.deep_eq(1, True)
+    assert not fp.deep_eq(1, 1.0)
+    assert not fp.deep_eq([1], (1,))
+    assert not fp.deep_eq({"a": 1}, {"a": 1, "b": 2})
+    assert not fp.deep_eq({"a": 1}, {"b": 1})
+    assert not fp.deep_eq("1", 1)
+
+
+def test_deep_eq_matches_reference_impl():
+    from plenum_tpu.server.propagator import _strict_deep_eq_py
+    rng = random.Random(11)
+    for _ in range(200):
+        a = random_tree(rng, 3, for_json=False)
+        b = random_tree(rng, 3, for_json=False)
+        assert fp.deep_eq(a, b) == _strict_deep_eq_py(a, b)
+        assert fp.deep_eq(a, a)
+
+
+def test_sha256_matches_hashlib():
+    rng = random.Random(12)
+    for n in [0, 1, 55, 56, 63, 64, 65, 127, 128, 1000, 70000]:
+        data = bytes(rng.randrange(256) for _ in range(n))
+        assert fp.sha256(data) == hashlib.sha256(data).digest()
+        assert fp.sha256_hex(data) == hashlib.sha256(data).hexdigest()
+
+
+def test_b58_roundtrip_matches_python():
+    rng = random.Random(13)
+    for _ in range(200):
+        n = rng.choice([0, 1, 16, 20, 32, 33, 64])
+        data = bytes(rng.randrange(256) for _ in range(n))
+        if rng.random() < 0.3:
+            data = b"\x00" * rng.randrange(1, 4) + data[max(1, n // 2):]
+        enc = fp.b58encode(data)
+        assert enc == b58py._b58encode_raw(data)
+        assert fp.b58decode(enc) == data
+        assert b58py.b58decode(enc) == data
+
+
+def test_b58decode_rejects_bad_chars():
+    with pytest.raises(ValueError):
+        fp.b58decode("0OIl")

@@ -168,3 +168,24 @@ def test_faulty_backup_removed_locally(pool7):
     assert node.replicas.backup_ids == [1]
     # the master keeps ordering fine
     assert node.replicas[0].last_ordered[1] >= 1
+
+
+def test_removed_backup_gap_timer_goes_quiet(pool7):
+    """Removing a backup must stop its MessageReqService gap timer — a
+    leaked RepeatingTimer would keep firing _check_gaps on the shared
+    TimerService forever (regression: stop() was defined twice and the
+    network-unsubscribe body shadowed the timer stop)."""
+    nodes, net, timer = pool7
+    node = nodes[0]
+    replica = node.replicas[1]
+    fired = []
+    gap_timer = replica.message_req._gap_timer
+    orig = gap_timer._callback
+    gap_timer._callback = (
+        lambda: fired.append(timer.get_current_time()) or orig())
+    pump(timer, nodes, 3)
+    assert fired, "gap timer never fired while the backup was alive"
+    node.replicas.remove_backup(1)
+    fired.clear()
+    pump(timer, nodes, 5)
+    assert not fired, "removed backup's gap timer kept firing"

@@ -7,8 +7,6 @@ production transport — the rung-2 suite covers the protocol matrix.)
 """
 import asyncio
 
-import pytest
-
 from plenum_tpu.common.config import Config
 from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
 from plenum_tpu.crypto.signer import SimpleSigner
@@ -58,9 +56,16 @@ def test_view_change_over_real_sockets():
         assert await pump(everyone, 10, until=lambda: all(
             len(n.nodestack.connecteds) == 3 for n in everyone))
 
-        # a client writes through Beta (a non-primary, so it survives)
-        client = ClientConnection(nodes["Beta"].clientstack.ha,
-                                  expected_verkey=keys["Beta"].verkey_raw)
+        # wait until the pool agrees on a view-0 primary, then attach the
+        # client to a node that is NOT the primary — so killing the primary
+        # later can never eat the client's connection (and the second half
+        # of this test never self-skips)
+        assert await pump(everyone, 10, until=lambda: all(
+            n.node.master_primary_name for n in everyone))
+        primary0 = everyone[0].node.master_primary_name
+        client_node = next(n for n in NAMES if n != primary0)
+        client = ClientConnection(nodes[client_node].clientstack.ha,
+                                  expected_verkey=keys[client_node].verkey_raw)
         await client.connect()
         signer = SimpleSigner(seed=b"\x43" * 32)
 
@@ -78,7 +83,8 @@ def test_view_change_over_real_sockets():
             n.node.domain_ledger.size == 1 for n in everyone))
 
         # kill the primary: stop its stacks, never prod it again
-        primary_name = nodes["Beta"].node.master_primary_name
+        primary_name = nodes[client_node].node.master_primary_name
+        assert primary_name != client_node
         victim = nodes.pop(primary_name)
         await victim.nodestack.stop()
         await victim.clientstack.stop()
@@ -93,10 +99,7 @@ def test_view_change_over_real_sockets():
         assert all(n.node.master_primary_name == new_primary
                    for n in survivors)
 
-        # the pool still orders (Beta survived; resend through it if the
-        # dead primary ate the client's connection — it didn't)
-        if primary_name == "Beta":
-            pytest.skip("primary was the client's node")  # pragma: no cover
+        # the pool still orders (the client's node survived by construction)
         write(2)
         assert await pump(survivors, 20, until=lambda: all(
             n.node.domain_ledger.size == 2 for n in survivors)), \
