@@ -38,6 +38,13 @@ CLIENT_BATCH = int(os.environ.get("BENCH_CLIENT_BATCH", "1000"))
 MICRO_BATCH = int(os.environ.get("BENCH_BATCH", "16384"))
 NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
 SIM_EPOCH = 1600000000
+MP_TRUSTEE_SEED = b"\x42" * 32
+
+
+def make_mp_requests(n):
+    """Requests for the multi-process pool, authored by its trustee."""
+    from plenum_tpu.crypto.signer import DidSigner
+    return make_requests(n, DidSigner(seed=MP_TRUSTEE_SEED))
 
 
 def best_time(fn, runs=3):
@@ -154,6 +161,211 @@ def pipelined_intake(nodes, timer, chunks, client_id, deadline=None,
     drain_chunk(nodes, timer, None, target_size=injected,
                 deadline=deadline)
     return injected
+
+
+def run_multiprocess_pool(reqs, provider, run_label=""):
+    """Deployment-shaped north star: 4 node OS processes over the real
+    TCP stack (scripts/start_plenum_tpu_node from on-disk keys+genesis),
+    client broadcasting to all nodes, REPLYs counted per connection.
+
+    provider="remote": a verify daemon subprocess owns the TPU and fuses
+    all nodes' signature batches (server/verify_daemon.py).
+    provider="cpu": each node verifies locally via OpenSSL.
+
+    NOTE this host exposes ONE CPU core (os.cpu_count()==1): the 4 node
+    processes + client + daemon time-slice a single core, so this
+    measures the deployment shape's overheads honestly rather than any
+    multi-core speedup. → (elapsed, ordered)
+    """
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from plenum_tpu.bootstrap import generate_pool
+    base_dir = tempfile.mkdtemp(prefix="plenum_tpu_bench_")
+    procs = []
+    daemon_proc = None
+    # SIGTERM (driver timeout, operator Ctrl-C via term) must run the
+    # finally-cleanup below — otherwise node/daemon children outlive us
+    # and poison later runs' ports
+    prev_term = signal.signal(signal.SIGTERM,
+                              lambda s, f: sys.exit(143))
+    try:
+        base_port = 19000 + (os.getpid() % 400) * 10
+        # the bench client signs as the pool trustee (same seed), so NYM
+        # authorization passes under the real genesis authz rules
+        generate_pool(base_dir, NAMES, base_port=base_port,
+                      trustee_seed=MP_TRUSTEE_SEED)
+
+        daemon_port = base_port + 9
+        if provider == "remote":
+            ready = os.path.join(base_dir, "daemon_ready")
+            daemon_backend = os.environ.get("BENCH_DAEMON_BACKEND",
+                                            "adaptive")
+            daemon_proc = subprocess.Popen(
+                [sys.executable, "-m", "plenum_tpu.server.verify_daemon",
+                 "--port", str(daemon_port), "--backend", daemon_backend,
+                 "--ready-file", ready],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            deadline = time.perf_counter() + 60
+            while not os.path.exists(ready):
+                if time.perf_counter() > deadline or \
+                        daemon_proc.poll() is not None:
+                    raise RuntimeError("verify daemon failed to start")
+                time.sleep(0.1)
+            # warm the device bucket so XLA compile stays out of the
+            # timed window (the daemon compiles ONE fixed batch shape)
+            from plenum_tpu.crypto.fixtures import make_signed_batch
+            from plenum_tpu.crypto.remote_verifier import RemoteVerifier
+            rv = RemoteVerifier(("127.0.0.1", daemon_port), timeout=600)
+            wm, ws, wv = make_signed_batch(4096, seed=3)
+            assert all(rv.verify_batch(list(zip(wm, ws, wv))))
+            rv.close()
+
+        with open(os.path.join(base_dir, "plenum_tpu_config.py"), "w") as f:
+            f.write(
+                "Max3PCBatchSize = %d\n"
+                "Max3PCBatchWait = 0.05\n"
+                "CHK_FREQ = 10\n"
+                "LOG_SIZE = 30\n"
+                "CLIENT_TO_NODE_STACK_QUOTA = 4000\n"
+                "NODE_TO_NODE_STACK_QUOTA = 4096\n"
+                "NODE_TO_NODE_STACK_SIZE = %d\n"
+                "CLIENT_TO_NODE_STACK_SIZE = %d\n"
+                "VERIFIER_PROVIDER = %r\n"
+                "VERIFIER_DAEMON_PORT = %d\n"
+                % (CLIENT_BATCH, 16 << 20, 16 << 20, provider,
+                   daemon_port))
+
+        env = dict(os.environ)
+        # node processes must never touch the (process-exclusive) TPU —
+        # their device work lives in the daemon
+        env["JAX_PLATFORMS"] = "cpu"
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "start_plenum_tpu_node")
+        log_dir = os.environ.get("BENCH_MP_LOGS")  # debugging aid
+        for name in NAMES:
+            out = open(os.path.join(log_dir, name + ".log"), "w") \
+                if log_dir else subprocess.DEVNULL
+            procs.append(subprocess.Popen(
+                [sys.executable, script, "--name", name,
+                 "--base-dir", base_dir],
+                env=env, stdout=out, stderr=subprocess.STDOUT))
+
+        ordered, elapsed = _drive_mp_client(base_dir, reqs, procs)
+        return elapsed, ordered
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        for p in procs + ([daemon_proc] if daemon_proc else []):
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs + ([daemon_proc] if daemon_proc else []):
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+
+def _drive_mp_client(base_dir, reqs, procs):
+    """Async client: one encrypted connection per node, broadcast every
+    request, count REPLYs per connection. Done when EVERY node replied
+    to every request (whole pool committed). → (ordered, elapsed)."""
+    import asyncio
+
+    from plenum_tpu.bootstrap import (client_ha_from_pool_genesis,
+                                      registry_from_pool_genesis)
+    from plenum_tpu.network.stack import ClientConnection
+
+    registry = registry_from_pool_genesis(base_dir)
+    debug = os.environ.get("BENCH_MP_LOGS") is not None
+
+    def dbg(*a):
+        if debug:
+            print("[mp-client]", *a, flush=True)
+
+    async def drive():
+        conns = {}
+        deadline = time.perf_counter() + 120
+        for name in NAMES:
+            ha = client_ha_from_pool_genesis(base_dir, name)
+            while True:
+                conn = ClientConnection(
+                    ha, expected_verkey=registry[name].verkey)
+                try:
+                    await conn.connect()
+                    conns[name] = conn
+                    break
+                except OSError:
+                    if time.perf_counter() > deadline:
+                        raise RuntimeError(
+                            "node %s never came up" % name)
+                    await asyncio.sleep(0.5)
+
+        dbg("connected to all nodes")
+        # wait for the pool to elect a primary: probe with the first
+        # request until a REPLY arrives from every node
+        probe = reqs[0]
+        t_probe = time.perf_counter() + 120
+        while time.perf_counter() < t_probe:
+            for conn in conns.values():
+                conn.send(dict(probe))
+            await asyncio.sleep(1.0)
+            if all(any(m.get("op") == "REPLY" for m in c.rx)
+                   for c in conns.values()):
+                break
+        else:
+            raise RuntimeError("pool never ordered the probe request")
+        dbg("probe ordered")
+
+        t0 = time.perf_counter()
+        rest = reqs[1:]
+        required = frozenset(r["reqId"] for r in rest)
+        for conn in conns.values():
+            for r in rest:
+                conn.send(r)
+        dbg("blasted", len(rest), "to each node")
+        done_at = None
+        hard_deadline = time.perf_counter() + 600
+        seen = {n: set() for n in conns}
+        last_dbg = time.perf_counter()
+        import collections as _coll
+        all_ops = {n: _coll.Counter() for n in conns}
+        while time.perf_counter() < hard_deadline:
+            if debug and time.perf_counter() - last_dbg > 5:
+                last_dbg = time.perf_counter()
+                dbg("progress", {n: len(s) for n, s in seen.items()},
+                    "ops", {n: dict(c) for n, c in all_ops.items()})
+            for name, conn in conns.items():
+                for m in conn.rx:
+                    all_ops[name][m.get("op")] += 1
+                    if m.get("op") == "REPLY":
+                        # a write REPLY's result is the committed txn:
+                        # reqId lives in txn.metadata
+                        result = m.get("result", {})
+                        rid = result.get(
+                            "txn", {}).get("metadata", {}).get("reqId")
+                        if rid is None:
+                            rid = result.get("reqId")
+                        if rid in required:
+                            seen[name].add(rid)
+                conn.rx.clear()
+            if all(len(s) == len(required) for s in seen.values()):
+                done_at = time.perf_counter()
+                break
+            await asyncio.sleep(0.02)
+        for conn in conns.values():
+            conn.close()
+        if done_at is None:
+            return (min(len(s) for s in seen.values()),
+                    time.perf_counter() - t0)
+        return len(required), done_at - t0
+
+    return asyncio.run(drive())
 
 
 def run_pool(reqs, verifier_name):

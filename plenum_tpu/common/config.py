@@ -69,6 +69,17 @@ class Config:
     RETRY_TIMEOUT_RESTRICTED = 15
     MAX_RECONNECT_RETRY_ON_SAME_SOCKET = 1
 
+    # ---- client-signature verification provider (the TPU seam;
+    # crypto/batch_verifier.py). "remote" offloads to the verify daemon
+    # (server/verify_daemon.py) — the multi-process deployment shape,
+    # where one daemon process owns the accelerator for the whole host.
+    VERIFIER_PROVIDER = "adaptive"
+    VERIFIER_DAEMON_HOST = "127.0.0.1"
+    VERIFIER_DAEMON_PORT = 9988
+    # seconds a dispatched client-auth batch may stay in flight before
+    # the prod loop harvests it blocking (wedged daemon/device fallback)
+    CLIENT_AUTH_TIMEOUT = 10.0
+
     # ---- quotas per prod tick (reference stp_core/config.py:29+,
     # plenum/server/quota_control.py)
     NODE_TO_NODE_STACK_QUOTA = 1024

@@ -18,11 +18,21 @@ class MessageValidationError(InvalidNodeMessageException):
 class MessageBase:
     typename: str = None
     schema: Tuple[Tuple[str, FieldValidator], ...] = ()
+    # per-class caches derived from schema (set by __init_subclass__;
+    # rebuilding these per message construction dominated the hot wire
+    # path before)
+    _schema_names: Tuple[str, ...] = ()
+    _schema_name_set: frozenset = frozenset()
     # fields not included in the digest/signature
     _frozen = False
 
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        cls._schema_names = tuple(name for name, _ in cls.schema)
+        cls._schema_name_set = frozenset(cls._schema_names)
+
     def __init__(self, *args, **kwargs):
-        field_names = [name for name, _ in self.schema]
+        field_names = self._schema_names
         if len(args) > len(field_names):
             raise MessageValidationError(
                 "too many positional arguments for {}".format(self.typename))
@@ -31,7 +41,7 @@ class MessageBase:
             if k in values:
                 raise MessageValidationError(
                     "duplicate argument {} for {}".format(k, self.typename))
-            if k not in field_names:
+            if k not in self._schema_name_set:
                 raise MessageValidationError(
                     "unknown argument {} for {}".format(k, self.typename))
             values[k] = v
@@ -53,17 +63,17 @@ class MessageBase:
                     "validation error [{}]: {} ({}={})"
                     .format(type(self).__name__, err, name,
                             repr(values[name])[:128]))
-        for name, _ in self.schema:
+        for name in self._schema_names:
             object.__setattr__(self, name, values.get(name))
 
     def __setattr__(self, key, value):
-        if self._frozen and key in [n for n, _ in self.schema]:
+        if self._frozen and key in self._schema_name_set:
             raise AttributeError("message fields are immutable")
         object.__setattr__(self, key, value)
 
     @property
     def _field_names(self):
-        return tuple(name for name, _ in self.schema)
+        return self._schema_names
 
     def as_dict(self) -> Dict[str, Any]:
         """Plain-dict form of the payload, tuples normalized to lists so

@@ -30,6 +30,9 @@ class _Ready:
     def __init__(self, results: List[bool]):
         self._results = results
 
+    def ready(self) -> bool:
+        return True
+
     def collect(self) -> List[bool]:
         return self._results
 
@@ -42,6 +45,10 @@ class _PendingDevice:
         self._ok = ok_device
         self._valid = valid
         self._n = n
+
+    def ready(self) -> bool:
+        is_ready = getattr(self._ok, "is_ready", None)
+        return bool(is_ready()) if is_ready is not None else True
 
     def collect(self) -> List[bool]:
         import numpy as np
@@ -132,6 +139,13 @@ class _HubPending:
         self._lo = lo
         self._hi = hi
 
+    def ready(self) -> bool:
+        pending = self._gen.pending
+        if pending is None:
+            return False  # generation not flushed yet
+        r = getattr(pending, "ready", None)
+        return bool(r()) if r is not None else True
+
     def collect(self) -> List[bool]:
         self._hub._flush(self._gen)
         return self._gen.results()[self._lo:self._hi]
@@ -209,12 +223,18 @@ class CoalescingVerifierHub:
         return self.dispatch(items).collect()
 
 
+def _make_remote(**kwargs):
+    from plenum_tpu.crypto.remote_verifier import RemoteVerifier
+    return RemoteVerifier(**kwargs)
+
+
 _PROVIDERS = {
     "scalar": ScalarVerifier,
     "cpu": OpenSSLVerifier,
     "tpu_batch": JaxBatchVerifier,
     "tpu_hub": CoalescingVerifierHub,
     "adaptive": AdaptiveVerifier,
+    "remote": _make_remote,
 }
 
 

@@ -1,0 +1,148 @@
+"""RemoteVerifier — batch-verification provider that offloads to the
+verify daemon (server/verify_daemon.py) over a local socket.
+
+Same dispatch()/collect() interface as the in-process providers
+(crypto/batch_verifier.py), plus ready(): the node's prod loop polls it
+so the daemon round trip (device launch + tunnel RTT) overlaps consensus
+work instead of blocking a tick. The socket is plain blocking TCP used
+non-blockingly for reads; frames are length-prefixed msgpack (see the
+daemon's protocol doc).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Dict, List, Sequence, Tuple
+
+import msgpack
+
+LEN = struct.Struct("<I")
+
+VerifyItem = Tuple[bytes, bytes, bytes]
+
+
+class _RemotePending:
+    def __init__(self, verifier: "RemoteVerifier", req_id: int, n: int):
+        self._verifier = verifier
+        self._req_id = req_id
+        self._n = n
+
+    def ready(self) -> bool:
+        v = self._verifier
+        if self._req_id in v._results or v._sock is None:
+            return True
+        v._pump(block=False)
+        return self._req_id in v._results or v._sock is None
+
+    def collect(self) -> List[bool]:
+        v = self._verifier
+        while self._req_id not in v._results:
+            if v._sock is None:
+                v._results.setdefault(self._req_id, b"")
+                break
+            v._pump(block=True)
+        body = v._results.pop(self._req_id, b"")
+        # a short body (daemon rejected the frame, or the link dropped
+        # mid-request) fails the missing tail instead of crashing the
+        # caller's result slicing
+        return [i < len(body) and body[i] == 1 for i in range(self._n)]
+
+
+class RemoteVerifier:
+    """Failure policy: if the daemon drops or times out, every in-flight
+    request resolves to all-False (the node nacks those client requests;
+    clients resubmit) and the connection is re-dialed lazily on the next
+    dispatch — a daemon restart must never take the node's prod loop
+    down with an unhandled ConnectionError."""
+
+    name = "remote"
+
+    def __init__(self, addr: Tuple[str, int] = None, timeout: float = 30.0):
+        self._addr = addr or ("127.0.0.1", 9999)
+        self._timeout = timeout
+        self._sock = None
+        self._rx = b""
+        self._results: Dict[int, bytes] = {}
+        self._outstanding: Dict[int, int] = {}  # req_id -> item count
+        self._next_id = 0
+        self._connect()  # fail fast at construction: config error
+
+    def _connect(self):
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rx = b""
+
+    def _drop_link(self):
+        """Fail all in-flight requests and discard the socket."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        for req_id in list(self._outstanding):
+            self._results[req_id] = b""  # short body == all False
+            del self._outstanding[req_id]
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -------------------------------------------------------- dispatch
+
+    def dispatch(self, items: Sequence[VerifyItem]) -> _RemotePending:
+        self._next_id += 1
+        req_id = self._next_id
+        frame = msgpack.packb(
+            [req_id, [[bytes(m), bytes(s), bytes(vk)]
+                      for m, s, vk in items]], use_bin_type=True)
+        self._outstanding[req_id] = len(items)
+        try:
+            if self._sock is None:
+                self._connect()
+            self._sock.sendall(LEN.pack(len(frame)) + frame)
+        except OSError:
+            self._drop_link()
+        return _RemotePending(self, req_id, len(items))
+
+    def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        return self.dispatch(items).collect()
+
+    # ------------------------------------------------------------- recv
+
+    def _pump(self, block: bool):
+        if self._sock is None:
+            return  # dropped link already resolved everything to False
+        self._sock.settimeout(self._timeout if block else 0.0)
+        try:
+            while True:
+                chunk = self._sock.recv(1 << 20)
+                if not chunk:
+                    raise ConnectionError("verify daemon closed")
+                self._rx += chunk
+                self._drain_frames()
+                if block and self._results:
+                    return
+        except (BlockingIOError, socket.timeout):
+            if block:
+                self._drop_link()
+        except (ConnectionError, OSError):
+            self._drop_link()
+        finally:
+            if self._sock is not None:
+                self._sock.settimeout(self._timeout)
+
+    def _drain_frames(self):
+        while len(self._rx) >= 4:
+            (n,) = LEN.unpack(self._rx[:4])
+            if len(self._rx) < 4 + n:
+                return
+            req_id, body = msgpack.unpackb(self._rx[4:4 + n], raw=False)
+            self._rx = self._rx[4 + n:]
+            self._results[req_id] = body
+            self._outstanding.pop(req_id, None)

@@ -23,6 +23,9 @@ class CompactMerkleTree:
         # frontier: maximal full subtrees, descending height,
         # entries (start, height, hash)
         self._frontier: List[Tuple[int, int, bytes]] = []
+        # (size, root) — valid while _size matches (appends change _size;
+        # reset/load/copy set _size too, so size is the full invalidator)
+        self._root_cache: Optional[Tuple[int, bytes]] = None
 
     # ------------------------------------------------------------ state
 
@@ -39,12 +42,20 @@ class CompactMerkleTree:
 
     @property
     def root_hash(self) -> bytes:
+        # cached by size: callers re-read the root several times per
+        # batch (executor roots, audit txns, state checks) and each
+        # recompute is O(log n) hashes
+        cached = self._root_cache
+        if cached is not None and cached[0] == self._size:
+            return cached[1]
         if not self._frontier:
-            return self.hasher.hash_empty()
-        accum = self._frontier[-1][2]
-        for _, _, h in reversed(self._frontier[:-1]):
-            accum = self.hasher.hash_children(h, accum)
-        return accum
+            root = self.hasher.hash_empty()
+        else:
+            root = self._frontier[-1][2]
+            for _, _, h in reversed(self._frontier[:-1]):
+                root = self.hasher.hash_children(h, root)
+        self._root_cache = (self._size, root)
+        return root
 
     @property
     def root_hash_hex(self) -> str:
@@ -194,6 +205,7 @@ class CompactMerkleTree:
         hashes (reference recoverTreeFromHashStore)."""
         self._frontier = []
         self._size = tree_size
+        self._root_cache = None  # content replaced wholesale
         start = 0
         remaining = tree_size
         while remaining > 0:
@@ -216,6 +228,7 @@ class CompactMerkleTree:
     def reset(self):
         self._size = 0
         self._frontier = []
+        self._root_cache = None  # size alone can't invalidate a shrink
         self.hash_store.reset()
 
     def __repr__(self):

@@ -74,6 +74,19 @@ class Ledger:
 
     # ---------------------------------------------------------- commits
 
+    def add_quiet(self, txn: dict) -> int:
+        """Append a committed txn; returns its seqNo. The commit hot path:
+        no merkle-info dict is built — Replies fetch proofs on demand via
+        merkleInfo(seq_no), so computing root + audit-path b58 strings
+        per append (reference ledger.py:115 does) is wasted work."""
+        seq_no = self.seqNo + 1
+        append_txn_metadata(txn, seq_no=seq_no)
+        serialized = self.serialize_for_tree(txn)
+        self.tree.append(serialized)
+        self._store.put(_seq_key(seq_no), serialized)
+        self.seqNo = seq_no
+        return seq_no
+
     def add(self, txn: dict) -> dict:
         """Append a committed txn; returns merkle info (seqNo, rootHash,
         auditPath) (reference ledger.py:115)."""
@@ -119,7 +132,7 @@ class Ledger:
         committed = []
         first = self.seqNo + 1
         for txn in self.uncommittedTxns[:count]:
-            self.add(txn)
+            self.add_quiet(txn)
             committed.append(txn)
         self.uncommittedTxns = self.uncommittedTxns[count:]
         if not self.uncommittedTxns:

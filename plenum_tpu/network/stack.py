@@ -320,7 +320,17 @@ class NodeStack(StackBase):
         if old is not None:
             old.close()
         self._incoming[frm] = conn
-        await self._read_loop(conn, frm)
+        try:
+            await self._read_loop(conn, frm)
+        except (HandshakeError, ConnectionError, OSError,
+                asyncio.IncompleteReadError) as e:
+            # a bad frame (oversize, corrupt AEAD) must drop THIS link,
+            # not surface as an unhandled asyncio exception
+            logger.warning("%s: read from %s failed: %s",
+                           self.name, frm, e)
+            conn.close()
+            if self._incoming.get(frm) is conn:
+                del self._incoming[frm]
 
     async def _read_loop(self, conn: Connection, frm: str):
         while conn.alive:
@@ -524,7 +534,12 @@ class NodeStack(StackBase):
         frames = []
         group: List[bytes] = []
         group_size = 0
-        budget = self.msg_len_limit - 512  # batch-envelope overhead
+        budget = self.msg_len_limit - 512  # fixed envelope overhead
+        # each message inside the envelope also costs a msgpack bin
+        # header (≤5 bytes) — at thousands of small messages per batch
+        # that per-item overhead alone can push the sealed frame past
+        # the limit, so it must be part of the size accounting
+        PER_MSG = 8
         for m in msgs:
             if len(m) > self.msg_len_limit:
                 logger.error(
@@ -532,7 +547,7 @@ class NodeStack(StackBase):
                     "limit — dropped (%r...)", self.name, len(m),
                     self.msg_len_limit, m[:128])
                 continue
-            if len(m) > budget:
+            if len(m) + PER_MSG > budget:
                 # too big to share a batch envelope, but fine as its own
                 # raw frame (singletons are sent unenveloped)
                 if group:
@@ -540,11 +555,11 @@ class NodeStack(StackBase):
                     group, group_size = [], 0
                 frames.append(m)
                 continue
-            if group and group_size + len(m) > budget:
+            if group and group_size + len(m) + PER_MSG > budget:
                 frames.append(self._seal_batch(group))
                 group, group_size = [], 0
             group.append(m)
-            group_size += len(m)
+            group_size += len(m) + PER_MSG
         if group:
             frames.append(self._seal_batch(group))
         return frames

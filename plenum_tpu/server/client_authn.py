@@ -100,6 +100,26 @@ class CoreAuthNr(ClientAuthNr):
         pending = self._verifier.dispatch(all_items) if all_items else None
         return (list(reqs), spans, idrs_per_req, prep_errors, pending)
 
+    def flush(self) -> None:
+        """Start any coalesced device launch now (CoalescingVerifierHub);
+        no-op for providers without a coalescing window. A networked
+        node calls this right after its tick's dispatch — nothing else
+        co-resident will deepen the generation, and without the flush a
+        hub pending's ready() could never turn true."""
+        fn = getattr(self._verifier, "flush", None)
+        if fn is not None:
+            fn()
+
+    def batch_ready(self, handle) -> bool:
+        """Non-blocking: True when conclude_batch will not block on the
+        device/daemon (the prod loop polls this to overlap the round
+        trip with consensus work)."""
+        pending = handle[4]
+        if pending is None:
+            return True
+        r = getattr(pending, "ready", None)
+        return bool(r()) if r is not None else True
+
     def conclude_batch(self, handle) -> List[Optional[List[str]]]:
         """Phase 2 (blocking): harvest the device results."""
         reqs, spans, idrs_per_req, prep_errors, pending = handle

@@ -115,6 +115,44 @@ class NetworkedNode(Prodable):
     def _on_client_wire_msg(self, msg_dict: dict, client_id: str):
         self.node.process_client_request(msg_dict, client_id)
 
+    # Batched client intake with deferred harvest: each tick's client
+    # frames become ONE verifier dispatch (device batch / daemon frame);
+    # the result is harvested on a later tick once it has landed, so the
+    # verification round trip overlaps consensus work instead of
+    # blocking the prod loop (same pipelining the in-process bench pool
+    # gets from dispatch/conclude). While a batch is in flight, newly
+    # arrived frames BUFFER (never a blocking conclude inside prod —
+    # that would stall every consensus tick for a device round trip);
+    # the buffered frames become the next, deeper dispatch.
+    _pending_auth = None
+    _pending_since = None
+    _client_buf: list
+
+    def _collect_client_msgs(self) -> int:
+        import time as _time
+        buf = self.__dict__.setdefault("_client_buf", [])
+        count = self.clientstack.service(
+            lambda d, cid: buf.append((d, cid)),
+            quota=self.config.CLIENT_TO_NODE_STACK_QUOTA,
+            size_quota=self.config.CLIENT_TO_NODE_STACK_SIZE)
+        if self._pending_auth is not None:
+            # liveness fallback: a wedged daemon/device must not buffer
+            # forever — after the timeout, harvest blocking
+            if _time.monotonic() - self._pending_since > \
+                    self.config.CLIENT_AUTH_TIMEOUT:
+                pending, self._pending_auth = self._pending_auth, None
+                self.node.conclude_client_batch(pending)
+            else:
+                return count
+        if buf:
+            self._client_buf = []
+            self._pending_auth = self.node.dispatch_client_batch(buf)
+            self._pending_since = _time.monotonic()
+            # a coalescing provider (tpu_hub) needs an explicit flush to
+            # start its launch — in this process nothing else will
+            self.node.authnr.flush()
+        return count
+
     # -------------------------------------------------------- Prodable
 
     @property
@@ -140,14 +178,16 @@ class NetworkedNode(Prodable):
     async def prod(self, limit: int = None) -> int:
         """One tick (reference node.py:1037): rx quotas → consensus →
         timer → lifecycle → flush."""
+        # harvest a landed verification batch before taking new work
+        if self._pending_auth is not None and \
+                self.node.client_batch_ready(self._pending_auth):
+            pending, self._pending_auth = self._pending_auth, None
+            self.node.conclude_client_batch(pending)
         c = self.nodestack.service(
             self._on_node_wire_msg,
             quota=self.config.NODE_TO_NODE_STACK_QUOTA,
             size_quota=self.config.NODE_TO_NODE_STACK_SIZE)
-        c += self.clientstack.service(
-            self._on_client_wire_msg,
-            quota=self.config.CLIENT_TO_NODE_STACK_QUOTA,
-            size_quota=self.config.CLIENT_TO_NODE_STACK_SIZE)
+        c += self._collect_client_msgs()
         c += self.node.service()
         c += self.timer.service()
         self.nodestack.service_lifecycle()

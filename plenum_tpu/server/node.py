@@ -178,9 +178,21 @@ class Node:
             node_handler.reserved_aliases = \
                 lambda: self.pool_manager.seed_aliases
 
-        # ---- client authentication (TPU-batched seam)
+        # ---- client authentication (TPU-batched seam); the provider is
+        # config-selected: in-process device batching by default, or the
+        # host verify daemon in multi-process deployments
+        provider = getattr(self.config, "VERIFIER_PROVIDER", "adaptive")
+        verifier = None
+        if provider:
+            from plenum_tpu.crypto.batch_verifier import create_verifier
+            kwargs = {}
+            if provider == "remote":
+                kwargs["addr"] = (self.config.VERIFIER_DAEMON_HOST,
+                                  self.config.VERIFIER_DAEMON_PORT)
+            verifier = create_verifier(provider, **kwargs)
         self.authnr = CoreAuthNr(
-            verkey_provider=self._verkey_from_domain_state)
+            verkey_provider=self._verkey_from_domain_state,
+            verifier=verifier)
         self.req_authenticator = ReqAuthenticator()
         self.req_authenticator.register_authenticator(self.authnr)
 
@@ -639,6 +651,14 @@ class Node:
                                len(parsed))
         handle = self.authnr.dispatch_batch([r for r, _ in parsed])
         return (parsed, handle)
+
+    def client_batch_ready(self, pending) -> bool:
+        """True when conclude_client_batch will not block (device/daemon
+        result landed)."""
+        if pending is None:
+            return True
+        _, handle = pending
+        return self.authnr.batch_ready(handle)
 
     def conclude_client_batch(self, pending):
         """Phase 2: harvest device results, ack/nack, propagate."""

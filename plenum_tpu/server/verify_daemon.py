@@ -1,0 +1,217 @@
+"""Verification daemon — one process owns the accelerator, every node
+offloads ed25519 batch verification to it over a local socket.
+
+Deployment shape for multi-process pools on one host: the TPU is a
+process-exclusive device, so co-located node processes cannot each hold
+it. The daemon plays the role the CoalescingVerifierHub plays inside a
+single process (crypto/batch_verifier.py): requests from all connected
+nodes are coalesced within a small window into ONE fused device launch —
+the verify kernel is latency-bound, so k separate launches cost ~k× one
+fused launch — and results are scattered back per request.
+
+Pipelining: the device call runs on a single worker thread while the
+asyncio loop keeps reading frames, so batch k+1 accumulates during batch
+k's device round trip (the tunnel RTT is the dominant term on this
+hardware).
+
+Wire protocol (both directions): 4-byte little-endian length prefix +
+msgpack payload.
+  request : [req_id, [[msg, sig, vk], ...]]
+  response: [req_id, results_bytes]   (one 0/1 byte per item)
+
+Reference equivalence: the reference verifies inline through libsodium
+(plenum/server/client_authn.py:84); this daemon is the tpu-native
+replacement for that native-library seam at multi-process scale.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Tuple
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+LEN = struct.Struct("<I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class VerifyDaemon:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 backend: str = "adaptive", window: float = 0.002,
+                 bucket: int = 4096, cpu_floor: int = 512):
+        """bucket: device launches are chunked to EXACTLY this many items
+        (padded by repetition) so XLA compiles ONE batch shape — variable
+        shapes would hit a fresh ~100 s compile mid-run. cpu_floor:
+        fused batches below this take the OpenSSL path (a near-empty
+        device launch costs more than scalar verification). Both only
+        apply to device backends; backend="cpu" verifies directly."""
+        from plenum_tpu.crypto.batch_verifier import create_verifier
+        self.host = host
+        self.port = port
+        self._backend_name = backend
+        self._verifier = create_verifier(backend)
+        self._bucket = bucket
+        self._cpu_floor = cpu_floor
+        self._window = window
+        self._queue: asyncio.Queue = asyncio.Queue()
+        # one worker thread: device launches must serialize anyway, and a
+        # busy worker is exactly what lets the NEXT batch coalesce deeper
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._server = None
+        self._writers = set()
+        self.served = 0
+        self.launches = 0
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        asyncio.get_event_loop().create_task(self._batcher())
+        logger.info("verify daemon listening on %s:%d", self.host, self.port)
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            # close live node connections first: 3.12's wait_closed()
+            # waits for EVERY client connection, not just the listener
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+        self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------ conns
+
+    def _verify_bucketed(self, items):
+        """Fixed-shape device launches: chunk to `bucket` items (pad the
+        tail by repetition), dispatch every chunk async FIRST so the
+        launches pipeline through the device queue, then collect."""
+        if self._backend_name == "cpu" or self._bucket <= 0 \
+                or len(items) < self._cpu_floor:
+            return self._verifier.verify_batch(items)
+        b = self._bucket
+        chunks = [items[i:i + b] for i in range(0, len(items), b)]
+        if len(chunks[-1]) < b:
+            pad = chunks[-1][0]
+            chunks[-1] = chunks[-1] + [pad] * (b - len(chunks[-1]))
+        pendings = [self._verifier.dispatch(c) for c in chunks]
+        out = []
+        for p in pendings:
+            out.extend(p.collect())
+        return out[:len(items)]
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        self._writers.add(writer)
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (n,) = LEN.unpack(hdr)
+                if n > MAX_FRAME:
+                    logger.warning("oversized frame (%d); closing", n)
+                    break
+                payload = await reader.readexactly(n)
+                req_id, items = msgpack.unpackb(payload, raw=False)
+                await self._queue.put((writer, req_id, items))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    # ---------------------------------------------------------- batching
+
+    async def _batcher(self):
+        loop = asyncio.get_event_loop()
+        while True:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = loop.time() + self._window
+            while loop.time() < deadline:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    await asyncio.sleep(self._window / 4)
+            all_items: List[Tuple[bytes, bytes, bytes]] = []
+            spans = []
+            for _, _, items in batch:
+                lo = len(all_items)
+                try:
+                    all_items.extend(
+                        (bytes(m), bytes(s), bytes(vk))
+                        for m, s, vk in items)
+                except Exception:
+                    # malformed frame from one client: answer all-False
+                    # for ITS span; the batcher must survive (it serves
+                    # every node on the host)
+                    del all_items[lo:]
+                    logger.warning("malformed verify request", exc_info=True)
+                spans.append((lo, len(all_items) - lo))
+            # run on the worker thread so the loop keeps reading frames
+            # (batch k+1 coalesces during batch k's device round trip)
+            try:
+                results = await loop.run_in_executor(
+                    self._pool, self._verify_bucketed, all_items)
+            except Exception:
+                logger.warning("verify batch failed", exc_info=True)
+                results = [False] * len(all_items)
+            self.served += len(all_items)
+            self.launches += 1
+            for (writer, req_id, _), (lo, cnt) in zip(batch, spans):
+                body = bytes(bytearray(
+                    1 if results[lo + i] else 0 for i in range(cnt)))
+                frame = msgpack.packb([req_id, body], use_bin_type=True)
+                try:
+                    writer.write(LEN.pack(len(frame)) + frame)
+                except Exception:
+                    pass
+
+
+async def run_daemon(host="127.0.0.1", port=0, backend="adaptive",
+                     ready_file=None, window: float = 0.002,
+                     bucket: int = 4096, cpu_floor: int = 512):
+    daemon = VerifyDaemon(host, port, backend, window=window,
+                          bucket=bucket, cpu_floor=cpu_floor)
+    await daemon.start()
+    if ready_file:
+        with open(ready_file, "w") as f:
+            f.write(str(daemon.port))
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main():  # pragma: no cover - exercised via subprocess in bench
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--backend", default="adaptive")
+    ap.add_argument("--window", type=float, default=0.002)
+    ap.add_argument("--bucket", type=int, default=4096)
+    ap.add_argument("--cpu-floor", type=int, default=512)
+    ap.add_argument("--ready-file", default=None,
+                    help="write the bound port here once listening")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    if args.backend != "cpu":
+        # persistent XLA compile cache (must go through jax.config — the
+        # env var alone is inert here); saves ~100 s per bucket shape on
+        # every daemon start after the first
+        from plenum_tpu.ops import enable_persistent_compilation_cache
+        enable_persistent_compilation_cache()
+    asyncio.run(run_daemon(args.host, args.port, args.backend,
+                           args.ready_file, args.window, args.bucket,
+                           args.cpu_floor))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

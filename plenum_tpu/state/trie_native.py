@@ -13,9 +13,14 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from plenum_tpu.native import load_ext
+from plenum_tpu.native import try_load_ext
 
-_mpt = load_ext("mpt_c")
+_mpt = try_load_ext("mpt_c")
+if _mpt is None:
+    # honor the PLENUM_TPU_NO_NATIVE kill-switch (and missing-compiler
+    # environments): PruningState catches this import failure and falls
+    # back to the Python trie
+    raise ImportError("native MPT unavailable or disabled")
 
 BLANK_ROOT = _mpt.blank_root()
 
