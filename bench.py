@@ -204,12 +204,15 @@ def run_multiprocess_pool(reqs, provider, run_label=""):
             ready = os.path.join(base_dir, "daemon_ready")
             daemon_backend = os.environ.get("BENCH_DAEMON_BACKEND",
                                             "adaptive")
+            log_dir0 = os.environ.get("BENCH_MP_LOGS")
+            dout = open(os.path.join(log_dir0, "daemon.log"), "w") \
+                if log_dir0 else subprocess.DEVNULL
             daemon_proc = subprocess.Popen(
                 [sys.executable, "-m", "plenum_tpu.server.verify_daemon",
                  "--port", str(daemon_port), "--backend", daemon_backend,
                  "--ready-file", ready],
                 cwd=os.path.dirname(os.path.abspath(__file__)),
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+                stdout=dout, stderr=subprocess.STDOUT)
             deadline = time.perf_counter() + 60
             while not os.path.exists(ready):
                 if time.perf_counter() > deadline or \
@@ -592,6 +595,17 @@ def main():
     signer = SimpleSigner(seed=b"\x42" * 32)
     reqs = make_requests(POOL_REQS, signer)
 
+    # ---- deployment-shaped north star FIRST: it runs the TPU inside
+    # the verify-daemon SUBPROCESS, so it must finish before this
+    # process touches the (exclusive) device for the sim pool + micro
+    # benches. Both providers measured on the same multi-process shape.
+    mp_reqs = make_mp_requests(POOL_REQS)
+    mp_remote_elapsed, mp_remote_ordered = run_multiprocess_pool(
+        mp_reqs, "remote")
+    mp_cpu_elapsed, mp_cpu_ordered = run_multiprocess_pool(mp_reqs, "cpu")
+    mp_rate = mp_remote_ordered / mp_remote_elapsed
+    mp_cpu_rate = mp_cpu_ordered / mp_cpu_elapsed
+
     # TPU-batched pool (warm once so compile time stays out of the timing;
     # the hub fuses all 4 nodes' chunks, so warm every power-of-two
     # bucket the chunking can produce: full chunks AND the remainder)
@@ -627,17 +641,26 @@ def main():
     p25 = pool25_backlog()
 
     print(json.dumps({
-        "metric": "ordered write-reqs/s, 4-node pool, TPU-batched verify"
-                  " (n=%d, client_batch=%d)" % (POOL_REQS, CLIENT_BATCH),
-        "value": round(tpu_rate, 1),
+        "metric": "ordered write-reqs/s, 4-node MULTI-PROCESS pool over "
+                  "real TCP+AEAD, TPU verify daemon (n=%d; host has %d "
+                  "CPU core(s) shared by 4 nodes + daemon + client)"
+                  % (POOL_REQS, os.cpu_count() or 1),
+        "value": round(mp_rate, 1),
         "unit": "req/s",
-        "vs_baseline": round(tpu_rate / cpu_rate, 3),
+        "vs_baseline": round(mp_rate / mp_cpu_rate, 3),
         "baseline": {
-            "desc": "same pool, OpenSSL Ed25519 scalar verify"
-                    " (libsodium-equivalent CPU floor)",
-            "value": round(cpu_rate, 1),
+            "desc": "same multi-process pool, per-node OpenSSL Ed25519 "
+                    "verify (libsodium-equivalent CPU floor)",
+            "value": round(mp_cpu_rate, 1),
         },
         "secondary": {
+            "sim_pool": {
+                "desc": "in-process 4-node sim pool (round-2 comparable)"
+                        ": TPU hub vs OpenSSL",
+                "tpu_req_per_s": round(tpu_rate, 1),
+                "cpu_req_per_s": round(cpu_rate, 1),
+                "vs_cpu": round(tpu_rate / cpu_rate, 3),
+            },
             "ed25519_batch_verify_per_chip": round(device_rate, 1),
             "batch": MICRO_BATCH,
             "floors": {
