@@ -213,6 +213,8 @@ def run_multiprocess_pool(reqs, provider, run_label=""):
                  "--ready-file", ready],
                 cwd=os.path.dirname(os.path.abspath(__file__)),
                 stdout=dout, stderr=subprocess.STDOUT)
+            if dout is not subprocess.DEVNULL:
+                dout.close()  # the child holds its own copy
             deadline = time.perf_counter() + 60
             while not os.path.exists(ready):
                 if time.perf_counter() > deadline or \
@@ -251,12 +253,17 @@ def run_multiprocess_pool(reqs, provider, run_label=""):
                               "scripts", "start_plenum_tpu_node")
         log_dir = os.environ.get("BENCH_MP_LOGS")  # debugging aid
         for name in NAMES:
-            out = open(os.path.join(log_dir, name + ".log"), "w") \
+            # provider in the filename so back-to-back remote/cpu runs
+            # don't clobber each other's logs
+            out = open(os.path.join(
+                log_dir, "%s.%s.log" % (name, provider)), "w") \
                 if log_dir else subprocess.DEVNULL
             procs.append(subprocess.Popen(
                 [sys.executable, script, "--name", name,
                  "--base-dir", base_dir],
                 env=env, stdout=out, stderr=subprocess.STDOUT))
+            if out is not subprocess.DEVNULL:
+                out.close()
 
         ordered, elapsed = _drive_mp_client(base_dir, reqs, procs)
         return elapsed, ordered
@@ -478,7 +485,7 @@ def pool25_backlog():
 
     n_nodes = int(os.environ.get("BENCH_P25_NODES", "25"))
     backlog = int(os.environ.get("BENCH_P25_BACKLOG", "50000"))
-    wall_budget = float(os.environ.get("BENCH_P25_WALL", "90"))
+    wall_budget = float(os.environ.get("BENCH_P25_WALL", "240"))
     # config 5 keeps its own batch size: headline tuning must not
     # silently reshape this workload across rounds
     batch = int(os.environ.get("BENCH_P25_BATCH", "500"))

@@ -88,6 +88,24 @@ class Propagate(MessageBase):
     )
 
 
+class PropagateBatch(MessageBase):
+    """Many PROPAGATEs in one wire message (no reference equivalent —
+    the reference sends one PROPAGATE per request, plenum/server/
+    propagator.py:204, and amortizes only at the ZMQ frame layer).
+    At n nodes every request is handled n-1 times per node; batching at
+    the MESSAGE level amortizes handler dispatch, validation, and sim/
+    transport delivery across a whole tick of requests — the difference
+    between the 25-node pool collapsing and draining. `clients` uses ""
+    for requests whose submitting client is unknown."""
+
+    typename = "PROPAGATE_BATCH"
+    schema = (
+        ("requests", IterableField(AnyMapField(), min_length=1)),
+        # "" = submitting client unknown (relay hop)
+        ("clients", IterableField(AnyField())),
+    )
+
+
 # ----------------------------------------------------------------- 3PC
 
 class PrePrepare(MessageBase):

@@ -27,7 +27,8 @@ from plenum_tpu.common.constants import (
 from plenum_tpu.common.exceptions import InvalidClientMessageException
 from plenum_tpu.common.messages.client_request import ClientMessageValidator
 from plenum_tpu.common.messages.node_messages import (
-    Ordered, Propagate, Reject, Reply, RequestAck, RequestNack)
+    Ordered, Propagate, PropagateBatch, Reject, Reply, RequestAck,
+    RequestNack)
 from plenum_tpu.common.request import Request
 from plenum_tpu.common.txn_util import (
     get_payload_data, get_seq_no, get_txn_time)
@@ -264,6 +265,8 @@ class Node:
             name, self.replica.data.quorums, network,
             forward_handler=self._forward_finalised)
         network.subscribe(Propagate, self.propagator.process_propagate)
+        network.subscribe(PropagateBatch,
+                          self.propagator.process_propagate_batch)
 
         self._validator = ClientMessageValidator()
 
@@ -675,6 +678,8 @@ class Node:
                     reason="signature verification failed"))
                 continue
             self._accept_write(request, client_id)
+        # ship the whole intake batch's propagates as one wire message
+        self.propagator.flush()
 
     def _process_write(self, request: Request, client_id: str):
         try:
@@ -953,6 +958,9 @@ class Node:
     def service(self):
         """One prod tick: all protocol instances (master + backups)."""
         with self.metrics.measure_time(MetricsName.NODE_PROD_TIME):
+            # propagates queued this tick (intake + batch echoes) leave
+            # as ONE PROPAGATE_BATCH before consensus work runs
+            self.propagator.flush()
             return self.replicas.service()
 
     # ------------------------------------------------------- inspection

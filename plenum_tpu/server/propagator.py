@@ -10,11 +10,24 @@ from __future__ import annotations
 import logging
 from typing import Callable, Dict, Optional, Set
 
-from plenum_tpu.common.messages.node_messages import Propagate
+from plenum_tpu.common.messages.node_messages import (
+    Propagate, PropagateBatch)
 from plenum_tpu.common.request import Request
 from plenum_tpu.consensus.quorums import Quorums
 
 logger = logging.getLogger(__name__)
+
+
+def _payload_size(payload: dict) -> int:
+    """Serialized size estimate for batch budgeting (exact when the C
+    canonical packer is available; conservative otherwise)."""
+    if _fp is not None:
+        try:
+            return len(_fp.canonical_msgpack(payload)) + 16
+        except TypeError:
+            pass
+    # no packer: assume the worst entry the budget still accepts 40 of
+    return 3 * 1024
 
 
 def _strict_deep_eq_py(a, b) -> bool:
@@ -73,38 +86,34 @@ class Requests(dict):
 
     def __init__(self):
         super().__init__()
-        self._by_ref: dict = {}          # (identifier, reqId) → digest
+        # (identifier, reqId) → ReqState, straight to the state object:
+        # the propagate hot path must not pay a second dict hop through
+        # the digest
+        self._by_ref: dict = {}
 
     def add(self, req: Request) -> ReqState:
-        if req.key not in self:
-            self[req.key] = ReqState(req)
-            self._by_ref[(req.identifier, req.reqId)] = req.key
-        return self[req.key]
+        key = req.key
+        state = self.get(key)
+        if state is None:
+            state = self[key] = ReqState(req)
+            self._by_ref[(req.identifier, req.reqId)] = state
+        return state
 
-    def add_propagate(self, req: Request, sender: str):
-        state = self.add(req)
-        state.propagates.add(sender)
-
-    def lookup_payload(self, payload: dict) -> Optional[Request]:
-        """Cheap pre-digest lookup: the stored Request if `payload` is
+    def lookup_state(self, payload: dict) -> Optional[ReqState]:
+        """Cheap pre-digest lookup: the stored ReqState if `payload` is
         bit-for-bit the request we already hold, else None. Equality is
         TYPE-STRICT deep comparison — the digest's canonical
         serialization distinguishes True/1/1.0, so plain dict equality
         (which conflates them) would let a byzantine re-gossip count as
         a vote for the original digest; any mismatch falls back to the
         full digest path."""
-        digest = self._by_ref.get((payload.get("identifier"),
-                                   payload.get("reqId")))
-        if digest is None:
-            return None
-        state = self.get(digest)
+        state = self._by_ref.get((payload.get("identifier"),
+                                  payload.get("reqId")))
         if state is None:
             return None
         if state.payload is None:
             state.payload = state.request.as_dict()
-        if _strict_deep_eq(state.payload, payload):
-            return state.request
-        return None
+        return state if _strict_deep_eq(state.payload, payload) else None
 
     def votes(self, req_key: str) -> int:
         state = self.get(req_key)
@@ -122,11 +131,20 @@ class Requests(dict):
         state = self.pop(req_key, None)
         if state is not None:
             ref = (state.request.identifier, state.request.reqId)
-            if self._by_ref.get(ref) == req_key:
+            if self._by_ref.get(ref) is state:
                 del self._by_ref[ref]
 
 
 class Propagator:
+    # upper bound on entries per PROPAGATE_BATCH; the size budget below
+    # is the real wire guard
+    BATCH_LIMIT = 200
+    # serialized-payload budget per batch: MSG_LEN_LIMIT (128 KiB) minus
+    # generous envelope/AEAD headroom — chunking by count alone would
+    # let large operations (multi-KB ATTRIB raws) build a frame the
+    # stack drops wholesale, silently losing every propagate in it
+    BATCH_SIZE_BUDGET = 128 * 1024 - 8 * 1024
+
     def __init__(self, name: str, quorums: Quorums, network,
                  forward_handler: Callable[[Request], None]):
         """network: ExternalBus; forward_handler: called exactly once per
@@ -136,6 +154,11 @@ class Propagator:
         self._network = network
         self._forward = forward_handler
         self.requests = Requests()
+        # queued outgoing propagates, flushed as PROPAGATE_BATCH once
+        # per tick: at n validators every request is otherwise its own
+        # message n-1 times per node — batching is what lets wide pools
+        # (25 nodes) drain instead of drowning in per-message overhead
+        self._out: list = []
 
     def update_quorums(self, quorums: Quorums):
         self.quorums = quorums
@@ -143,30 +166,87 @@ class Propagator:
     # ----------------------------------------------------------- sending
 
     def propagate(self, request: Request, client_name: Optional[str]):
-        """Broadcast our PROPAGATE for this request (reference :204)."""
+        """Queue our PROPAGATE for this request (reference :204 sends
+        immediately; here it rides the next flush's batch)."""
         state = self.requests.add(request)
         if self.name in state.propagates:
             return
         state.propagates.add(self.name)
-        self._network.send(Propagate(request=request.as_dict(),
-                                     senderClient=client_name))
+        self._queue_out(request.as_dict(), client_name)
         self._try_finalise(request.key)
+
+    def _queue_out(self, payload: dict, client_name) -> None:
+        self._out.append((payload, client_name, _payload_size(payload)))
+
+    def flush(self) -> int:
+        """Send everything queued since the last flush, chunked under
+        BOTH an entry-count cap and a serialized-size budget so no batch
+        can exceed the transport frame limit. Called once per prod tick
+        (and right after a client intake batch concludes). → messages
+        queued count."""
+        if not self._out:
+            return 0
+        out, self._out = self._out, []
+
+        def send_chunk(chunk):
+            if len(chunk) == 1:
+                self._network.send(Propagate(request=chunk[0][0],
+                                             senderClient=chunk[0][1]))
+            else:
+                self._network.send(PropagateBatch(
+                    requests=[r for r, _, _ in chunk],
+                    clients=[c or "" for _, c, _ in chunk]))
+
+        chunk, chunk_size = [], 0
+        for entry in out:
+            size = entry[2]
+            if chunk and (len(chunk) >= self.BATCH_LIMIT
+                          or chunk_size + size > self.BATCH_SIZE_BUDGET):
+                send_chunk(chunk)
+                chunk, chunk_size = [], 0
+            chunk.append(entry)
+            chunk_size += size
+        if chunk:
+            send_chunk(chunk)
+        return len(out)
 
     # ---------------------------------------------------------- receiving
 
     def process_propagate(self, msg: Propagate, frm: str):
-        request = self.requests.lookup_payload(msg.request)
-        if request is None:
-            request = Request.from_dict(msg.request)
-        self.requests.add_propagate(request, frm)
+        self._process_one(msg.request, msg.senderClient, frm)
+
+    def process_propagate_batch(self, msg: PropagateBatch, frm: str):
+        clients = msg.clients or [""] * len(msg.requests)
+        if len(clients) != len(msg.requests):
+            # malformed (byzantine?) batch: dropping it silently via zip
+            # truncation would make a protocol violation invisible
+            logger.warning(
+                "%s: PROPAGATE_BATCH from %s with %d requests but %d "
+                "clients — discarded", self.name, frm,
+                len(msg.requests), len(clients))
+            return
+        for payload, client in zip(msg.requests, clients):
+            self._process_one(payload, client or None, frm)
+
+    def _process_one(self, payload: dict, sender_client, frm: str):
+        # ONE state lookup per propagate: at n validators this handler
+        # runs (n-1) times per request per node — every extra dict hop
+        # or digest-property access in here is multiplied by that
+        state = self.requests.lookup_state(payload)
+        if state is None:
+            state = self.requests.add(Request.from_dict(payload))
+        propagates = state.propagates
+        propagates.add(frm)
         # echo our own propagate if we haven't yet (so slow clients still
         # reach quorum via node-to-node gossip)
-        state = self.requests[request.key]
-        if self.name not in state.propagates:
-            state.propagates.add(self.name)
-            self._network.send(Propagate(request=msg.request,
-                                         senderClient=msg.senderClient))
-        self._try_finalise(request.key)
+        if self.name not in propagates:
+            propagates.add(self.name)
+            self._queue_out(payload, sender_client)
+        if not state.forwarded and \
+                self.quorums.propagate.is_reached(len(propagates)):
+            state.finalised = True
+            state.forwarded = True
+            self._forward(state.request)
 
     def _try_finalise(self, req_key: str):
         state = self.requests.get(req_key)
