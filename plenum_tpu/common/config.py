@@ -127,6 +127,25 @@ class Config:
     # ---- metrics
     METRICS_COLLECTOR_TYPE = None
 
+    # ---- plugins (reference plenum/config.py:164
+    # notifierEventTriggeringConfig + SpikeEventsEnabled; plugin dirs
+    # from plenum/server/plugin_loader.py usage)
+    NOTIFIER_EVENTS_ENABLED = True
+    SPIKE_EVENTS_ENABLED = False      # reference default: off
+    SPIKE_EVENTS_FREQ = 60            # seconds between spike samples
+    SPIKE_EVENT_TRIGGERING = {
+        "NodeRequestSuspiciousSpike": {
+            "bounds_coeff": 10, "min_cnt": 15,
+            "min_activity_threshold": 10,
+            "use_weighted_bounds_coeff": True, "enabled": True},
+        "ClusterThroughputSuspiciousSpike": {
+            "bounds_coeff": 10, "min_cnt": 15,
+            "min_activity_threshold": 10,
+            "use_weighted_bounds_coeff": True, "enabled": True},
+    }
+    NOTIFIER_PLUGINS_DIR = None       # dir of notifier*.py/plugin*.py
+    PLUGINS_DIR = None                # dir of typed plugin*.py classes
+
     # ---- TAA
     TXN_AUTHOR_AGREEMENT_EXPIRATION = None
 

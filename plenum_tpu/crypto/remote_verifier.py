@@ -10,13 +10,21 @@ daemon's protocol doc).
 """
 from __future__ import annotations
 
+import logging
 import socket
 import struct
+import time
 from typing import Dict, List, Sequence, Tuple
 
 import msgpack
 
+logger = logging.getLogger(__name__)
+
 LEN = struct.Struct("<I")
+# re-dial pacing: a dead daemon must not turn every dispatch into a
+# blocking connect attempt on the prod loop
+RECONNECT_COOLDOWN = 1.0
+RECONNECT_TIMEOUT = 0.5
 
 VerifyItem = Tuple[bytes, bytes, bytes]
 
@@ -40,7 +48,10 @@ class _RemotePending:
             if v._sock is None:
                 v._results.setdefault(self._req_id, b"")
                 break
-            v._pump(block=True)
+            # block until THIS request's frame lands — returning on just
+            # any response would mis-handle out-of-order harvest when
+            # more than one request is in flight
+            v._pump(block=True, until=self._req_id)
         body = v._results.pop(self._req_id, b"")
         # a short body (daemon rejected the frame, or the link dropped
         # mid-request) fails the missing tail instead of crashing the
@@ -65,11 +76,26 @@ class RemoteVerifier:
         self._results: Dict[int, bytes] = {}
         self._outstanding: Dict[int, int] = {}  # req_id -> item count
         self._next_id = 0
-        self._connect()  # fail fast at construction: config error
+        self._last_dial_fail = 0.0
+        # initial connect is best-effort: in multi-process deployments
+        # the daemon may come up after the node (start-ordering race,
+        # daemon restart); dispatch() re-dials lazily, so construction
+        # must not hard-fail
+        try:
+            self._connect()
+        except OSError as e:
+            logger.warning(
+                "verify daemon at %s:%d not reachable at construction "
+                "(%s) — will re-dial on first dispatch", self._addr[0],
+                self._addr[1], e)
+            self._sock = None
+            self._last_dial_fail = time.monotonic()
 
-    def _connect(self):
-        self._sock = socket.create_connection(self._addr,
-                                              timeout=self._timeout)
+    def _connect(self, timeout: float = None):
+        self._sock = socket.create_connection(
+            self._addr, timeout=self._timeout if timeout is None
+            else timeout)
+        self._sock.settimeout(self._timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rx = b""
 
@@ -104,9 +130,25 @@ class RemoteVerifier:
         self._outstanding[req_id] = len(items)
         try:
             if self._sock is None:
-                self._connect()
+                # paced, short-timeout re-dial: the prod loop must not
+                # block up to self._timeout per intake batch while the
+                # daemon host is black-holing SYNs
+                if time.monotonic() - self._last_dial_fail \
+                        < RECONNECT_COOLDOWN:
+                    raise OSError("verify daemon re-dial cooling down")
+                self._connect(timeout=RECONNECT_TIMEOUT)
+                logger.info("reconnected to verify daemon at %s:%d",
+                            self._addr[0], self._addr[1])
             self._sock.sendall(LEN.pack(len(frame)) + frame)
-        except OSError:
+        except OSError as e:
+            if self._sock is None:
+                self._last_dial_fail = time.monotonic()
+                logger.warning("verify daemon at %s:%d unavailable (%s); "
+                               "failing batch of %d", self._addr[0],
+                               self._addr[1], e, len(items))
+            else:
+                logger.warning("verify daemon link lost (%s); failing "
+                               "in-flight requests", e)
             self._drop_link()
         return _RemotePending(self, req_id, len(items))
 
@@ -115,7 +157,10 @@ class RemoteVerifier:
 
     # ------------------------------------------------------------- recv
 
-    def _pump(self, block: bool):
+    def _pump(self, block: bool, until: int = None):
+        """Read frames. block=False drains whatever is buffered;
+        block=True reads until the `until` req_id arrives (or, with no
+        target, until anything does) or the timeout drops the link."""
         if self._sock is None:
             return  # dropped link already resolved everything to False
         self._sock.settimeout(self._timeout if block else 0.0)
@@ -126,7 +171,8 @@ class RemoteVerifier:
                     raise ConnectionError("verify daemon closed")
                 self._rx += chunk
                 self._drain_frames()
-                if block and self._results:
+                if block and (until in self._results if until is not None
+                              else bool(self._results)):
                     return
         except (BlockingIOError, socket.timeout):
             if block:

@@ -270,6 +270,29 @@ class Node:
 
         self._validator = ClientMessageValidator()
 
+        # ---- plugin seams: notifier event push + typed plugins
+        # (reference notifier_plugin_manager.py:24, plugin_loader.py:25)
+        from plenum_tpu.server.plugins import (
+            PLUGIN_TYPE_STATS_CONSUMER, PLUGIN_TYPE_VERIFICATION,
+            NotifierPluginManager, PluginLoader)
+        self.notifier = NotifierPluginManager(
+            node_name=name,
+            enabled=self.config.NOTIFIER_EVENTS_ENABLED,
+            spike_configs=self.config.SPIKE_EVENT_TRIGGERING
+            if self.config.SPIKE_EVENTS_ENABLED else None)
+        if self.config.NOTIFIER_PLUGINS_DIR:
+            self.notifier.load_from_dir(self.config.NOTIFIER_PLUGINS_DIR)
+        self.plugin_loader = None
+        self._verification_plugins: List = []
+        self._stats_plugins: List = []
+        if self.config.PLUGINS_DIR:
+            self.plugin_loader = PluginLoader(self.config.PLUGINS_DIR)
+            self._verification_plugins = self.plugin_loader.get(
+                PLUGIN_TYPE_VERIFICATION)
+            self._stats_plugins = self.plugin_loader.get(
+                PLUGIN_TYPE_STATS_CONSUMER)
+        self._request_spike_accum = 0
+
         # ---- performance + primary-connection monitoring
         from plenum_tpu.common.messages.internal_messages import (
             NewViewAccepted, VoteForViewChange)
@@ -302,11 +325,19 @@ class Node:
         def _check_master_degraded():
             if self.mode_participating and self.monitor.is_master_degraded():
                 self.monitor.reset()
+                self.notifier.send_cluster_degraded()
                 self.replica.internal_bus.send(
                     VoteForViewChange(suspicion="MASTER_DEGRADED"))
         self._degradation_timer = RepeatingTimer(
             timer, self.config.ThroughputWindowSize,
             _check_master_degraded)
+        # periodic spike sampling + stats-consumer push (reference
+        # node.py:2552 checkNodeRequestSpike / monitor.py:643
+        # checkPerformance), only scheduled when someone listens
+        self._spike_timer = None
+        if self.config.SPIKE_EVENTS_ENABLED or self._stats_plugins:
+            self._spike_timer = RepeatingTimer(
+                timer, self.config.SPIKE_EVENTS_FREQ, self._sample_spikes)
         from plenum_tpu.server.replicas import BackupInstanceFaultyProcessor
         self.backup_faulty_processor = BackupInstanceFaultyProcessor(
             self.replicas, self.monitor, self.config)
@@ -506,6 +537,12 @@ class Node:
                         ts_store.set(txn_time, ledger.strToHash(root_b58),
                                      lid)
         self._adopt_3pc_from_audit()
+        if audit.size > 0:
+            # a non-empty audit ledger at construction == restart from
+            # persisted state; observers may want to know (reference
+            # notifier restart/upgrade-complete events)
+            self.notifier.send_cluster_restart(
+                "Resumed at audit seq %d." % audit.size)
         # backup primaries resume their persisted pp_seq_no (master
         # recovers via catchup; see last_sent_pp_store.try_restore)
         self.last_sent_pp_store.try_restore(self)
@@ -699,17 +736,61 @@ class Node:
                 identifier=request.identifier or "unknown",
                 reqId=request.reqId or 0, reason=str(e)))
             return
-        # dedup: already committed?
+        # dedup: already committed? (must precede the plugin veto —
+        # resubmission of a committed request returns its Reply even if
+        # a later-installed plugin would now reject the operation)
         existing = self._committed_reply(request)
         if existing is not None:
             self._reply_to_client(client_id, existing)
             return
+        # VERIFICATION plugins veto operations by raising (reference
+        # plugin_loader.py:41 — Node calls each plugin's verify(msg) on
+        # client requests)
+        for plugin in self._verification_plugins:
+            try:
+                plugin.verify(request.operation)
+            except Exception as e:
+                self._reply_to_client(client_id, RequestNack(
+                    identifier=request.identifier or "unknown",
+                    reqId=request.reqId or 0,
+                    reason="plugin rejected: %s" % e))
+                return
+        self._request_spike_accum += 1
         self._req_clients[request.key] = client_id
         self._reply_to_client(client_id, RequestAck(
             identifier=request.identifier or "unknown",
             reqId=request.reqId or 0))
         self.monitor.request_received(request.key)
         self.propagator.propagate(request, client_id)
+
+    def _sample_spikes(self):
+        """One periodic sample per stream: client-request intake count
+        (reference node.py:2561 sendNodeRequestSpike) and master EMA
+        throughput (reference monitor.py:645 sendClusterThroughputSpike);
+        STATS_CONSUMER plugins get the same snapshot."""
+        from plenum_tpu.server.plugins import (
+            TOPIC_CLUSTER_THROUGHPUT_SPIKE, TOPIC_NODE_REQUEST_SPIKE)
+        reqs = self._request_spike_accum
+        self._request_spike_accum = 0
+        if self.mode_participating:
+            self.notifier.send_spike_check(TOPIC_NODE_REQUEST_SPIKE, reqs)
+            thr = self.monitor.instance_throughput(0)
+            if thr is not None:
+                self.notifier.send_spike_check(
+                    TOPIC_CLUSTER_THROUGHPUT_SPIKE, thr)
+        if self._stats_plugins:
+            stats = {"node": self.name,
+                     "requests_in_window": reqs,
+                     "total_ordered": self.monitor.total_ordered,
+                     "avg_latency": self.monitor.avg_latency(),
+                     "master_throughput":
+                         self.monitor.instance_throughput(0)}
+            for plugin in self._stats_plugins:
+                try:
+                    plugin.consume_stats(stats)
+                except Exception:
+                    logger.error("stats plugin %r failed", plugin,
+                                 exc_info=True)
 
     def _process_action(self, request: Request, client_id: str):
         """Authenticated action: validated + executed locally, no

@@ -10,6 +10,8 @@ from __future__ import annotations
 import logging
 from typing import Callable, Dict, Optional, Set
 
+import msgpack
+
 from plenum_tpu.common.messages.node_messages import (
     Propagate, PropagateBatch)
 from plenum_tpu.common.request import Request
@@ -20,14 +22,20 @@ logger = logging.getLogger(__name__)
 
 def _payload_size(payload: dict) -> int:
     """Serialized size estimate for batch budgeting (exact when the C
-    canonical packer is available; conservative otherwise)."""
+    canonical packer is available; real msgpack size otherwise — a flat
+    guess would under-count multi-KB ATTRIB raws, letting a batch exceed
+    the transport frame limit and be dropped wholesale)."""
     if _fp is not None:
         try:
             return len(_fp.canonical_msgpack(payload)) + 16
         except TypeError:
             pass
-    # no packer: assume the worst entry the budget still accepts 40 of
-    return 3 * 1024
+    try:
+        return len(msgpack.packb(payload, use_bin_type=True)) + 16
+    except Exception:
+        # unpackable oddity: assume the worst entry the budget accepts
+        # 40 of rather than dropping the propagate entirely
+        return 3 * 1024
 
 
 def _strict_deep_eq_py(a, b) -> bool:

@@ -37,6 +37,10 @@ logger = logging.getLogger(__name__)
 
 LEN = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
+# per-connection response backlog past which the peer is declared stalled
+# and dropped: the daemon serves every node on the host, so one wedged
+# reader must not buffer the others' memory away
+WRITE_HIGH_WATER = 8 * 1024 * 1024
 
 
 class VerifyDaemon:
@@ -117,7 +121,15 @@ class VerifyDaemon:
                     logger.warning("oversized frame (%d); closing", n)
                     break
                 payload = await reader.readexactly(n)
-                req_id, items = msgpack.unpackb(payload, raw=False)
+                try:
+                    req_id, items = msgpack.unpackb(payload, raw=False)
+                except Exception:
+                    # garbage frame: close THIS connection cleanly; an
+                    # escaped decode error would kill the reader task
+                    # with an unretrieved-exception warning instead
+                    logger.warning("undecodable frame; closing",
+                                   exc_info=True)
+                    break
                 await self._queue.put((writer, req_id, items))
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
@@ -135,12 +147,19 @@ class VerifyDaemon:
         while True:
             first = await self._queue.get()
             batch = [first]
+            # event-driven coalescing: sleep exactly until the next frame
+            # or the window deadline — a polling loop would burn the one
+            # CPU core the node processes need
             deadline = loop.time() + self._window
-            while loop.time() < deadline:
+            while True:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
                 try:
-                    batch.append(self._queue.get_nowait())
-                except asyncio.QueueEmpty:
-                    await asyncio.sleep(self._window / 4)
+                    batch.append(await asyncio.wait_for(
+                        self._queue.get(), remaining))
+                except asyncio.TimeoutError:
+                    break
             all_items: List[Tuple[bytes, bytes, bytes]] = []
             spans = []
             for _, _, items in batch:
@@ -171,7 +190,24 @@ class VerifyDaemon:
                     1 if results[lo + i] else 0 for i in range(cnt)))
                 frame = msgpack.packb([req_id, body], use_bin_type=True)
                 try:
+                    if writer.transport.is_closing():
+                        continue
                     writer.write(LEN.pack(len(frame)) + frame)
+                    # bounded buffering without stalling the batcher on
+                    # one slow peer: a connection whose response backlog
+                    # passes the high-water mark is aborted (abort, not
+                    # close — close would keep the backlog alive trying
+                    # to flush it to the stalled reader). Its node fails
+                    # in-flight requests to all-False and re-dials — see
+                    # RemoteVerifier's failure policy.
+                    if writer.transport.get_write_buffer_size() \
+                            > WRITE_HIGH_WATER:
+                        logger.warning(
+                            "dropping stalled verify client "
+                            "(write backlog %d bytes)",
+                            writer.transport.get_write_buffer_size())
+                        self._writers.discard(writer)
+                        writer.transport.abort()
                 except Exception:
                     pass
 

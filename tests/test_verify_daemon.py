@@ -114,6 +114,150 @@ def test_remote_verifier_survives_daemon_death():
     asyncio.run(main())
 
 
+def test_daemon_drops_stalled_client_bounded_memory(monkeypatch):
+    """A client that sends requests but never reads its responses must
+    not buffer the daemon's memory away: once the per-connection write
+    backlog passes the high-water mark the connection is dropped, and
+    the observed backlog never exceeds mark + one frame."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    import msgpack as msgpack_mod
+
+    from plenum_tpu.server import verify_daemon as vd_mod
+
+    HWM = 32 * 1024
+    monkeypatch.setattr(vd_mod, "WRITE_HIGH_WATER", HWM)
+
+    async def main():
+        daemon = VerifyDaemon(backend="cpu", window=0.001)
+        await daemon.start()
+        loop = asyncio.get_event_loop()
+
+        sock = socket_mod.socket()
+        # tiny receive window so the daemon's sends back up quickly
+        sock.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF, 4096)
+        await loop.run_in_executor(
+            None, sock.connect, ("127.0.0.1", daemon.port))
+        for _ in range(50):
+            if daemon._writers:
+                break
+            await asyncio.sleep(0.01)
+        assert daemon._writers
+        writer = next(iter(daemon._writers))
+        dsock = writer.get_extra_info("socket")
+        dsock.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_SNDBUF, 4096)
+
+        # 40 requests x 5000 garbage items -> ~5 KB response each, never
+        # read by the client
+        items = [[b"x" * 32, b"y" * 64, b"z" * 32]] * 5000
+        max_backlog = 0
+
+        def send_all():
+            for i in range(40):
+                frame = msgpack_mod.packb([i + 1, items], use_bin_type=True)
+                sock.sendall(struct_mod.pack("<I", len(frame)) + frame)
+
+        send_task = loop.run_in_executor(None, send_all)
+        frame_bound = 8 * 1024  # one response frame is well under this
+        dropped = False
+        for _ in range(2000):
+            max_backlog = max(max_backlog,
+                              writer.transport.get_write_buffer_size())
+            if writer not in daemon._writers:
+                dropped = True
+                break
+            await asyncio.sleep(0.005)
+        assert dropped, "stalled client was never dropped " \
+            f"(max backlog {max_backlog})"
+        assert max_backlog <= HWM + frame_bound, max_backlog
+        try:
+            sock.close()
+        except OSError:
+            pass
+        try:
+            await asyncio.wait_for(send_task, 5)
+        except Exception:
+            pass
+
+        # the daemon still serves a healthy client afterwards
+        rv = await loop.run_in_executor(
+            None, lambda: RemoteVerifier(("127.0.0.1", daemon.port)))
+        results = await loop.run_in_executor(
+            None, rv.verify_batch, make_items(5))
+        assert results == [True] * 5
+        rv.close()
+        await daemon.stop()
+
+    asyncio.run(main())
+
+
+def test_daemon_survives_undecodable_frame():
+    """A frame whose payload isn't valid msgpack closes THAT connection
+    cleanly (documented close-and-log path) without killing the daemon."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    async def main():
+        daemon = VerifyDaemon(backend="cpu", window=0.001)
+        await daemon.start()
+        loop = asyncio.get_event_loop()
+        sock = socket_mod.socket()
+        await loop.run_in_executor(
+            None, sock.connect, ("127.0.0.1", daemon.port))
+        junk = b"\xc1\xff\x00garbage-not-msgpack"
+        await loop.run_in_executor(
+            None, sock.sendall, struct_mod.pack("<I", len(junk)) + junk)
+        # daemon closes this connection...
+        got = await loop.run_in_executor(None, sock.recv, 1)
+        assert got == b""
+        sock.close()
+        # ...and keeps serving others
+        rv = await loop.run_in_executor(
+            None, lambda: RemoteVerifier(("127.0.0.1", daemon.port)))
+        results = await loop.run_in_executor(
+            None, rv.verify_batch, make_items(3, tamper={1}))
+        assert results == [True, False, True]
+        rv.close()
+        await daemon.stop()
+
+    asyncio.run(main())
+
+
+def test_remote_verifier_tolerates_daemon_starting_late():
+    """Node-before-daemon start ordering: construction with nothing
+    listening must not raise; the first dispatch after the daemon
+    arrives reconnects and succeeds."""
+    import socket as socket_mod
+
+    async def main():
+        loop = asyncio.get_event_loop()
+        # find a free port, then construct against it while closed
+        probe = socket_mod.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        rv = await loop.run_in_executor(
+            None, lambda: RemoteVerifier(("127.0.0.1", port), timeout=2.0))
+        assert rv._sock is None  # tolerated, not raised
+        # dispatch with daemon still down: resolves all-False, no raise
+        p = await loop.run_in_executor(None, rv.dispatch, make_items(2))
+        assert await loop.run_in_executor(None, p.collect) == [False, False]
+        daemon = VerifyDaemon(port=port, backend="cpu", window=0.001)
+        await daemon.start()
+        # the re-dial pacer refuses connect attempts for RECONNECT_COOLDOWN
+        # after a failure — wait it out before expecting success
+        from plenum_tpu.crypto.remote_verifier import RECONNECT_COOLDOWN
+        await asyncio.sleep(RECONNECT_COOLDOWN + 0.1)
+        results = await loop.run_in_executor(
+            None, rv.verify_batch, make_items(4, tamper={2}))
+        assert results == [True, True, False, True]
+        rv.close()
+        await daemon.stop()
+
+    asyncio.run(main())
+
+
 def test_networked_pool_orders_via_remote_daemon():
     """Rung-3: a 4-node pool over real sockets with
     VERIFIER_PROVIDER=remote orders client writes through the daemon —
