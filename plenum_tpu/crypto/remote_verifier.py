@@ -128,14 +128,19 @@ class RemoteVerifier:
             [req_id, [[bytes(m), bytes(s), bytes(vk)]
                       for m, s, vk in items]], use_bin_type=True)
         self._outstanding[req_id] = len(items)
+        if self._sock is None and time.monotonic() - self._last_dial_fail \
+                < RECONNECT_COOLDOWN:
+            # paced re-dial: fail this batch WITHOUT touching the
+            # cooldown clock — refreshing it here would push the expiry
+            # forward on every dispatch and starve reconnection forever
+            # under sustained traffic
+            self._drop_link()
+            return _RemotePending(self, req_id, len(items))
         try:
             if self._sock is None:
-                # paced, short-timeout re-dial: the prod loop must not
-                # block up to self._timeout per intake batch while the
-                # daemon host is black-holing SYNs
-                if time.monotonic() - self._last_dial_fail \
-                        < RECONNECT_COOLDOWN:
-                    raise OSError("verify daemon re-dial cooling down")
+                # short-timeout re-dial: the prod loop must not block up
+                # to self._timeout per intake batch while the daemon
+                # host is black-holing SYNs
                 self._connect(timeout=RECONNECT_TIMEOUT)
                 logger.info("reconnected to verify daemon at %s:%d",
                             self._addr[0], self._addr[1])

@@ -109,6 +109,52 @@ class RequestTimeTracker:
         self._started.clear()
 
 
+class ClientLatencyMeasurement:
+    """Per-client EMA latency (reference latency_measurements.py:17
+    EMALatencyMeasurementForEachClient + MedianHighStrategy): one EMA
+    per client identifier; the pool-level figure is the high median
+    across clients so a single fast client can't mask slow service to
+    the rest."""
+
+    MAX_CLIENTS = 1000  # LRU bound: identifiers are client-chosen, so
+    # an unbounded map is an attacker-controlled allocation
+
+    def __init__(self, min_latency_count: int = 10):
+        from collections import OrderedDict
+        self.min_latency_count = min_latency_count
+        self.alpha = 1.0 / (min_latency_count + 1)
+        # identifier → (ordered_count, ema_latency_seconds), LRU-ordered
+        self.avg_latencies: "OrderedDict[str, tuple]" = OrderedDict()
+        self.total_reqs = 0
+
+    def add_duration(self, identifier: str, duration: float):
+        cnt, ema = self.avg_latencies.get(identifier, (0, 0.0))
+        self.avg_latencies[identifier] = (
+            cnt + 1, ema * (1 - self.alpha) + duration * self.alpha)
+        self.avg_latencies.move_to_end(identifier)
+        while len(self.avg_latencies) > self.MAX_CLIENTS:
+            self.avg_latencies.popitem(last=False)
+        self.total_reqs += 1
+
+    def get_avg_latency(self) -> Optional[float]:
+        if self.total_reqs < self.min_latency_count:
+            return None
+        lats = sorted(ema for _, ema in self.avg_latencies.values())
+        return lats[len(lats) // 2]  # high median
+
+    def per_client(self, limit: int = 100) -> Dict[str, dict]:
+        """Snapshot of the busiest `limit` clients (full map stays
+        internal — validator-info dumps must stay bounded)."""
+        busiest = sorted(self.avg_latencies.items(),
+                         key=lambda kv: -kv[1][0])[:limit]
+        return {ident: {"count": cnt, "avg": round(ema, 6)}
+                for ident, (cnt, ema) in busiest}
+
+    def reset(self):
+        self.avg_latencies.clear()
+        self.total_reqs = 0
+
+
 class Monitor:
     def __init__(self, name: str, timer: TimerService, bus,
                  config: Optional[Config] = None,
@@ -121,6 +167,8 @@ class Monitor:
         # per-instance throughput, instance 0 = master
         self.throughputs: Dict[int, EMAThroughputMeasurement] = {}
         self.request_tracker = RequestTimeTracker()
+        self.client_latencies = ClientLatencyMeasurement(
+            self.config.MIN_LATENCY_COUNT)
         self.latencies = deque(maxlen=50)
         self.total_ordered = 0
         self._warm = False
@@ -139,7 +187,8 @@ class Monitor:
         self.request_tracker.start(digest,
                                    self._timer.get_current_time())
 
-    def request_ordered(self, digest: str, inst_id: int = 0):
+    def request_ordered(self, digest: str, inst_id: int = 0,
+                        identifier: str = None):
         now = self._timer.get_current_time()
         self._throughput(inst_id).add_request(now)
         if inst_id != 0:
@@ -149,6 +198,8 @@ class Monitor:
         latency = self.request_tracker.order(digest, now)
         if latency is not None:
             self.latencies.append(latency)
+            if identifier:
+                self.client_latencies.add_duration(identifier, latency)
             self.total_ordered += 1
             self._warm = self._warm or \
                 self.total_ordered >= self.config.MIN_LATENCY_COUNT
@@ -158,6 +209,7 @@ class Monitor:
         self.throughputs.clear()
         self.request_tracker.reset()
         self.latencies.clear()
+        self.client_latencies.reset()
 
     # --------------------------------------------------------- judgments
 

@@ -140,6 +140,12 @@ class Node:
         self.name = name
         self.config = config or Config()
         self.metrics = metrics or NullMetricsCollector()
+        # GC pause/throughput feed (reference gc_trackers.py): one
+        # process-wide hook, weakly attached — only worth the callback
+        # when a real collector will persist it
+        if metrics is not None:
+            from plenum_tpu.utils.gc_tracker import GcTimeTracker
+            GcTimeTracker.instance().attach(self.metrics)
         self.observable = Observable()
         self.timer = timer
         self.network = network
@@ -862,7 +868,8 @@ class Node:
         ledger = self.db_manager.get_ledger(ordered.ledgerId)
         for txn in committed_txns or []:
             seq_no = get_seq_no(txn)
-            from plenum_tpu.common.txn_util import get_payload_digest, get_digest
+            from plenum_tpu.common.txn_util import (
+                get_digest, get_from, get_payload_digest)
             payload_digest = get_payload_digest(txn)
             if payload_digest:
                 self.seq_no_db.put(
@@ -870,7 +877,8 @@ class Node:
                     "{}:{}".format(ordered.ledgerId, seq_no).encode())
             digest = get_digest(txn)
             if digest:
-                self.monitor.request_ordered(digest, ordered.instId)
+                self.monitor.request_ordered(digest, ordered.instId,
+                                             identifier=get_from(txn))
                 self._rejected_digests.pop(digest, None)
             client_id = self._req_clients.pop(digest, None)
             if client_id is not None and self._clients_attached:

@@ -55,9 +55,35 @@ class ValidatorNodeInfoTool:
             },
             "Pool_info": self._pool_info(),
             "Software": {"plenum_tpu": _version()},
+            "Memory_info": self._memory_info(),
+            "Latencies": self._latencies(),
             "Metrics": (self._metrics.summary()
                         if self._metrics is not None
                         and hasattr(self._metrics, "summary") else {}),
+        }
+
+    def _memory_info(self) -> dict:
+        """Process RSS + GC behavior (reference gc_trackers.py; the
+        reference's validator-info memory section reads psutil — here
+        it's /proc + the process-wide GcTimeTracker totals)."""
+        from plenum_tpu.utils.gc_tracker import (
+            GcTimeTracker, process_memory_info)
+        out = dict(process_memory_info())
+        out["gc"] = GcTimeTracker.instance().snapshot()
+        return out
+
+    def _latencies(self) -> dict:
+        """Pool- and per-client request latency (reference
+        latency_measurements.py:17 — per-client EMAs, high-median
+        aggregate)."""
+        monitor = getattr(self._node, "monitor", None)
+        if monitor is None:
+            return {}
+        cl = monitor.client_latencies
+        return {
+            "Avg_latency_s": monitor.avg_latency(),
+            "Clients_avg_latency_s": cl.get_avg_latency(),
+            "Per_client": cl.per_client(),
         }
 
     def _replicas_status(self) -> dict:

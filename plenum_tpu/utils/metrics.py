@@ -30,6 +30,14 @@ class MetricsName(IntEnum):
     CATCHUP_TXNS_RECEIVED = 30
     # transport
     TRANSPORT_BATCH_SIZE = 50     # messages per outbox flush
+    # garbage collector (reference gc_trackers.py GcTimeTracker): the
+    # three *_TIME names MUST stay consecutive — the tracker indexes
+    # them as GC_GEN0_TIME + generation
+    GC_GEN0_TIME = 60             # seconds paused in a gen-0 collection
+    GC_GEN1_TIME = 61
+    GC_GEN2_TIME = 62
+    GC_COLLECTED_OBJECTS = 63     # objects freed per collection
+    GC_UNCOLLECTABLE_OBJECTS = 64
 
 
 class ValueAccumulator:
