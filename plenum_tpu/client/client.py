@@ -7,6 +7,10 @@ the client too, because rung-2/3 testing and the ops scripts need one:
 - submit to all nodes (or a subset), track REQACK / REQNACK / REJECT
 - confirm a request once f+1 nodes return matching Reply results
   (Quorums.reply — the BFT read quorum on write acks)
+- accept a state-proof-bearing read from ONE node when the proof's
+  BLS multi-signature verifies against the pool's registered keys
+  (reference read_request_handler.py:39-56 attaches the multi-sig
+  precisely so clients don't need f+1 matching answers)
 - timer-driven resubmission of unconfirmed requests
 
 Transport-agnostic: `send_fn(node_name, msg_dict)` is injected — the
@@ -46,6 +50,7 @@ class RequestStatus:
         self.replies: Dict[str, dict] = {}   # node -> result
         self.confirmed_result: Optional[dict] = None
         self.failed: bool = False            # terminally nacked/rejected
+        self.proven: bool = False            # accepted via state proof
 
     @property
     def key(self):
@@ -61,11 +66,18 @@ class PoolClient:
     def __init__(self, wallet: Wallet, node_names: Sequence[str],
                  send_fn: Callable[[str, dict], None],
                  timer: TimerService = None,
-                 resubmit_interval: float = 15.0):
+                 resubmit_interval: float = 15.0,
+                 bls_verifier=None,
+                 bls_key_provider: Callable[[str], Optional[str]] = None):
+        """bls_verifier + bls_key_provider(node_name → BLS pk) enable
+        single-node trust for proof-bearing reads; without them every
+        read needs the f+1 matching-reply quorum."""
         self.wallet = wallet
         self.node_names = list(node_names)
         self._send = send_fn
         self.quorums = Quorums(len(self.node_names))
+        self._bls_verifier = bls_verifier
+        self._bls_keys = bls_key_provider
         self._pending: Dict[tuple, RequestStatus] = {}
         self._completed: Dict[tuple, RequestStatus] = {}
         self._resubmitter = None
@@ -166,6 +178,18 @@ class PoolClient:
         if status is None:
             return
         status.replies[node_name] = result
+        # a verified state proof makes THIS single reply trustworthy:
+        # the multi-sig (n-f nodes) vouches for the root, the proof
+        # nodes tie the value to the root — no reply quorum needed. The
+        # proof is only trusted for the REQUEST's own question: a reply
+        # whose dest/type differ from what we asked carries a possibly
+        # valid proof of the wrong fact (single-node substitution)
+        if self._proof_answers_request(status.request, result) \
+                and self.verify_state_proof(result):
+            status.confirmed_result = result
+            status.proven = True
+            self._completed[key] = self._pending.pop(key)
+            return
         by_fp: Dict[str, List[str]] = {}
         for node, res in status.replies.items():
             by_fp.setdefault(_result_fingerprint(res), []).append(node)
@@ -174,6 +198,120 @@ class PoolClient:
                 status.confirmed_result = status.replies[nodes[0]]
                 self._completed[key] = self._pending.pop(key)
                 break
+
+    # ----------------------------------------------------- state proofs
+
+    @staticmethod
+    def _proof_answers_request(req: Request, result: dict) -> bool:
+        """The proof path is only valid when the result claims to answer
+        exactly the operation we asked: same read type, same dest.
+        Writes and mismatched reads always go through the reply
+        quorum — otherwise one malicious node could 'confirm' a pending
+        request with a valid proof of some unrelated fact."""
+        from plenum_tpu.common.constants import TARGET_NYM, TXN_TYPE
+        op = req.operation or {}
+        if op.get(TXN_TYPE) != "105":
+            return False
+        return (isinstance(result, dict)
+                and result.get(TXN_TYPE) == "105"
+                and result.get("dest") == op.get(TARGET_NYM))
+
+    def verify_state_proof(self, result: dict,
+                           max_age: Optional[float] = None,
+                           now: Optional[float] = None) -> bool:
+        """True iff `result` carries a state proof whose BLS multi-sig
+        verifies against n-f registered pool keys AND whose proof nodes
+        tie the claimed value to the signed root. Every check fails
+        closed: a reply that can't be proven simply falls back to the
+        reply quorum.
+
+        max_age (seconds, with `now`) additionally rejects proofs whose
+        multi-sig timestamp is older than the window — without it a
+        single node can serve provably-stale state (a valid multi-sig
+        over an OLD root, e.g. an absence proof predating a committed
+        write). Leave it None for historical (timestamped) queries,
+        where an old root is the point."""
+        if self._bls_verifier is None or self._bls_keys is None:
+            return False
+        if not isinstance(result, dict):
+            return False
+        from plenum_tpu.common.constants import (
+            DOMAIN_LEDGER_ID, MULTI_SIGNATURE, PROOF_NODES, ROOT_HASH,
+            STATE_PROOF)
+        sp = result.get(STATE_PROOF)
+        if not isinstance(sp, dict) or MULTI_SIGNATURE not in sp:
+            return False
+        # 1. cheap shape checks first — no pairing work for a reply
+        # that could never be proof-confirmed
+        kv = self._expected_state_kv(result)
+        if kv is None:
+            return False
+        state_key, state_value = kv
+        try:
+            from plenum_tpu.crypto.bls import MultiSignature
+            multi = MultiSignature.from_dict(sp[MULTI_SIGNATURE])
+        except Exception:
+            return False
+        # 2. the multi-sig must vouch for exactly the proof's root, on
+        # the ledger this read serves, and recently enough
+        if multi.value.state_root_hash != sp.get(ROOT_HASH):
+            return False
+        if multi.value.ledger_id != DOMAIN_LEDGER_ID:
+            return False
+        if max_age is not None:
+            ts = multi.value.timestamp
+            ref = now if now is not None else __import__("time").time()
+            if not isinstance(ts, (int, float)) or ref - ts > max_age:
+                return False
+        # 3. enough distinct, registered signers (n-f)
+        participants = list(multi.participants)
+        if len(set(participants)) != len(participants):
+            return False
+        if not self.quorums.bls_signatures.is_reached(len(participants)):
+            return False
+        keys = []
+        for name in participants:
+            pk = self._bls_keys(name)
+            if pk is None:
+                return False
+            keys.append(pk)
+        # 4. the aggregated signature itself (the expensive pairing)
+        try:
+            if not self._bls_verifier.verify_multi_sig(
+                    multi.signature, multi.value.as_single_value(), keys):
+                return False
+        except Exception:
+            return False
+        # 5. proof nodes: claimed value (or absence) under the root
+        try:
+            from plenum_tpu.common.serializers.base58 import b58decode
+            from plenum_tpu.state.pruning_state import PruningState
+            root = b58decode(sp[ROOT_HASH])
+            nodes = PruningState.deserialize_proof(sp[PROOF_NODES])
+            return PruningState.verify_state_proof(
+                root, state_key, state_value, nodes)
+        except Exception:
+            return False
+
+    @staticmethod
+    def _expected_state_kv(result: dict):
+        """(state_key, expected_encoded_value|None) for a read result,
+        or None when the result type has no state mapping. The encoding
+        must match the write handler's byte-for-byte (GET_NYM:
+        request_handlers.nym_to_state_key / encode_state_value)."""
+        from plenum_tpu.common.constants import TXN_TYPE
+        if result.get(TXN_TYPE) != "105":
+            return None
+        dest = result.get("dest")
+        if not isinstance(dest, str) or not dest:
+            return None
+        from plenum_tpu.server.request_handlers import (
+            encode_state_value, nym_to_state_key)
+        key = nym_to_state_key(dest)
+        if result.get("data") is None:
+            return key, None  # proof of absence
+        return key, encode_state_value(result["data"], result.get("seqNo"),
+                                       result.get("txnTime"))
 
     # ----------------------------------------------------------- query
 

@@ -115,6 +115,10 @@ class Config:
     reqIdToTxnStorage = "memory"
     nodeStatusStorage = "memory"
 
+    # ---- BLS (networked nodes derive the signer from the transport
+    # seed; False skips BLS share generation/aggregation entirely)
+    BLS_SIGN = True
+
     # ---- TPU crypto dispatch (new — the north-star gated boundary)
     # provider: 'cpu' (scalar C path via `cryptography`) or 'tpu_batch'
     # (JAX batched kernels). 'auto' picks by queue depth.

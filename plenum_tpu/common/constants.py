@@ -97,6 +97,12 @@ SERVICES = "services"
 BLS_KEY = "blskey"
 BLS_KEY_PROOF = "blskey_pop"
 
+# state-proof reply keys (reference plenum/common/constants.py:128-141)
+STATE_PROOF = "state_proof"
+ROOT_HASH = "root_hash"
+PROOF_NODES = "proof_nodes"
+MULTI_SIGNATURE = "multi_signature"
+
 # --- Audit txn fields (reference plenum/common/constants.py AUDIT_TXN_*)
 AUDIT_TXN_VIEW_NO = "viewNo"
 AUDIT_TXN_PP_SEQ_NO = "ppSeqNo"

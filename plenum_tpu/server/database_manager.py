@@ -21,6 +21,10 @@ class DatabaseManager:
         self.databases: Dict[int, Database] = {}
         self.stores: Dict[str, object] = {}
         self._init_hooks = []
+        # state_root → MultiSignature store, set by the Node when BLS is
+        # enabled; read handlers attach it to state proofs (reference
+        # plenum/server/database_manager.py:112 bls_store property)
+        self.bls_store = None
 
     def register_new_database(self, lid: int, ledger: Ledger,
                               state: Optional[State] = None,

@@ -53,11 +53,19 @@ class NetworkedNode(Prodable):
         # the stack outboxes
         self.bus = ExternalBus(send_handler=self._send_to_nodes)
         validators = sorted(registry)
+        # BLS signer derived from the same seed the transport identity
+        # uses — deterministic, so it matches the blskey the bootstrap
+        # scripts put in the genesis NODE txn (bootstrap.py:58)
+        bls_signer = None
+        if getattr(self.config, "BLS_SIGN", True):
+            from plenum_tpu.crypto.bls import BlsCryptoSignerPlenum
+            bls_signer, _ = BlsCryptoSignerPlenum.generate(keys.seed)
         self.node = Node(name, validators, self.timer, self.bus,
                          config=self.config,
                          storage_factory=storage_factory,
                          client_reply_handler=self._reply_to_client,
                          genesis_txns=genesis_txns,
+                         bls_signer=bls_signer,
                          metrics=metrics)
 
         # periodic metrics flush + validator-info dump (reference

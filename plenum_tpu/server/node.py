@@ -22,8 +22,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from plenum_tpu.common.config import Config
 from plenum_tpu.common.constants import (
-    AUDIT_LEDGER_ID, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID, GET_TXN, NODE,
-    NYM, POOL_LEDGER_ID, VERKEY)
+    AUDIT_LEDGER_ID, BLS_KEY, CONFIG_LEDGER_ID, DOMAIN_LEDGER_ID, GET_TXN,
+    NODE, NYM, POOL_LEDGER_ID, VERKEY)
 from plenum_tpu.common.exceptions import InvalidClientMessageException
 from plenum_tpu.common.messages.client_request import ClientMessageValidator
 from plenum_tpu.common.messages.node_messages import (
@@ -130,7 +130,7 @@ class Node:
                  config: Optional[Config] = None,
                  storage_factory=None,
                  client_reply_handler: Callable[[str, object], None] = None,
-                 bls_bft_replica=None,
+                 bls_bft_replica=None, bls_signer=None,
                  genesis_txns: Optional[List[dict]] = None,
                  on_membership_change: Callable[[List[str]], None] = None,
                  metrics=None):
@@ -247,6 +247,26 @@ class Node:
             for lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID):
                 self.freshness_checker.register_ledger(
                     lid, timer.get_current_time())
+
+        # ---- BLS: a signer is enough to stand up the full BLS-BFT seam
+        # (keys of peers come from pool-ledger NODE txns via the pool
+        # manager; the aggregated multi-sigs land in a persistent store
+        # that read handlers attach to state proofs)
+        if bls_bft_replica is None and bls_signer is not None:
+            from plenum_tpu.consensus.bls_bft_replica import (
+                BlsBftReplica, BlsKeyRegister, BlsStore)
+            from plenum_tpu.crypto.bls import BlsCryptoVerifierPlenum
+            pool_state = self.db_manager.get_state(POOL_LEDGER_ID)
+            bls_bft_replica = BlsBftReplica(
+                name, bls_signer, BlsCryptoVerifierPlenum(),
+                BlsKeyRegister(lambda n: (self.pool_manager.node_info(n)
+                                          or {}).get(BLS_KEY)),
+                bls_store=BlsStore(make_kv("bls_store")),
+                get_pool_root=lambda: pool_state.committedHeadHash_b58
+                if pool_state is not None else "")
+        self.bls_bft_replica = bls_bft_replica
+        if bls_bft_replica is not None:
+            self.db_manager.bls_store = bls_bft_replica.bls_store
 
         self.replica = ReplicaService(
             name, validators, timer, network, executor=self.executor,

@@ -71,6 +71,29 @@ class ReadRequestHandler(RequestHandler):
     @abstractmethod
     def get_result(self, request: Request) -> dict: ...
 
+    def make_state_proof(self, key: bytes, root: bytes) -> dict:
+        """Structured state proof a client can verify against ONE node:
+        {root_hash, proof_nodes, multi_signature?} — the multi-sig from
+        the BlsStore is what lets the root itself be trusted without
+        f+1 matching replies (reference
+        handler_interfaces/read_request_handler.py:39-56: bls_store.get
+        on the proof root → MULTI_SIGNATURE in the proof dict)."""
+        from plenum_tpu.common.constants import (
+            MULTI_SIGNATURE, PROOF_NODES, ROOT_HASH)
+        from plenum_tpu.common.serializers.base58 import b58encode
+        root_b58 = b58encode(bytes(root))
+        proof = {
+            ROOT_HASH: root_b58,
+            PROOF_NODES: self.state.generate_state_proof(
+                key, root=root, serialize=True),
+        }
+        bls_store = getattr(self.database_manager, "bls_store", None)
+        if bls_store is not None:
+            multi_sig = bls_store.get(root_b58)
+            if multi_sig is not None:
+                proof[MULTI_SIGNATURE] = multi_sig.as_dict()
+        return proof
+
 
 class ActionRequestHandler(RequestHandler):
     """Non-ledger actions: validated and executed locally, no consensus
@@ -376,17 +399,14 @@ class GetNymHandler(ReadRequestHandler):
             ts_store = self.database_manager.get_store("state_ts")
             root = (ts_store.get_equal_or_prev(ts, self.ledger_id)
                     if ts_store is not None else None)
-            if root is None:
-                data, seq_no, proof = None, None, None
-            else:
-                data, seq_no, _ = decode_state_value(
-                    self.state.get_for_root_hash(root, key))
-                proof = self.state.generate_state_proof(
-                    key, root=root, serialize=True)
         else:
-            data, seq_no, _ = decode_state_value(
-                self.state.get(key, isCommitted=True))
-            proof = self.state.generate_state_proof(key, serialize=True)
+            root = self.state.committedHeadHash
+        if root is None:
+            data, seq_no, txn_time, proof = None, None, None, None
+        else:
+            data, seq_no, txn_time = decode_state_value(
+                self.state.get_for_root_hash(root, key))
+            proof = self.make_state_proof(key, root)
         return {
             TXN_TYPE: "105",
             "identifier": request.identifier,
@@ -394,5 +414,8 @@ class GetNymHandler(ReadRequestHandler):
             "dest": nym,
             "data": data,
             "seqNo": seq_no,
+            # the client re-encodes (data, seqNo, txnTime) to check the
+            # proof leaf byte-for-byte — the time must travel with it
+            "txnTime": txn_time,
             "state_proof": proof,
         }

@@ -130,18 +130,21 @@ def test_state_readable_with_proof_after_write(pool):
     data = reply.result["data"]
     assert data is not None and data[VERKEY] == client.verkey
     proof = reply.result["state_proof"]
-    # verify the proof against the node's committed state root
+    # structured proof: {root_hash, proof_nodes[, multi_signature]}
+    from plenum_tpu.common.serializers.base58 import b58encode
     from plenum_tpu.server.request_handlers import (
         encode_state_value, nym_to_state_key)
     from plenum_tpu.state.pruning_state import PruningState
     nym_handler = nodes[1].write_manager.request_handlers[NYM]
     root = nym_handler.state.committedHeadHash
-    nodes_list = PruningState.deserialize_proof(proof)
-    expected_value = encode_state_value(
-        data, reply.result["seqNo"], None)
+    assert proof["root_hash"] == b58encode(root)
+    nodes_list = PruningState.deserialize_proof(proof["proof_nodes"])
     # value encodes (val, lsn, lut); reconstruct exactly as stored
+    expected_value = encode_state_value(
+        data, reply.result["seqNo"], reply.result["txnTime"])
     raw = nym_handler.state.get(
         nym_to_state_key(client.identifier), isCommitted=True)
+    assert bytes(raw) == expected_value
     assert PruningState.verify_state_proof(
         root, nym_to_state_key(client.identifier), bytes(raw), nodes_list)
 
