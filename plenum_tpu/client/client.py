@@ -68,16 +68,29 @@ class PoolClient:
                  timer: TimerService = None,
                  resubmit_interval: float = 15.0,
                  bls_verifier=None,
-                 bls_key_provider: Callable[[str], Optional[str]] = None):
+                 bls_key_provider: Callable[[str], Optional[str]] = None,
+                 proof_max_age: Optional[float] = None,
+                 get_time: Callable[[], float] = None):
         """bls_verifier + bls_key_provider(node_name → BLS pk) enable
         single-node trust for proof-bearing reads; without them every
-        read needs the f+1 matching-reply quorum."""
+        read needs the f+1 matching-reply quorum.
+
+        proof_max_age (seconds, against get_time — wall clock by
+        default): reject single-node proofs whose multi-sig timestamp
+        is older than this, EXCEPT for reads that explicitly ask for
+        historical state (operation carries a timestamp). Without a
+        window, one malicious node can answer a current-state read
+        with a genuine-but-stale proof (e.g. an absence proof captured
+        before the value was written)."""
+        import time as _time
         self.wallet = wallet
         self.node_names = list(node_names)
         self._send = send_fn
         self.quorums = Quorums(len(self.node_names))
         self._bls_verifier = bls_verifier
         self._bls_keys = bls_key_provider
+        self._proof_max_age = proof_max_age
+        self._get_time = get_time or _time.time
         self._pending: Dict[tuple, RequestStatus] = {}
         self._completed: Dict[tuple, RequestStatus] = {}
         self._resubmitter = None
@@ -183,13 +196,19 @@ class PoolClient:
         # nodes tie the value to the root — no reply quorum needed. The
         # proof is only trusted for the REQUEST's own question: a reply
         # whose dest/type differ from what we asked carries a possibly
-        # valid proof of the wrong fact (single-node substitution)
-        if self._proof_answers_request(status.request, result) \
-                and self.verify_state_proof(result):
-            status.confirmed_result = result
-            status.proven = True
-            self._completed[key] = self._pending.pop(key)
-            return
+        # valid proof of the wrong fact (single-node substitution). The
+        # freshness window applies to current-state reads only — a read
+        # that names a timestamp WANTS an old root
+        if self._proof_answers_request(status.request, result):
+            historical = (status.request.operation or {}).get(
+                "timestamp") is not None
+            max_age = None if historical else self._proof_max_age
+            if self.verify_state_proof(result, max_age=max_age,
+                                       now=self._get_time()):
+                status.confirmed_result = result
+                status.proven = True
+                self._completed[key] = self._pending.pop(key)
+                return
         by_fp: Dict[str, List[str]] = {}
         for node, res in status.replies.items():
             by_fp.setdefault(_result_fingerprint(res), []).append(node)
@@ -305,7 +324,7 @@ class PoolClient:
         dest = result.get("dest")
         if not isinstance(dest, str) or not dest:
             return None
-        from plenum_tpu.server.request_handlers import (
+        from plenum_tpu.common.state_codec import (
             encode_state_value, nym_to_state_key)
         key = nym_to_state_key(dest)
         if result.get("data") is None:

@@ -5,9 +5,12 @@ ClientMessageValidator checks the envelope; operation schemas are
 per-txn-type (registered by request handlers for static validation).
 """
 from plenum_tpu.common.constants import (
-    IDENTIFIER, OPERATION, REQ_ID, SIGNATURE, SIGNATURES, TAA_ACCEPTANCE,
-    TAA_ACCEPTANCE_DIGEST, TAA_ACCEPTANCE_MECHANISM, TAA_ACCEPTANCE_TIME,
-    TXN_TYPE)
+    CURRENT_PROTOCOL_VERSION, IDENTIFIER, OPERATION, REQ_ID, SIGNATURE,
+    SIGNATURES, TAA_ACCEPTANCE, TAA_ACCEPTANCE_DIGEST,
+    TAA_ACCEPTANCE_MECHANISM, TAA_ACCEPTANCE_TIME, TXN_TYPE)
+from plenum_tpu.native import try_load_ext
+
+_fp = try_load_ext("fastpath")
 from plenum_tpu.common.exceptions import InvalidClientRequest
 from plenum_tpu.common.messages.fields import (
     IdentifierField, LimitedLengthStringField, MapField, NonEmptyStringField,
@@ -41,6 +44,20 @@ class ClientMessageValidator:
         self._strict = operation_schema_is_strict
 
     def validate(self, dct: dict):
+        # C fast path (fastpath.c validate_client_request): returns None
+        # only when the envelope is PROVABLY valid; anything else falls
+        # through to the Python checks below, which either pass or raise
+        # with their exact error message — clients never see C-made text
+        if _fp is not None:
+            try:
+                if _fp.validate_client_request(
+                        dct, CURRENT_PROTOCOL_VERSION) is None:
+                    return
+            except TypeError:
+                pass
+        self._validate_py(dct)
+
+    def _validate_py(self, dct: dict):
         if not isinstance(dct, dict):
             raise InvalidClientRequest(None, None, 'request must be a dict')
         identifier = dct.get(IDENTIFIER)

@@ -37,6 +37,10 @@ class Request:
         self.endorser = endorser
         self._digest = None
         self._payload_digest = None
+        self._payload_state = None  # cached signingPayloadState()
+        # canonical signing bytes, pre-computed by the C intake path
+        # (fastpath.request_intake) — authentication reuses them
+        self._signing_ser = None
 
     @property
     def digest(self) -> str:
@@ -68,7 +72,9 @@ class Request:
             self.signingPayloadState())).hexdigest()
 
     def signingState(self, identifier=None) -> Dict:
-        state = self.signingPayloadState(identifier)
+        # copy: signingPayloadState may hand back its cached dict, and
+        # the signature keys added here must not leak into it
+        state = dict(self.signingPayloadState(identifier))
         if self.signatures is not None:
             state[SIGNATURES] = self.signatures
         if self.signature is not None:
@@ -76,6 +82,12 @@ class Request:
         return state
 
     def signingPayloadState(self, identifier=None) -> Dict:
+        if identifier is None or identifier == self.identifier:
+            # hot path: digest, payload digest, and signature prep all
+            # build this same dict — once per request, not three times
+            state = self._payload_state
+            if state is not None:
+                return state
         state = {
             IDENTIFIER: identifier or self.identifier,
             REQ_ID: self.reqId,
@@ -87,6 +99,8 @@ class Request:
             state[TAA_ACCEPTANCE] = self.taaAcceptance
         if self.endorser is not None:
             state['endorser'] = self.endorser
+        if identifier is None or identifier == self.identifier:
+            self._payload_state = state
         return state
 
     @property

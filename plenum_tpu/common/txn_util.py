@@ -34,25 +34,29 @@ def init_empty_txn(txn_type, protocol_version=None) -> dict:
 
 
 def reqToTxn(req) -> dict:
-    """Build the txn envelope from a Request (reference txn_util.py reqToTxn)."""
+    """Build the txn envelope from a Request (reference txn_util.py
+    reqToTxn). Runs once per write on the apply hot path — the envelope
+    is built as one literal instead of init_empty_txn + patching."""
     if isinstance(req, dict):
         from plenum_tpu.common.request import Request
         req = Request(**req) if 'operation' in req else Request(**req.get('req', req))
     op = dict(req.operation)
     txn_type = op.pop('type')
-    txn = init_empty_txn(txn_type, req.protocolVersion)
-    txn[TXN_PAYLOAD][TXN_PAYLOAD_DATA] = op
-    md = txn[TXN_PAYLOAD][TXN_PAYLOAD_METADATA]
+    md = {TXN_PAYLOAD_METADATA_DIGEST: req.digest,
+          TXN_PAYLOAD_METADATA_PAYLOAD_DIGEST: req.payload_digest}
     if req.identifier is not None:
         md[TXN_PAYLOAD_METADATA_FROM] = req.identifier
     if req.reqId is not None:
         md[TXN_PAYLOAD_METADATA_REQ_ID] = req.reqId
-    md[TXN_PAYLOAD_METADATA_DIGEST] = req.digest
-    md[TXN_PAYLOAD_METADATA_PAYLOAD_DIGEST] = req.payload_digest
     if req.taaAcceptance is not None:
         md[TXN_PAYLOAD_METADATA_TAA_ACCEPTANCE] = req.taaAcceptance
     if req.endorser is not None:
         md[TXN_PAYLOAD_METADATA_ENDORSER] = req.endorser
+    payload = {TXN_PAYLOAD_TYPE: txn_type,
+               TXN_PAYLOAD_DATA: op,
+               TXN_PAYLOAD_METADATA: md}
+    if req.protocolVersion is not None:
+        payload[TXN_PAYLOAD_PROTOCOL_VERSION] = req.protocolVersion
     sig = {}
     if req.signature or req.signatures:
         sig[TXN_SIGNATURE_TYPE] = ED25519
@@ -65,8 +69,10 @@ def reqToTxn(req) -> dict:
                 values.append({TXN_SIGNATURE_FROM: frm,
                                TXN_SIGNATURE_VALUE: value})
         sig[TXN_SIGNATURE_VALUES] = values
-    txn[TXN_SIGNATURE] = sig
-    return txn
+    return {TXN_PAYLOAD: payload,
+            TXN_METADATA: {},
+            TXN_SIGNATURE: sig,
+            TXN_VERSION: "1"}
 
 
 def append_txn_metadata(txn: dict, seq_no: int = None, txn_time: int = None,

@@ -69,6 +69,10 @@ class BlsBftReplica:
         self._get_pool_root = get_pool_root or (lambda: "")
         # (view_no, pp_seq_no) -> pp fields needed to bind commit sigs
         self._pp_values: Dict[tuple, MultiSignatureValue] = {}
+        # shares already pairing-checked in validate_commit, so
+        # process_order doesn't pay a second ~5 ms pairing per share:
+        # (view_no, pp_seq_no, sender) -> sig string
+        self._verified_shares: Dict[tuple, str] = {}
 
     # ------------------------------------------------------- PRE-PREPARE
 
@@ -117,6 +121,7 @@ class BlsBftReplica:
         value = self._pp_values[(commit.viewNo, commit.ppSeqNo)]
         if not self._verifier.verify_sig(sig, value.as_single_value(), pk):
             return "invalid BLS signature share from {}".format(sender)
+        self._verified_shares[(commit.viewNo, commit.ppSeqNo, sender)] = sig
         return None
 
     def process_commit(self, commit, sender: str):
@@ -128,10 +133,12 @@ class BlsBftReplica:
                       quorums=None):
         """Aggregate shares → MultiSignature → BlsStore (reference
         bls_bft_replica_plenum.py process_order). Every share is verified
-        here — a COMMIT can arrive (and be counted for consensus) before
-        its PrePrepare, in which case its share was never checked — and
-        the aggregate is only persisted with a bls_signatures (n-f)
-        quorum of valid shares, so stored proofs always verify."""
+        EXACTLY once: most were pairing-checked in validate_commit (the
+        memo skips a second ~5 ms pairing here); a COMMIT that arrived
+        (and was counted for consensus) before its PrePrepare was never
+        checked, so it is verified now. The aggregate is only persisted
+        with a bls_signatures (n-f) quorum of valid shares, so stored
+        proofs always verify."""
         value = self._pp_values.get((pp.viewNo, pp.ppSeqNo))
         if value is None:
             return
@@ -144,7 +151,9 @@ class BlsBftReplica:
             pk = self._keys.get_key_by_name(sender)
             if pk is None:
                 continue
-            if not self._verifier.verify_sig(sig, signed, pk):
+            if self._verified_shares.get(
+                    (pp.viewNo, pp.ppSeqNo, sender)) != sig \
+                    and not self._verifier.verify_sig(sig, signed, pk):
                 logger.warning("%s dropping invalid BLS share from %s at %s",
                                self._name, sender, key)
                 continue
@@ -165,3 +174,6 @@ class BlsBftReplica:
     def _gc(self, below_seq: int):
         for k in [k for k in self._pp_values if k[1] < below_seq - 10]:
             del self._pp_values[k]
+        for k in [k for k in self._verified_shares
+                  if k[1] < below_seq - 10]:
+            del self._verified_shares[k]

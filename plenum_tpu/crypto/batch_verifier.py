@@ -151,15 +151,41 @@ class _HubPending:
         return self._gen.results()[self._lo:self._hi]
 
 
+def dedup_items(items: Sequence[VerifyItem]
+                ) -> Tuple[List[VerifyItem], List[int]]:
+    """→ (unique_items, index) where index[i] is item i's slot in the
+    unique list. Verification is pure, and co-resident nodes all verify
+    the SAME client requests — callers sharing a device (hub,
+    verify daemon) would otherwise pay n× the work for one answer."""
+    uniq: dict = {}
+    order: List[VerifyItem] = []
+    index: List[int] = []
+    for item in items:
+        pos = uniq.get(item)
+        if pos is None:
+            pos = uniq[item] = len(order)
+            order.append(item)
+        index.append(pos)
+    return order, index
+
+
 class _HubGeneration:
     def __init__(self):
         self.items: List[VerifyItem] = []
         self.pending = None
         self._results = None
+        self._index = None  # per-item slot in the deduped launch
+
+    def dedup(self) -> List[VerifyItem]:
+        order, self._index = dedup_items(self.items)
+        return order
 
     def results(self) -> List[bool]:
         if self._results is None:
-            self._results = self.pending.collect()
+            res = self.pending.collect()
+            idx = self._index
+            self._results = res if idx is None \
+                else [res[i] for i in idx]
         return self._results
 
 
@@ -210,14 +236,15 @@ class CoalescingVerifierHub:
         # co-resident consumer
         if gen is self._gen:
             self._gen = _HubGeneration()
-        if not gen.items:
+        launch_items = gen.dedup()
+        if not launch_items:
             gen.pending = _Ready([])
-        elif len(gen.items) < self.threshold:
+        elif len(launch_items) < self.threshold:
             # quiet pool: a lone small generation takes the CPU floor
             # rather than paying a full device launch
-            gen.pending = self._scalar.dispatch(gen.items)
+            gen.pending = self._scalar.dispatch(launch_items)
         else:
-            gen.pending = self._batch.dispatch(gen.items)
+            gen.pending = self._batch.dispatch(launch_items)
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
         return self.dispatch(items).collect()

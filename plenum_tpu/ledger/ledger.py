@@ -42,6 +42,11 @@ class Ledger:
         self.seqNo = 0
         # uncommitted staging (reference plenum/common/ledger.py)
         self.uncommittedTxns: List[dict] = []
+        # (serialized, leaf_hash) per staged txn: the bytes that fed the
+        # shadow tree ARE the bytes commit must store/hash — reusing
+        # them both halves the serialization work and guarantees the
+        # committed root equals the root the pool agreed on
+        self._uncommitted_blobs: List[Tuple[bytes, bytes]] = []
         self.uncommittedTree: Optional[CompactMerkleTree] = None
         self.uncommittedRootHash: Optional[bytes] = None
         self.recoverTree()
@@ -119,22 +124,37 @@ class Ledger:
             self.uncommittedTree = self.tree.copy_shadow()
         first = self.uncommitted_size + 1
         for txn in txns:
-            self.uncommittedTree._append_hash(
-                self.hasher.hash_leaf(self.serialize_for_tree(txn)))
+            serialized = self.serialize_for_tree(txn)
+            leaf_hash = self.hasher.hash_leaf(serialized)
+            self.uncommittedTree._append_hash(leaf_hash)
+            self._uncommitted_blobs.append((serialized, leaf_hash))
         self.uncommittedTxns.extend(txns)
-        self.uncommittedRootHash = self.uncommittedTree.root_hash
+        # root is NOT folded here: staging runs once per request, the
+        # root is read once per batch — uncommitted_root_hash computes
+        # it on demand (the tree caches by size)
+        self.uncommittedRootHash = None
         last = self.uncommitted_size
         return (first, last), txns
 
     def commitTxns(self, count: int) -> Tuple[Tuple[int, int], List[dict]]:
         """Move the oldest `count` uncommitted txns into the durable log +
-        real tree (reference plenum/common/ledger.py commitTxns)."""
+        real tree (reference plenum/common/ledger.py commitTxns). Commit
+        replays the STAGED bytes/leaf hashes — txns are FIFO, their
+        metadata (seq_no, time) was fixed at staging, and the agreed
+        uncommitted root was computed from exactly these leaves."""
         committed = []
         first = self.seqNo + 1
-        for txn in self.uncommittedTxns[:count]:
-            self.add_quiet(txn)
+        store_put, tree_append = self._store.put, self.tree._append_hash
+        for txn, (serialized, leaf_hash) in zip(
+                self.uncommittedTxns[:count],
+                self._uncommitted_blobs[:count]):
+            seq_no = self.seqNo + 1
+            tree_append(leaf_hash)
+            store_put(_seq_key(seq_no), serialized)
+            self.seqNo = seq_no
             committed.append(txn)
         self.uncommittedTxns = self.uncommittedTxns[count:]
+        self._uncommitted_blobs = self._uncommitted_blobs[count:]
         if not self.uncommittedTxns:
             self.uncommittedTree = None
             self.uncommittedRootHash = None
@@ -147,6 +167,7 @@ class Ledger:
         """Drop the newest `count` uncommitted txns (batch revert)."""
         remaining = self.uncommittedTxns[:-count] if count else self.uncommittedTxns
         self.uncommittedTxns = []
+        self._uncommitted_blobs = []
         self.uncommittedTree = None
         self.uncommittedRootHash = None
         if remaining:
@@ -158,6 +179,8 @@ class Ledger:
 
     @property
     def uncommitted_root_hash(self) -> bytes:
+        if self.uncommittedTree is not None:
+            return self.uncommittedTree.root_hash
         if self.uncommittedRootHash is not None:
             return self.uncommittedRootHash
         return self.tree.root_hash
@@ -251,5 +274,6 @@ class Ledger:
         self._store.drop()
         self.seqNo = 0
         self.uncommittedTxns = []
+        self._uncommitted_blobs = []
         self.uncommittedTree = None
         self.uncommittedRootHash = None

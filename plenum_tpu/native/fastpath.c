@@ -831,7 +831,258 @@ static PyObject *py_b58decode(PyObject *self, PyObject *arg) {
     return out;
 }
 
+/* ------------------------------------------------------------------ */
+/* client request envelope validation                                  */
+/* ------------------------------------------------------------------ */
+
+/* decoded byte length of a base58 string, or -1 on a bad char /
+   oversize input. Mirrors Base58Field (fields.py:123) without
+   allocating the decoded bytes. */
+static int b58_decoded_len(const char *s, Py_ssize_t n) {
+    uint8_t acc[64];
+    size_t outlen = 0, pad = 0, j;
+    Py_ssize_t i;
+    if (n > 88) return -1; /* longer than any 64-byte encoding */
+    while ((Py_ssize_t)pad < n && s[pad] == '1') pad++;
+    for (i = 0; i < n; i++) {
+        int8_t d = B58I[(uint8_t)s[i]];
+        uint32_t carry;
+        if (d < 0) return -1;
+        carry = (uint32_t)d;
+        for (j = 0; j < outlen; j++) {
+            uint32_t t = (uint32_t)acc[j] * 58 + carry;
+            acc[j] = (uint8_t)t;
+            carry = t >> 8;
+        }
+        while (carry) {
+            if (outlen >= sizeof acc) return -1;
+            acc[outlen++] = (uint8_t)carry;
+            carry >>= 8;
+        }
+    }
+    return (int)(pad + outlen);
+}
+
+/* identifier: str whose b58 decoding is 16 or 32 bytes */
+static int valid_identifier(PyObject *o) {
+    const char *s;
+    Py_ssize_t n;
+    int len;
+    if (!PyUnicode_Check(o)) return 0;
+    s = PyUnicode_AsUTF8AndSize(o, &n);
+    if (!s) { PyErr_Clear(); return 0; }
+    len = b58_decoded_len(s, n);
+    return len == 16 || len == 32;
+}
+
+/* signature: non-empty str of at most 512 chars (SignatureField) */
+static int valid_signature(PyObject *o) {
+    if (!PyUnicode_Check(o)) return 0;
+    return PyUnicode_GET_LENGTH(o) > 0 && PyUnicode_GET_LENGTH(o) <= 512;
+}
+
+static int nonneg_int(PyObject *o) {
+    int overflow;
+    long long v;
+    if (!PyLong_Check(o) || PyBool_Check(o)) return 0;
+    v = PyLong_AsLongLongAndOverflow(o, &overflow);
+    if (overflow > 0) return 1;   /* huge positive is still non-negative */
+    if (overflow < 0) return 0;
+    return v >= 0;
+}
+
+/* validate_client_request(dct, protocol_version) ->
+     None : envelope definitely valid (the overwhelmingly common case)
+     True : not provably valid here -- run the Python validator, which
+            either passes or raises with its exact error message.
+   Mirrors ClientMessageValidator.validate + _validate_taa
+   (common/messages/client_request.py); never produces error text, so
+   clients always see the Python path's messages. */
+static PyObject *py_validate_client_request(PyObject *self, PyObject *args) {
+    PyObject *dct, *op, *idr, *req_id, *sig, *sigs, *pv, *taa;
+    long protocol_version;
+    if (!PyArg_ParseTuple(args, "Ol", &dct, &protocol_version))
+        return NULL;
+    if (!PyDict_Check(dct)) Py_RETURN_TRUE;
+    idr = PyDict_GetItemString(dct, "identifier");
+    req_id = PyDict_GetItemString(dct, "reqId");
+    op = PyDict_GetItemString(dct, "operation");
+    if (!op || !PyDict_Check(op)) Py_RETURN_TRUE;
+    if (!PyDict_GetItemString(op, "type")) Py_RETURN_TRUE;
+    if (!req_id || !nonneg_int(req_id)) Py_RETURN_TRUE;
+    sigs = PyDict_GetItemString(dct, "signatures");
+    if (sigs == Py_None) sigs = NULL;
+    if (idr == Py_None) idr = NULL;
+    if (!idr && !sigs) Py_RETURN_TRUE;
+    if (idr && !valid_identifier(idr)) Py_RETURN_TRUE;
+    if (sigs) {
+        PyObject *k, *v;
+        Py_ssize_t pos = 0;
+        if (!PyDict_Check(sigs) || PyDict_GET_SIZE(sigs) == 0)
+            Py_RETURN_TRUE;
+        while (PyDict_Next(sigs, &pos, &k, &v)) {
+            if (!valid_identifier(k) || !valid_signature(v))
+                Py_RETURN_TRUE;
+        }
+    }
+    sig = PyDict_GetItemString(dct, "signature");
+    if (sig && sig != Py_None && !valid_signature(sig)) Py_RETURN_TRUE;
+    pv = PyDict_GetItemString(dct, "protocolVersion");
+    if (pv && pv != Py_None) {
+        long got;
+        if (!PyLong_Check(pv) || PyBool_Check(pv)) Py_RETURN_TRUE;
+        got = PyLong_AsLong(pv);
+        if (PyErr_Occurred()) { PyErr_Clear(); Py_RETURN_TRUE; }
+        if (got != protocol_version) Py_RETURN_TRUE;
+    }
+    taa = PyDict_GetItemString(dct, "taaAcceptance");
+    if (taa && taa != Py_None) {
+        PyObject *v;
+        Py_ssize_t i, tn;
+        const char *ds;
+        if (!PyDict_Check(taa)) Py_RETURN_TRUE;
+        v = PyDict_GetItemString(taa, "taaDigest");
+        if (!v || !PyUnicode_Check(v)) Py_RETURN_TRUE;
+        ds = PyUnicode_AsUTF8AndSize(v, &tn);
+        if (!ds) { PyErr_Clear(); Py_RETURN_TRUE; }
+        if (tn != 64) Py_RETURN_TRUE;
+        for (i = 0; i < tn; i++) {
+            char c = ds[i];
+            if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f')
+                  || (c >= 'A' && c <= 'F')))
+                Py_RETURN_TRUE;
+        }
+        v = PyDict_GetItemString(taa, "mechanism");
+        if (!v || !PyUnicode_Check(v) || PyUnicode_GET_LENGTH(v) == 0
+            || PyUnicode_GET_LENGTH(v) > 256)
+            Py_RETURN_TRUE;
+        v = PyDict_GetItemString(taa, "time");
+        if (!v || !nonneg_int(v)) Py_RETURN_TRUE;
+    }
+    Py_RETURN_NONE;
+}
+
+/* one JSON `"key":value` pair (comma-prefixed unless first) */
+static int put_kv_json(buf_t *b, const char *key, PyObject *val,
+                       int *first) {
+    if (!*first && buf_putc(b, ',') < 0) return -1;
+    *first = 0;
+    if (buf_putc(b, '"') < 0) return -1;
+    if (buf_put(b, key, strlen(key)) < 0) return -1;
+    if (buf_put(b, "\":", 2) < 0) return -1;
+    return json_write(b, val, 1, 0);
+}
+
+/* request_intake(dct, protocol_version) ->
+     None                       : envelope not provably valid (use the
+                                  Python validate + digest path)
+     (digest_hex, payload_digest_hex, signing_bytes)
+   One boundary crossing for the whole client-request intake prep:
+   envelope validation + the two canonical-JSON digests + the signing
+   bytes. Byte-identical to Request.getDigest / getPayloadDigest /
+   serialize_msg_for_signing(signingPayloadState()) — the payload JSON
+   IS the signing bytes, so payload_digest is its sha256. */
+static PyObject *py_request_intake(PyObject *self, PyObject *args) {
+    PyObject *dct, *valid, *vargs;
+    PyObject *idr, *req_id, *op, *pv, *taa, *end_, *sig, *sigs;
+    buf_t pb, db;
+    sha256_ctx c;
+    uint8_t md[32];
+    PyObject *dig = NULL, *pdig = NULL, *ser = NULL, *out = NULL;
+    PyObject *pv_default = NULL;
+    int first;
+    long protocol_version;
+    if (!PyArg_ParseTuple(args, "Ol", &dct, &protocol_version))
+        return NULL;
+    /* reuse the validator: only a provably valid envelope proceeds */
+    vargs = Py_BuildValue("(Ol)", dct, protocol_version);
+    if (!vargs) return NULL;
+    valid = py_validate_client_request(self, vargs);
+    Py_DECREF(vargs);
+    if (!valid) return NULL;
+    if (valid != Py_None) { Py_DECREF(valid); Py_RETURN_NONE; }
+    Py_DECREF(valid);
+    idr = PyDict_GetItemString(dct, "identifier");
+    req_id = PyDict_GetItemString(dct, "reqId");
+    op = PyDict_GetItemString(dct, "operation");
+    pv = PyDict_GetItemString(dct, "protocolVersion");
+    taa = PyDict_GetItemString(dct, "taaAcceptance");
+    end_ = PyDict_GetItemString(dct, "endorser");
+    sig = PyDict_GetItemString(dct, "signature");
+    sigs = PyDict_GetItemString(dct, "signatures");
+    if (!idr) idr = Py_None;
+    if (!pv) {
+        /* ABSENT key defaults to the current protocol version
+           (Request.from_dict d.get('protocolVersion', CURRENT));
+           an explicit None stays omitted from the payload */
+        pv_default = PyLong_FromLong(protocol_version);
+        if (!pv_default) return NULL;
+        pv = pv_default;
+    }
+    /* payload JSON == signing bytes (sorted keys; identifier/operation/
+       reqId always present, optionals only when non-None) */
+    buf_init(&pb);
+    first = 1;
+    if (buf_putc(&pb, '{') < 0) goto fail;
+    if (end_ && end_ != Py_None
+        && put_kv_json(&pb, "endorser", end_, &first) < 0) goto fail;
+    if (put_kv_json(&pb, "identifier", idr, &first) < 0) goto fail;
+    if (put_kv_json(&pb, "operation", op, &first) < 0) goto fail;
+    if (pv && pv != Py_None
+        && put_kv_json(&pb, "protocolVersion", pv, &first) < 0) goto fail;
+    if (put_kv_json(&pb, "reqId", req_id, &first) < 0) goto fail;
+    if (taa && taa != Py_None
+        && put_kv_json(&pb, "taaAcceptance", taa, &first) < 0) goto fail;
+    if (buf_putc(&pb, '}') < 0) goto fail;
+    /* digest JSON: payload keys + signature(s), still sorted */
+    buf_init(&db);
+    first = 1;
+    if (buf_putc(&db, '{') < 0) goto fail2;
+    if (end_ && end_ != Py_None
+        && put_kv_json(&db, "endorser", end_, &first) < 0) goto fail2;
+    if (put_kv_json(&db, "identifier", idr, &first) < 0) goto fail2;
+    if (put_kv_json(&db, "operation", op, &first) < 0) goto fail2;
+    if (pv && pv != Py_None
+        && put_kv_json(&db, "protocolVersion", pv, &first) < 0) goto fail2;
+    if (put_kv_json(&db, "reqId", req_id, &first) < 0) goto fail2;
+    if (sig && sig != Py_None
+        && put_kv_json(&db, "signature", sig, &first) < 0) goto fail2;
+    if (sigs && sigs != Py_None
+        && put_kv_json(&db, "signatures", sigs, &first) < 0) goto fail2;
+    if (taa && taa != Py_None
+        && put_kv_json(&db, "taaAcceptance", taa, &first) < 0) goto fail2;
+    if (buf_putc(&db, '}') < 0) goto fail2;
+    sha256_init(&c);
+    sha256_update(&c, db.p, db.len);
+    sha256_final(&c, md);
+    dig = hex_str(md, 32);
+    sha256_init(&c);
+    sha256_update(&c, pb.p, pb.len);
+    sha256_final(&c, md);
+    pdig = hex_str(md, 32);
+    ser = PyBytes_FromStringAndSize((const char *)pb.p,
+                                    (Py_ssize_t)pb.len);
+    if (dig && pdig && ser)
+        out = PyTuple_Pack(3, dig, pdig, ser);
+    Py_XDECREF(dig); Py_XDECREF(pdig); Py_XDECREF(ser);
+    Py_XDECREF(pv_default);
+    buf_free(&db);
+    buf_free(&pb);
+    return out;
+fail2:
+    buf_free(&db);
+fail:
+    Py_XDECREF(pv_default);
+    buf_free(&pb);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
+    {"validate_client_request", py_validate_client_request, METH_VARARGS,
+     "client request envelope check -> None | error str | True"},
+    {"request_intake", py_request_intake, METH_VARARGS,
+     "validate + digest pair + signing bytes in one pass -> "
+     "None | (digest_hex, payload_digest_hex, signing_bytes)"},
     {"canonical_json", py_canonical_json, METH_O,
      "json.dumps(x, sort_keys=True, separators=(',',':'),"
      " ensure_ascii=False).encode() in one C pass"},

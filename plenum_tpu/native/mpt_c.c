@@ -375,6 +375,11 @@ typedef struct {
     /* list of fresh hashes for drain() */
     uint8_t (*fresh)[32];
     size_t fresh_n, fresh_cap;
+    /* set_many batching: while 1, ref_node keeps nodes as in-memory
+       lists instead of hashing/storing them, so path nodes shared by
+       the batch's keys are encoded+hashed ONCE at commit instead of
+       once per key */
+    int deferred;
 } mpt_t;
 
 static uint64_t hash64(const uint8_t *h) {
@@ -568,6 +573,7 @@ static item_t *ref_node(mpt_t *m, arena_t *a, item_t *node) {
     uint8_t *h;
     item_t *out;
     if (item_is_blank(node)) return node;
+    if (m->deferred) return node;  /* batch mode: ref-ify at commit */
     enc = rlp_encode_arena(a, node, &enc_len);
     if (!enc) { PyErr_NoMemory(); return NULL; }
     if (enc_len < 32) return node;
@@ -1068,6 +1074,100 @@ done:
     return out;
 }
 
+/* post-order ref-ification of a deferred subtree: children first, so
+   every parent is encoded over its kids' final (hash/inline) form.
+   Only list items can be deferred nodes — bytes kids are values,
+   hashes, or hex-prefix paths and are left untouched. */
+static int commit_kids(mpt_t *m, arena_t *a, item_t *node) {
+    size_t i;
+    if (!node->is_list) return 0;
+    for (i = 0; i < node->n; i++) {
+        item_t *kid = node->kids[i];
+        if (kid && kid->is_list && !item_is_blank(kid)) {
+            item_t *r;
+            if (commit_kids(m, a, kid) < 0) return -1;
+            r = ref_node(m, a, kid);
+            if (!r) return -1;
+            node->kids[i] = r;
+        }
+    }
+    return 0;
+}
+
+/* set_many(h, root, [(key, value), ...]) -> new root.
+   One deferred pass: updates build an in-memory node tree (no hashing,
+   no stores), then the final tree is committed bottom-up — upper path
+   nodes shared by the batch hash once instead of once per key. Empty
+   value deletes, matching set(). Intermediate roots are not stored
+   (only the batch's FINAL root is a readable snapshot). */
+static PyObject *py_set_many(PyObject *self, PyObject *args) {
+    PyObject *cap, *pairs, *fast = NULL;
+    Py_buffer root;
+    mpt_t *m;
+    PyObject *out = NULL;
+    Py_ssize_t i, npairs;
+    if (!PyArg_ParseTuple(args, "Oy*O", &cap, &root, &pairs))
+        return NULL;
+    m = get_handle(cap);
+    if (!m || root.len != 32) {
+        PyErr_SetString(PyExc_ValueError, "bad handle or root");
+        goto done;
+    }
+    fast = PySequence_Fast(pairs, "set_many needs a sequence of pairs");
+    if (!fast) goto done;
+    npairs = PySequence_Fast_GET_SIZE(fast);
+    {
+        arena_t *a = &m->arena;
+        item_t *node = load_root(m, a, root.buf);
+        if (!node) goto done;
+        m->deferred = 1;
+        for (i = 0; i < npairs; i++) {
+            PyObject *pair = PySequence_Fast_GET_ITEM(fast, i);
+            PyObject *ko, *vo;
+            const uint8_t *kb, *vb;
+            Py_ssize_t klen, vlen;
+            uint8_t *nib;
+            size_t nlen;
+            if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+                PyErr_SetString(PyExc_TypeError,
+                                "set_many pairs must be (key, value)");
+                m->deferred = 0;
+                goto done;
+            }
+            ko = PyTuple_GET_ITEM(pair, 0);
+            vo = PyTuple_GET_ITEM(pair, 1);
+            if (!PyBytes_Check(ko) || !PyBytes_Check(vo)) {
+                PyErr_SetString(PyExc_TypeError,
+                                "set_many keys/values must be bytes");
+                m->deferred = 0;
+                goto done;
+            }
+            kb = (const uint8_t *)PyBytes_AS_STRING(ko);
+            klen = PyBytes_GET_SIZE(ko);
+            vb = (const uint8_t *)PyBytes_AS_STRING(vo);
+            vlen = PyBytes_GET_SIZE(vo);
+            key_nibbles(a, kb, (size_t)klen, &nib, &nlen);
+            if (!nib) { PyErr_NoMemory(); m->deferred = 0; goto done; }
+            if (vlen == 0) {
+                int changed = 0;
+                node = trie_delete_node(m, a, node, nib, nlen, &changed);
+            } else {
+                node = trie_update(m, a, node, nib, nlen, vb,
+                                   (size_t)vlen);
+            }
+            if (!node) { m->deferred = 0; goto done; }
+        }
+        m->deferred = 0;
+        if (commit_kids(m, a, node) < 0) goto done;
+        out = finish_root(m, a, node);
+    }
+done:
+    if (m) arena_reset(&m->arena);
+    Py_XDECREF(fast);
+    PyBuffer_Release(&root);
+    return out;
+}
+
 static PyObject *py_delete(PyObject *self, PyObject *args) {
     PyObject *cap;
     Py_buffer root, key;
@@ -1392,6 +1492,9 @@ static PyMethodDef methods[] = {
      "eviction (only safe without a durable KV backing the miss_cb)"},
     {"blank_root", py_blank_root, METH_NOARGS, "empty-trie root hash"},
     {"set", py_set, METH_VARARGS, "set(h, root, key, value) -> new root"},
+    {"set_many", py_set_many, METH_VARARGS,
+     "set_many(h, root, [(key, value), ...]) -> new root; one deferred-"
+     "hash pass (empty value deletes)"},
     {"delete", py_delete, METH_VARARGS, "delete(h, root, key) -> new root"},
     {"get", py_get, METH_VARARGS, "get(h, root, key) -> bytes | None"},
     {"proof", py_proof, METH_VARARGS, "proof(h, root, key) -> [blob]"},

@@ -60,9 +60,21 @@ class CoreAuthNr(ClientAuthNr):
             return self._verkey_provider(identifier)
         return None
 
+    # (identifier, verkey_str) → raw 32/64 bytes; keyed on BOTH so a
+    # rotated verkey can never serve a stale raw key — the conversion
+    # is deterministic, only the lookup result can change
+    _raw_cache: Dict[tuple, bytes] = {}
+
     def _raw_verkey(self, identifier: str) -> bytes:
         verkey = self.getVerkey(identifier)
-        return verkey_from_identifier(identifier, verkey)
+        cache_key = (identifier, verkey)
+        raw = self._raw_cache.get(cache_key)
+        if raw is None:
+            raw = verkey_from_identifier(identifier, verkey)
+            if len(self._raw_cache) > 8192:
+                self._raw_cache.clear()
+            self._raw_cache[cache_key] = raw
+        return raw
 
     # ----------------------------------------------------------- single
 
@@ -166,7 +178,11 @@ class CoreAuthNr(ClientAuthNr):
             if vk is None:
                 raise CouldNotAuthenticate(
                     idr, req.reqId, "no verkey for {}".format(idr))
-            ser = serialize_msg_for_signing(req.signingPayloadState(idr))
+            if idr == req.identifier and req._signing_ser is not None:
+                # canonical bytes already built by the C intake pass
+                ser = req._signing_ser
+            else:
+                ser = serialize_msg_for_signing(req.signingPayloadState(idr))
             items.append((ser, sig_raw, vk))
             idrs.append(idr)
         return items, idrs

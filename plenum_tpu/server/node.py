@@ -48,7 +48,10 @@ from plenum_tpu.server.request_handlers import (
 from plenum_tpu.server.write_request_manager import (
     ActionRequestManager, ReadRequestManager, WriteRequestManager)
 from plenum_tpu.state.pruning_state import PruningState
+from plenum_tpu.native import try_load_ext
 from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+
+_fp = try_load_ext("fastpath")
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
@@ -694,11 +697,24 @@ class Node:
         signature. The caller overlaps other work (other nodes\' batches,
         consensus ticks) before conclude_client_batch harvests — this
         hides the device round-trip latency entirely (SURVEY.md §7)."""
+        from plenum_tpu.common.constants import CURRENT_PROTOCOL_VERSION
+        intake = _fp.request_intake if _fp is not None else None
         parsed = []
         for msg, client_id in msgs:
             try:
-                self._validator.validate(msg)
-                request = Request.from_dict(msg)
+                # C fast path: validation + both digests + signing bytes
+                # in one crossing; None falls back to the Python chain
+                # (which also produces the exact rejection text)
+                pre = None
+                if intake is not None and type(msg) is dict:
+                    pre = intake(msg, CURRENT_PROTOCOL_VERSION)
+                if pre is None:
+                    self._validator.validate(msg)
+                    request = Request.from_dict(msg)
+                else:
+                    request = Request.from_dict(msg)
+                    request._digest, request._payload_digest, \
+                        request._signing_ser = pre
             except InvalidClientMessageException as e:
                 self._reply_to_client(client_id, RequestNack(
                     identifier=msg.get("identifier") or "unknown",
@@ -782,11 +798,15 @@ class Node:
                     reason="plugin rejected: %s" % e))
                 return
         self._request_spike_accum += 1
-        self._req_clients[request.key] = client_id
-        self._reply_to_client(client_id, RequestAck(
-            identifier=request.identifier or "unknown",
-            reqId=request.reqId or 0))
-        self.monitor.request_received(request.key)
+        key = request.key
+        self._req_clients[key] = client_id
+        if self._clients_attached:
+            # building the Ack (schema-validated message object) only
+            # makes sense when there is a transport to carry it
+            self._reply_to_client(client_id, RequestAck(
+                identifier=request.identifier or "unknown",
+                reqId=request.reqId or 0))
+        self.monitor.request_received(key)
         self.propagator.propagate(request, client_id)
 
     def _sample_spikes(self):
@@ -886,21 +906,32 @@ class Node:
         self.observable.batch_committed(ordered.ledgerId,
                                         committed_txns or [])
         ledger = self.db_manager.get_ledger(ordered.ledgerId)
+        # locals hoisted out of the per-txn loop: this runs once per
+        # ordered request on every node
+        from plenum_tpu.common.constants import (
+            TXN_METADATA, TXN_METADATA_SEQ_NO, TXN_PAYLOAD,
+            TXN_PAYLOAD_METADATA, TXN_PAYLOAD_METADATA_DIGEST,
+            TXN_PAYLOAD_METADATA_FROM, TXN_PAYLOAD_METADATA_PAYLOAD_DIGEST)
+        seq_no_put = self.seq_no_db.put
+        req_clients_pop = self._req_clients.pop
+        rejected_pop = self._rejected_digests.pop
+        request_ordered = self.monitor.request_ordered
+        free_request = self.propagator.requests.free
+        inst_id = ordered.instId
+        lid_prefix = "%d:" % ordered.ledgerId
         for txn in committed_txns or []:
-            seq_no = get_seq_no(txn)
-            from plenum_tpu.common.txn_util import (
-                get_digest, get_from, get_payload_digest)
-            payload_digest = get_payload_digest(txn)
+            md = txn.get(TXN_PAYLOAD, {}).get(TXN_PAYLOAD_METADATA, {})
+            seq_no = txn.get(TXN_METADATA, {}).get(TXN_METADATA_SEQ_NO)
+            payload_digest = md.get(TXN_PAYLOAD_METADATA_PAYLOAD_DIGEST)
             if payload_digest:
-                self.seq_no_db.put(
-                    payload_digest.encode(),
-                    "{}:{}".format(ordered.ledgerId, seq_no).encode())
-            digest = get_digest(txn)
+                seq_no_put(payload_digest.encode(),
+                           (lid_prefix + str(seq_no)).encode())
+            digest = md.get(TXN_PAYLOAD_METADATA_DIGEST)
             if digest:
-                self.monitor.request_ordered(digest, ordered.instId,
-                                             identifier=get_from(txn))
-                self._rejected_digests.pop(digest, None)
-            client_id = self._req_clients.pop(digest, None)
+                request_ordered(digest, inst_id,
+                                identifier=md.get(TXN_PAYLOAD_METADATA_FROM))
+                rejected_pop(digest, None)
+            client_id = req_clients_pop(digest, None)
             if client_id is not None and self._clients_attached:
                 result = dict(txn)
                 try:
@@ -909,7 +940,7 @@ class Node:
                     pass
                 self._reply_to_client(client_id, Reply(result=result))
             if digest:
-                self.propagator.requests.free(digest)
+                free_request(digest)
         if ordered.ledgerId == POOL_LEDGER_ID:
             for txn in committed_txns or []:
                 self.pool_manager.process_committed_txn(txn)
@@ -1054,9 +1085,7 @@ class Node:
         handler = self.write_manager.request_handlers.get(NYM)
         if handler is None or handler.state is None:
             return None
-        val, _, _ = decode_state_value(handler.state.get(
-            nym_to_state_key(identifier), isCommitted=False))
-        return (val or {}).get(VERKEY)
+        return (handler.cached_nym_record(identifier) or {}).get(VERKEY)
 
     def _audit_root_at(self, pp_seq_no: int) -> str:
         """Checkpoint digest: committed audit-ledger root (all honest

@@ -107,6 +107,13 @@ class Requests(dict):
             self._by_ref[(req.identifier, req.reqId)] = state
         return state
 
+    def ref_state(self, payload: dict) -> Optional[ReqState]:
+        """Raw (identifier, reqId) index hit WITHOUT the deep-equality
+        check — only valid for decisions that don't depend on payload
+        content (e.g. 'already forwarded, nothing to do')."""
+        return self._by_ref.get((payload.get("identifier"),
+                                 payload.get("reqId")))
+
     def lookup_state(self, payload: dict) -> Optional[ReqState]:
         """Cheap pre-digest lookup: the stored ReqState if `payload` is
         bit-for-bit the request we already hold, else None. Equality is
@@ -240,6 +247,13 @@ class Propagator:
         # ONE state lookup per propagate: at n validators this handler
         # runs (n-1) times per request per node — every extra dict hop
         # or digest-property access in here is multiplied by that
+        quick = self.requests.ref_state(payload)
+        if quick is not None and quick.forwarded:
+            # already queued for ordering: no propagate — matching OR
+            # byzantine-variant — can change anything, so skip the
+            # deep-equality check entirely. At 25 nodes most of the
+            # (n-1) gossip copies of every request land here.
+            return
         state = self.requests.lookup_state(payload)
         if state is None:
             state = self.requests.add(Request.from_dict(payload))

@@ -19,7 +19,10 @@ from typing import Optional
 
 from plenum_tpu.common.constants import (
     DATA, DOMAIN_LEDGER_ID, GET_TXN, NODE, NYM, POOL_LEDGER_ID, ROLE,
-    SERVICES, STEWARD, TARGET_NYM, TRUSTEE, TXN_TYPE, VALIDATOR, VERKEY)
+    SERVICES, STEWARD, TARGET_NYM, TRUSTEE, TXN_METADATA,
+    TXN_METADATA_SEQ_NO, TXN_METADATA_TIME, TXN_PAYLOAD, TXN_PAYLOAD_DATA,
+    TXN_PAYLOAD_METADATA, TXN_PAYLOAD_METADATA_FROM, TXN_TYPE, VALIDATOR,
+    VERKEY)
 from plenum_tpu.common.exceptions import (
     InvalidClientRequest, UnauthorizedClientRequest)
 from plenum_tpu.common.request import Request
@@ -114,25 +117,10 @@ class ActionRequestHandler(RequestHandler):
 
 # --------------------------------------------------------------- helpers
 
-def nym_to_state_key(nym: str) -> bytes:
-    return nym.encode()
-
-
-def encode_state_value(value: dict, seq_no, txn_time) -> bytes:
-    payload = {"val": value, "lsn": seq_no, "lut": txn_time}
-    if _fp is not None:
-        try:
-            return _fp.canonical_json_ascii(payload)
-        except TypeError:
-            pass
-    return json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
-
-
-def decode_state_value(data: bytes):
-    if data is None:
-        return None, None, None
-    parsed = json.loads(bytes(data).decode())
-    return parsed.get("val"), parsed.get("lsn"), parsed.get("lut")
+# the leaf codec lives in common (clients rebuild proof leaves from it);
+# re-exported here for the handler-side callers
+from plenum_tpu.common.state_codec import (  # noqa: F401
+    decode_state_value, encode_state_value, nym_to_state_key)
 
 
 # ------------------------------------------------------------------- NYM
@@ -147,6 +135,14 @@ class NymHandler(WriteRequestHandler):
         # dynamic_validation to the immediately following update_state so
         # the hot apply path walks the trie once per request, not twice
         self._lookup_memo = None
+        # identifier → decoded nym record (or None), saving a trie walk
+        # + JSON decode per request for repeat authors: author role
+        # checks (dynamic validation) AND verkey resolution (client
+        # authentication) both hit it, and in a loaded pool most
+        # requests in a batch share a handful of authors. Exactly
+        # invalidated: update_state pops the nym it writes; any state
+        # rewind clears it wholesale (clear_caches)
+        self._nym_cache: dict = {}
 
     def static_validation(self, request: Request):
         op = request.operation
@@ -163,7 +159,11 @@ class NymHandler(WriteRequestHandler):
         op = request.operation
         key = nym_to_state_key(op[TARGET_NYM])
         raw = self.state.get(key, isCommitted=False)
-        self._lookup_memo = (self.state.headHash, key, raw)
+        # memo keyed by the state's mutation counter, NOT headHash —
+        # reading headHash would force the write buffer to flush (and
+        # hash) once per request, defeating the batched apply
+        self._lookup_memo = (getattr(self.state, "mutation_count", None),
+                             key, raw)
         existing, _, _ = decode_state_value(raw)
         is_creation = existing is None
         if is_creation:
@@ -189,35 +189,59 @@ class NymHandler(WriteRequestHandler):
                         request.identifier, request.reqId,
                         "only TRUSTEE can change a nym's role")
 
+    _MISS = object()
+
+    def cached_nym_record(self, identifier: str):
+        """Decoded uncommitted-state record for a nym (None = absent),
+        through the invalidation-exact cache."""
+        rec = self._nym_cache.get(identifier, self._MISS)
+        if rec is not self._MISS:
+            return rec
+        rec, _, _ = decode_state_value(self.state.get(
+            nym_to_state_key(identifier), isCommitted=False))
+        if len(self._nym_cache) > 4096:
+            self._nym_cache.clear()
+        self._nym_cache[identifier] = rec
+        return rec
+
     def _author_role(self, request: Request):
-        if request.identifier is None:
+        idr = request.identifier
+        if idr is None:
             return None
-        val, _, _ = decode_state_value(self.state.get(
-            nym_to_state_key(request.identifier), isCommitted=False))
-        return (val or {}).get(ROLE)
+        return (self.cached_nym_record(idr) or {}).get(ROLE)
+
+    def clear_caches(self):
+        """State was rewound under us (batch revert / catchup): every
+        cached read may now be stale."""
+        self._nym_cache.clear()
+        self._lookup_memo = None
 
     def update_state(self, txn: dict, prev_result, request: Request,
                      is_committed: bool = False):
-        data = get_payload_data(txn)
+        payload = txn[TXN_PAYLOAD]
+        data = payload[TXN_PAYLOAD_DATA]
+        md = txn.get(TXN_METADATA) or {}
+        seq_no = md.get(TXN_METADATA_SEQ_NO)
         nym = data[TARGET_NYM]
         key = nym_to_state_key(nym)
         memo = self._lookup_memo
         if memo is not None and memo[1] == key and \
-                memo[0] == self.state.headHash:
+                memo[0] == getattr(self.state, "mutation_count", object()):
             raw = memo[2]
         else:
             raw = self.state.get(key, isCommitted=False)
         existing, _, _ = decode_state_value(raw)
         value = dict(existing or {})
-        value["identifier"] = get_from(txn)
+        value["identifier"] = payload[TXN_PAYLOAD_METADATA].get(
+            TXN_PAYLOAD_METADATA_FROM)
         if ROLE in data:
             value[ROLE] = data[ROLE]
         if VERKEY in data:
             value[VERKEY] = data[VERKEY]
-        value.setdefault("seqNo", get_seq_no(txn))
-        self.state.set(nym_to_state_key(nym),
-                       encode_state_value(value, get_seq_no(txn),
-                                          get_txn_time(txn)))
+        value.setdefault("seqNo", seq_no)
+        self.state.set(key, encode_state_value(
+            value, seq_no, md.get(TXN_METADATA_TIME)))
+        self._nym_cache.pop(nym, None)
         return value
 
     def get_nym_details(self, nym: str, is_committed=True):

@@ -175,11 +175,17 @@ class VerifyDaemon:
                     del all_items[lo:]
                     logger.warning("malformed verify request", exc_info=True)
                 spans.append((lo, len(all_items) - lo))
+            # dedup byte-identical items across nodes: every node on the
+            # host verifies the SAME client requests, so n connected
+            # nodes would otherwise cost n× the device work per request
+            from plenum_tpu.crypto.batch_verifier import dedup_items
+            order, index = dedup_items(all_items)
             # run on the worker thread so the loop keeps reading frames
             # (batch k+1 coalesces during batch k's device round trip)
             try:
-                results = await loop.run_in_executor(
-                    self._pool, self._verify_bucketed, all_items)
+                uniq_results = await loop.run_in_executor(
+                    self._pool, self._verify_bucketed, order)
+                results = [uniq_results[i] for i in index]
             except Exception:
                 logger.warning("verify batch failed", exc_info=True)
                 results = [False] * len(all_items)

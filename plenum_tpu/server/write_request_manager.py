@@ -109,10 +109,17 @@ class WriteRequestManager:
     def apply_request(self, request: Request, batch_ts: int) -> dict:
         """Stage one request: reqToTxn, update uncommitted state, stage
         ledger txn. Returns the txn."""
+        from plenum_tpu.common.constants import (
+            TXN_METADATA, TXN_METADATA_SEQ_NO, TXN_METADATA_TIME)
         handler = self.request_handlers[request.txn_type]
-        txn = append_txn_metadata(reqToTxn(request), txn_time=batch_ts)
+        txn = reqToTxn(request)
         ledger = handler.ledger
-        ledger.append_txns_metadata([txn], batch_ts)
+        # one metadata write: seq_no + time together (append_txn_metadata
+        # + append_txns_metadata used to each rebuild this dict)
+        txn[TXN_METADATA] = {
+            TXN_METADATA_SEQ_NO: ledger.uncommitted_size + 1,
+            TXN_METADATA_TIME: batch_ts,
+        }
         ledger.appendTxns([txn])
         handler.update_state(txn, None, request)
         return txn
@@ -171,6 +178,10 @@ class WriteRequestManager:
         """Reset every state head to match the last remaining staged batch
         (or the committed root if none): heads are recomputed from the
         audit ledger's staged entries."""
+        for handler in self.request_handlers.values():
+            clear = getattr(handler, "clear_caches", None)
+            if clear is not None:
+                clear()
         audit = self.database_manager.get_ledger(AUDIT_LEDGER_ID)
         last_roots = None
         if audit is not None and audit.uncommittedTxns:

@@ -66,19 +66,47 @@ class PruningState(State):
             committed = BLANK_ROOT
         self._trie = _TrieBackend(kv, committed)
         self._committed_root = committed
+        # write buffer: set/remove land here; the trie absorbs the whole
+        # batch in ONE deferred-hash pass when the head root is actually
+        # needed (headHash / commit). Shared path nodes then hash once
+        # per batch instead of once per request. Uncommitted gets read
+        # through the buffer, so apply-loop read-your-writes holds.
+        self._pending: dict = {}
+        # bumps on every write; validation memos key on it (cheaper than
+        # forcing a flush to compare head roots)
+        self.mutation_count = 0
 
     # ------------------------------------------------------------ writes
 
     def set(self, key: bytes, value: bytes):
-        self._trie.set(key, value)
+        self._pending[bytes(key)] = bytes(value)
+        self.mutation_count += 1
 
     def remove(self, key: bytes):
-        self._trie.delete(key)
+        self._pending[bytes(key)] = b""  # empty == delete (trie semantics)
+        self.mutation_count += 1
+
+    def _flush_pending(self):
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, {}
+        set_many = getattr(self._trie, "set_many", None)
+        if set_many is not None:
+            set_many(list(pending.items()))
+        else:
+            for k, v in pending.items():
+                if v:
+                    self._trie.set(k, v)
+                else:
+                    self._trie.delete(k)
 
     def get(self, key: bytes, isCommitted: bool = True) -> Optional[bytes]:
         if isCommitted:
             return self._trie.get_at_root(self._committed_root, key)
-        return self._trie.get(key)
+        k = bytes(key)
+        if k in self._pending:
+            return self._pending[k] or None
+        return self._trie.get(k)
 
     def get_for_root_hash(self, root_hash: bytes, key: bytes
                           ) -> Optional[bytes]:
@@ -92,17 +120,21 @@ class PruningState(State):
         The working head is NOT moved: later uncommitted batches may
         already be staged on top of the committed prefix (3PC pipelines
         several batches in flight)."""
+        self._flush_pending()
         root = rootHash if rootHash is not None else self._trie.root_hash
         self._committed_root = root
         self._kv.put(self.rootHashKey, root)
 
     def revertToHead(self, headHash: bytes):
+        self._pending.clear()  # buffered writes belong to the abandoned head
+        self.mutation_count += 1
         self._trie.root_hash = headHash
 
     # ------------------------------------------------------------- heads
 
     @property
     def head(self):
+        self._flush_pending()
         return self._trie
 
     @property
@@ -111,6 +143,7 @@ class PruningState(State):
 
     @property
     def headHash(self) -> bytes:
+        self._flush_pending()
         return self._trie.root_hash
 
     @property

@@ -53,6 +53,14 @@ class NativeTrie:
                                   bytes(value))
         self._flush()
 
+    def set_many(self, pairs):
+        """Batched set (empty value deletes): one deferred-hash C pass —
+        path nodes shared by the batch hash once, not once per key.
+        Only the final root is a readable snapshot."""
+        self.root_hash = _mpt.set_many(self._h, self.root_hash,
+                                       list(pairs))
+        self._flush()
+
     def delete(self, key: bytes):
         self.root_hash = _mpt.delete(self._h, self.root_hash, bytes(key))
         self._flush()

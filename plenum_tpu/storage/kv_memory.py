@@ -16,13 +16,16 @@ class KeyValueStorageInMemory(KeyValueStorage):
         self._closed = False
 
     def put(self, key, value):
-        self._dict[to_bytes(key)] = to_bytes(value)
+        # hot path: trie-node persists pass bytes already — an exact
+        # type check dodges two function calls per put
+        self._dict[key if type(key) is bytes else to_bytes(key)] = \
+            value if type(value) is bytes else to_bytes(value)
 
     def get(self, key) -> bytes:
-        return self._dict[to_bytes(key)]
+        return self._dict[key if type(key) is bytes else to_bytes(key)]
 
     def remove(self, key):
-        self._dict.pop(to_bytes(key), None)
+        self._dict.pop(key if type(key) is bytes else to_bytes(key), None)
 
     def setBatch(self, batch: Iterable[Tuple]):
         for key, value in batch:

@@ -213,6 +213,24 @@ def test_single_node_read_with_multisig_proof(bls_keys, mock_timer):
     assert client.verify_state_proof(result, max_age=300, now=ts + 10)
     assert not client.verify_state_proof(result, max_age=300, now=ts + 10000)
 
+    # the constructor knob wires the window into _on_reply itself: a
+    # client started with proof_max_age rejects the same single stale
+    # reply end-to-end, a fresh-clock client accepts it
+    for clock, expect in ((lambda: ts + 10000, False),
+                          (lambda: ts + 10, True)):
+        w = Wallet()
+        w.add_identifier(signer=SimpleSigner(seed=b"\x56" * 32))
+        stale_client = PoolClient(
+            w, names, send_fn=lambda n, m: None,
+            bls_verifier=verifier, bls_key_provider=lambda n: bls_keys[n].pk,
+            proof_max_age=300, get_time=clock)
+        rq = w.sign_op({"type": "105", TARGET_NYM: author.identifier})
+        rr = copy.deepcopy(result)
+        rr["identifier"], rr["reqId"] = rq.identifier, rq.reqId
+        stale_client.submit_request(rq)
+        stale_client.receive(first, Reply(result=rr))
+        assert stale_client.is_confirmed(rq) is expect, (expect, clock())
+
     # forged multi-sig: signature bytes replaced → reject
     read3 = wallet.sign_op({"type": "105", TARGET_NYM: author.identifier})
     forged3 = copy.deepcopy(result)

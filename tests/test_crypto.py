@@ -145,13 +145,15 @@ def test_coalescing_hub_fuses_concurrent_dispatches():
     p3 = hub.dispatch([bad])
     assert launches == []                      # nothing launched yet
     assert p2.collect() == [True, False, True]
-    assert launches == [6]                     # one fused launch
+    # one fused launch, AND byte-identical items collapse to one device
+    # slot each (6 dispatched items, 2 distinct)
+    assert launches == [2]
     assert p1.collect() == [True, True]
     assert p3.collect() == [False]
-    assert launches == [6]                     # harvests reuse it
+    assert launches == [2]                     # harvests reuse it
     p4 = hub.dispatch([good])                  # new generation
     assert p4.collect() == [True]
-    assert launches == [6, 1]
+    assert launches == [2, 1]
     assert hub.verify_batch([]) == []          # empty dispatch safe
 
 
@@ -190,8 +192,13 @@ def test_coalescing_hub_scalar_floor_and_failure_isolation():
     # below threshold: CPU floor, the failing batch backend never runs
     assert hub.verify_batch([good, good]) == [True, True]
     assert launches == []
-    # at threshold: batch backend raises, but only this generation is hit
-    p_bad = hub.dispatch([good] * 4)
+    # at threshold (4 DISTINCT items — identical ones dedup below it):
+    # batch backend raises, but only this generation is hit
+    distinct = []
+    for i in range(4):
+        msg = b"m%d" % i
+        distinct.append((msg, ed.sign(msg, seed), vk))
+    p_bad = hub.dispatch(distinct)
     with pytest.raises(RuntimeError):
         p_bad.collect()
     assert hub.verify_batch([good, good]) == [True, True]  # hub still live
