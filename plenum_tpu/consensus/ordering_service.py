@@ -154,6 +154,18 @@ class OrderingService:
         self._network = network
         self._executor = executor
         self._config = config or Config()
+        # a PRE-PREPARE carries ~72 wire bytes per request digest; a
+        # batch big enough to push it past the transport frame limit
+        # would be dropped by the stack and wedge ordering at the first
+        # full batch — clamp the configured size to what always fits
+        frame_cap = max(1, (self._config.MSG_LEN_LIMIT - 8192) // 72)
+        self._max_batch_size = min(self._config.Max3PCBatchSize, frame_cap)
+        if self._max_batch_size < self._config.Max3PCBatchSize:
+            logger.warning(
+                "Max3PCBatchSize %d exceeds what a PRE-PREPARE frame can "
+                "carry under MSG_LEN_LIMIT=%d; clamped to %d",
+                self._config.Max3PCBatchSize, self._config.MSG_LEN_LIMIT,
+                self._max_batch_size)
         self._bls = bls_bft_replica
         self._freshness_checker = freshness_checker
         # optional hook: called with (view_no, pp_seq_no) after this
@@ -249,7 +261,7 @@ class OrderingService:
             in_flight = self.lastPrePrepareSeqNo - self._data.last_ordered_3pc[1]
             if in_flight >= self._config.Max3PCBatchesInFlight:
                 break
-            full = len(queue) >= self._config.Max3PCBatchSize
+            full = len(queue) >= self._max_batch_size
             oldest = next(iter(queue), None)
             waited = (self._timer.get_current_time()
                       - self._queue_entry_time.get(oldest, 0))
@@ -289,7 +301,7 @@ class OrderingService:
 
     def _send_one_batch(self, ledger_id: int, queue: OrderedDict):
         digests = []
-        while queue and len(digests) < self._config.Max3PCBatchSize:
+        while queue and len(digests) < self._max_batch_size:
             d, _ = queue.popitem(last=False)
             self._queue_entry_time.pop(d, None)
             digests.append(d)

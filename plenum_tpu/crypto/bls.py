@@ -101,9 +101,48 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
     subgroup membership and the aggregate key are cached per key-set
     (the reference's ursa keys are likewise deserialized once)."""
 
+    # Miller-line blob for the FIXED -G2 generator argument of every
+    # verification (shared by all instances; computed once per process)
+    _neg_g2_prep = None
+
     def __init__(self):
         self._pk_cache = {}        # b58 pk -> (point, in_subgroup)
         self._agg_cache = {}       # tuple(pks) -> aggregate point | None
+        # G2 point (by id of cached object) -> prepared Miller lines:
+        # a validator re-verifies against the same pool key-set every
+        # batch, so the Q-only pairing work is paid once per set
+        self._prep_cache = {}
+
+    def _prepared(self, key, point):
+        """Miller-precompute blob for a cached G2 point (None when the
+        backend lacks prepared pairings)."""
+        if bls.miller_precompute is None:
+            return None
+        blob = self._prep_cache.get(key)
+        if blob is None:
+            try:
+                blob = bls.miller_precompute(point)
+            except ValueError:
+                return None
+            if len(self._prep_cache) > 1024:
+                self._prep_cache.clear()
+            self._prep_cache[key] = blob
+        return blob
+
+    def _pairing_is_one(self, sig_point, h_point, q_key, q_point) -> bool:
+        """e(sig, -G2)·e(H(m), Q) == 1, through the prepared path when
+        the native backend offers it."""
+        if bls.multi_pairing_is_one_prepared is not None:
+            cls = BlsCryptoVerifierPlenum
+            if cls._neg_g2_prep is None and bls.miller_precompute:
+                cls._neg_g2_prep = bls.miller_precompute(
+                    bls.g2_neg(bls.G2_GEN))
+            q_prep = self._prepared(q_key, q_point)
+            if cls._neg_g2_prep is not None and q_prep is not None:
+                return bls.multi_pairing_is_one_prepared(
+                    [(sig_point, cls._neg_g2_prep), (h_point, q_prep)])
+        return bls.multi_pairing_is_one(
+            [(sig_point, bls.g2_neg(bls.G2_GEN)), (h_point, q_point)])
 
     def _g1(self, s: str):
         return bls.g1_decompress(_unb58(s))
@@ -154,14 +193,14 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
             return False
         h = bls.hash_to_g1(message, _DST_SIG)
         # e(sig, G2) == e(H(m), pk)  ⇔  e(sig, -G2)·e(H(m), pk) == 1
-        return bls.multi_pairing_is_one(
-            [(sig, bls.g2_neg(bls.G2_GEN)), (h, pub)])
+        return self._pairing_is_one(sig, h, pk, pub)
 
     def verify_multi_sig(self, signature: str, message: bytes,
                          pks: Sequence[str]) -> bool:
         if not pks:
             return False
-        agg_pk = self._aggregate_pks(pks)
+        key = tuple(pks)
+        agg_pk = self._aggregate_pks(key)
         try:
             sig = self._g1(signature)
         except (ValueError, KeyError):
@@ -171,8 +210,7 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
         if not bls.g1_in_subgroup(sig):
             return False
         h = bls.hash_to_g1(message, _DST_SIG)
-        return bls.multi_pairing_is_one(
-            [(sig, bls.g2_neg(bls.G2_GEN)), (h, agg_pk)])
+        return self._pairing_is_one(sig, h, key, agg_pk)
 
     def create_multi_sig(self, signatures: Sequence[str]) -> str:
         agg = None
@@ -192,8 +230,7 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
             return False
         pk_bytes = _unb58(pk)
         h = bls.hash_to_g1(pk_bytes, _DST_POP)
-        return bls.multi_pairing_is_one(
-            [(proof, bls.g2_neg(bls.G2_GEN)), (h, pub)])
+        return self._pairing_is_one(proof, h, pk, pub)
 
 
 class MultiSignatureValue:

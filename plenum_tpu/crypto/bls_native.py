@@ -38,6 +38,17 @@ def _get_lib():
         lib.b_g1_decompress.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.b_g1_decompress.restype = ctypes.c_int
         lib.b_pairing.argtypes = [ctypes.c_char_p] * 2 + [ctypes.c_char_p]
+        lib.b_hash_to_g1.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_char_p]
+        lib.b_hash_to_g1.restype = ctypes.c_int
+        lib.b_prep_size.restype = ctypes.c_int
+        lib.b_miller_precompute.argtypes = [ctypes.c_char_p,
+                                            ctypes.c_char_p]
+        lib.b_miller_precompute.restype = ctypes.c_int
+        lib.b_multi_pairing_is_one_prepared.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]
+        lib.b_multi_pairing_is_one_prepared.restype = ctypes.c_int
         _lib = lib
     return _lib
 
@@ -118,6 +129,45 @@ def multi_pairing_is_one(pairs: Sequence[Tuple[G1Point, G2Point]]) -> bool:
     g1s = b"".join(_g1_bytes(p) for p, _ in pairs)
     g2s = b"".join(_g2_bytes(q) for _, q in pairs)
     return bool(_get_lib().b_multi_pairing_is_one(n, g1s, g2s))
+
+
+def hash_to_g1(msg: bytes, dst: bytes = b"PLENUM_TPU_BLS_G1") -> G1Point:
+    """Full-native try-and-increment hash-to-curve — bit-identical to
+    bls12_381.hash_to_g1 (cross-checked in tests)."""
+    out = ctypes.create_string_buffer(96)
+    rc = _get_lib().b_hash_to_g1(bytes(msg), len(msg), bytes(dst),
+                                 len(dst), out)
+    if rc != 0:
+        raise ValueError("hash_to_g1 failed")
+    return _g1_from(out.raw)
+
+
+def miller_precompute(q: G2Point) -> bytes:
+    """Per-step Miller line coefficients for a FIXED G2 argument —
+    opaque blob consumed by multi_pairing_is_one_prepared. A validator
+    pairs against the same G2 points on every verify (the generator and
+    the pool's aggregated key), so the Q-only half of the Miller loop
+    is hoisted out of the per-verify path."""
+    lib = _get_lib()
+    out = ctypes.create_string_buffer(lib.b_prep_size())
+    rc = lib.b_miller_precompute(_g2_bytes(q), out)
+    if rc != 0:
+        raise ValueError("cannot precompute lines for this G2 point")
+    return out.raw
+
+
+def multi_pairing_is_one_prepared(
+        pairs: Sequence[Tuple[G1Point, bytes]]) -> bool:
+    """Πᵢ e(Pᵢ, Qᵢ) == 1 with every Qᵢ given as a miller_precompute
+    blob. ONE shared fp12 squaring chain for all pairs."""
+    n = len(pairs)
+    if not 1 <= n <= 8:
+        # the C fast path sizes its stack for the verification shapes
+        # (2 pairs); outside it, callers must use the plain path
+        raise ValueError("prepared multi-pairing supports 1..8 pairs")
+    g1s = b"".join(_g1_bytes(p) for p, _ in pairs)
+    preps = b"".join(prep for _, prep in pairs)
+    return bool(_get_lib().b_multi_pairing_is_one_prepared(n, g1s, preps))
 
 
 def g1_decompress(data: bytes) -> G1Point:

@@ -57,11 +57,18 @@ if _native is not None:
     g2_mul = _native.g2_mul
     multi_pairing_is_one = _native.multi_pairing_is_one
     g1_decompress = _native.g1_decompress  # noqa: F811 (hot override)
+    # prepared pairings: precomputed line coefficients for fixed G2
+    # arguments (verifiers pair against the same generator/pool-key on
+    # every verify); None on the Python backend — callers fall back
+    miller_precompute = _native.miller_precompute
+    multi_pairing_is_one_prepared = _native.multi_pairing_is_one_prepared
 else:
     g1_add = _py.g1_add
     g1_mul = _py.g1_mul
     g2_add = _py.g2_add
     g2_mul = _py.g2_mul
+    miller_precompute = None
+    multi_pairing_is_one_prepared = None
 
     def multi_pairing_is_one(
             pairs: Sequence[Tuple[G1Point, G2Point]]) -> bool:
@@ -69,8 +76,11 @@ else:
 
 
 def hash_to_g1(msg: bytes, dst: bytes = b"PLENUM_TPU_BLS_G1") -> G1Point:
-    """The single shared try-and-increment construction from bls12_381,
-    with the cofactor clearing running on the fast backend."""
+    """The single shared try-and-increment construction from bls12_381;
+    fully native when the C backend is up (sha256 + sqrt + cofactor in
+    one call), else the Python construction with the fast scalar mul."""
+    if _native is not None:
+        return _native.hash_to_g1(msg, dst)
     return _py.hash_to_g1(msg, dst, g1_mul_fn=g1_mul)
 
 

@@ -1281,6 +1281,316 @@ int b_g1_decompress(const u8 *in48, u8 *out96) {
 }
 
 /* ∏ e(P_i, Q_i) == 1 ? (one shared final exponentiation) */
+/* ------------------------------------------------------------------ */
+/* SHA-256 (FIPS 180-4) — needed by the hash-to-curve construction,    */
+/* which must be bit-identical to crypto/bls12_381.py hash_to_g1       */
+/* ------------------------------------------------------------------ */
+
+typedef uint32_t u32;
+typedef struct { u32 h[8]; u64 len; u8 buf[64]; size_t buflen; } sha_ctx;
+
+static const u32 SK256[64] = {
+    0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,
+    0x923f82a4,0xab1c5ed5,0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,
+    0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,0xe49b69c1,0xefbe4786,
+    0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+    0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,
+    0x06ca6351,0x14292967,0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,
+    0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,0xa2bfe8a1,0xa81a664b,
+    0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+    0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,
+    0x5b9cca4f,0x682e6ff3,0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,
+    0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2
+};
+#define SROR(x,n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static void sha_init(sha_ctx *c) {
+    c->h[0]=0x6a09e667; c->h[1]=0xbb67ae85; c->h[2]=0x3c6ef372;
+    c->h[3]=0xa54ff53a; c->h[4]=0x510e527f; c->h[5]=0x9b05688c;
+    c->h[6]=0x1f83d9ab; c->h[7]=0x5be0cd19; c->len=0; c->buflen=0;
+}
+
+static void sha_block(sha_ctx *c, const u8 *p) {
+    u32 w[64], a,b2,cc,d,e,f,g,h2,t1,t2;
+    int i;
+    for (i = 0; i < 16; i++)
+        w[i] = ((u32)p[4*i]<<24)|((u32)p[4*i+1]<<16)
+             | ((u32)p[4*i+2]<<8)|(u32)p[4*i+3];
+    for (i = 16; i < 64; i++) {
+        u32 s0 = SROR(w[i-15],7)^SROR(w[i-15],18)^(w[i-15]>>3);
+        u32 s1 = SROR(w[i-2],17)^SROR(w[i-2],19)^(w[i-2]>>10);
+        w[i] = w[i-16]+s0+w[i-7]+s1;
+    }
+    a=c->h[0]; b2=c->h[1]; cc=c->h[2]; d=c->h[3];
+    e=c->h[4]; f=c->h[5]; g=c->h[6]; h2=c->h[7];
+    for (i = 0; i < 64; i++) {
+        u32 S1 = SROR(e,6)^SROR(e,11)^SROR(e,25);
+        u32 ch = (e&f)^((~e)&g);
+        t1 = h2+S1+ch+SK256[i]+w[i];
+        u32 S0 = SROR(a,2)^SROR(a,13)^SROR(a,22);
+        u32 mj = (a&b2)^(a&cc)^(b2&cc);
+        t2 = S0+mj;
+        h2=g; g=f; f=e; e=d+t1; d=cc; cc=b2; b2=a; a=t1+t2;
+    }
+    c->h[0]+=a; c->h[1]+=b2; c->h[2]+=cc; c->h[3]+=d;
+    c->h[4]+=e; c->h[5]+=f; c->h[6]+=g; c->h[7]+=h2;
+}
+
+static void sha_update(sha_ctx *c, const u8 *p, size_t n) {
+    c->len += n;
+    if (c->buflen) {
+        size_t take = 64 - c->buflen;
+        if (take > n) take = n;
+        memcpy(c->buf + c->buflen, p, take);
+        c->buflen += take; p += take; n -= take;
+        if (c->buflen == 64) { sha_block(c, c->buf); c->buflen = 0; }
+    }
+    while (n >= 64) { sha_block(c, p); p += 64; n -= 64; }
+    if (n) { memcpy(c->buf, p, n); c->buflen = n; }
+}
+
+static void sha_final(sha_ctx *c, u8 out[32]) {
+    u64 bits = c->len * 8;
+    u8 pad = 0x80, z = 0, lb[8];
+    int i;
+    sha_update(c, &pad, 1);
+    while (c->buflen != 56) sha_update(c, &z, 1);
+    for (i = 0; i < 8; i++) lb[i] = (u8)(bits >> (56 - 8*i));
+    sha_update(c, lb, 8);
+    for (i = 0; i < 8; i++) {
+        out[4*i]   = (u8)(c->h[i] >> 24);
+        out[4*i+1] = (u8)(c->h[i] >> 16);
+        out[4*i+2] = (u8)(c->h[i] >> 8);
+        out[4*i+3] = (u8)(c->h[i]);
+    }
+}
+
+/* fixed-exponent Montgomery pow over raw little-endian u64 limbs */
+static void fp_pow_limbs(fp *r, const fp *a, const u64 *e, int nlimbs) {
+    fp acc;
+    int started = 0;
+    memcpy(acc.l, ONE_M, sizeof ONE_M);
+    for (int i = nlimbs - 1; i >= 0; i--) {
+        for (int b = 63; b >= 0; b--) {
+            if (started) fp_sqr(&acc, &acc);
+            if ((e[i] >> b) & 1) {
+                if (!started) { acc = *a; started = 1; }
+                else fp_mul(&acc, &acc, a);
+            }
+        }
+    }
+    *r = acc;
+}
+
+/* hash-to-curve: bit-identical to bls12_381.py hash_to_g1 (try-and-
+ * increment over SHA-256, sqrt by (Q+1)/4, smaller root, cofactor
+ * cleared by (1+X_ABS)^2/3). Returns 0 ok / -1 if the cofactor mul
+ * lands at infinity (the Python path retries ctr in that case too). */
+int b_hash_to_g1(const u8 *msg, int msg_len, const u8 *dst, int dst_len,
+                 u8 *out96) {
+    u64 sqrt_e[NL];      /* (Q+1)/4 */
+    u8 cof[32];          /* (1+X_ABS)^2 / 3, big-endian 32 bytes */
+    {
+        /* (Q+1)/4: Q is odd, Q+1 even; shift the raw modulus right 2 */
+        u64 t[NL];
+        memcpy(t, Qm, sizeof t);
+        t[0] += 1;                   /* Q odd => no carry chain needed */
+        for (int i = 0; i < NL; i++) {
+            u64 lo = t[i] >> 2;
+            if (i + 1 < NL) lo |= t[i + 1] << 62;
+            sqrt_e[i] = lo;
+        }
+        /* cofactor (1+X_ABS)^2/3 fits 128 bits */
+        unsigned __int128 c = (unsigned __int128)(X_ABS + 1)
+            * (X_ABS + 1) / 3;
+        memset(cof, 0, sizeof cof);
+        for (int i = 0; i < 16; i++)
+            cof[31 - i] = (u8)(c >> (8 * i));
+    }
+    for (u32 ctr = 0; ; ctr++) {
+        u8 d1[32], d2[32], xb[48], ctr_be[4];
+        sha_ctx c;
+        fp x, yy, y, y2, t;
+        ctr_be[0] = (u8)(ctr >> 24); ctr_be[1] = (u8)(ctr >> 16);
+        ctr_be[2] = (u8)(ctr >> 8); ctr_be[3] = (u8)ctr;
+        sha_init(&c);
+        sha_update(&c, dst, (size_t)dst_len);
+        sha_update(&c, ctr_be, 4);
+        sha_update(&c, msg, (size_t)msg_len);
+        sha_final(&c, d1);
+        sha_init(&c);
+        { u8 one = 1; sha_update(&c, &one, 1); }
+        sha_update(&c, d1, 32);
+        sha_final(&c, d2);
+        memcpy(xb, d1, 32);
+        memcpy(xb + 32, d2, 16);
+        /* 48-byte big-endian value mod Q — raw reduce then Montgomery */
+        {
+            /* 48-byte value < 2^384; 2^384/Q < 8, so loop-subtract Q
+               (tracked with one overflow limb) until below it */
+            u64 v[NL + 1];
+            memset(v, 0, sizeof v);
+            for (int i = 0; i < 48; i++) {
+                int limb = (47 - i) / 8, byte = (47 - i) % 8;
+                v[limb] |= (u64)xb[i] << (8 * byte);
+            }
+            for (;;) {
+                int ge;
+                if (v[NL] != 0) {
+                    ge = 1;
+                } else {
+                    ge = 1;
+                    for (int i = NL - 1; i >= 0; i--) {
+                        if (v[i] != Qm[i]) { ge = v[i] > Qm[i]; break; }
+                    }
+                }
+                if (!ge) break;
+                unsigned __int128 br = 0;
+                for (int i = 0; i < NL; i++) {
+                    unsigned __int128 dd = (unsigned __int128)v[i]
+                        - Qm[i] - br;
+                    v[i] = (u64)dd;
+                    br = (dd >> 64) & 1;
+                }
+                v[NL] -= (u64)br;
+            }
+            u8 canon[48];
+            for (int i = 0; i < 48; i++) {
+                int limb = (47 - i) / 8, byte = (47 - i) % 8;
+                canon[i] = (u8)(v[limb] >> (8 * byte));
+            }
+            fp_from_bytes(&x, canon);
+        }
+        /* yy = x^3 + 4 */
+        fp_sqr(&t, &x);
+        fp_mul(&yy, &t, &x);
+        {
+            fp four;
+            memcpy(four.l, ONE_M, sizeof ONE_M);
+            fp_add(&four, &four, &four);
+            fp_add(&four, &four, &four);
+            fp_add(&yy, &yy, &four);
+        }
+        fp_pow_limbs(&y, &yy, sqrt_e, NL);
+        fp_sqr(&y2, &y);
+        if (memcmp(y2.l, yy.l, sizeof yy.l) != 0)
+            continue;  /* not a QR: next counter */
+        /* smaller of y, Q-y by canonical value */
+        {
+            u8 yb[48], nyb[48];
+            fp ny;
+            fp_neg(&ny, &y);
+            fp_to_bytes(yb, &y);
+            fp_to_bytes(nyb, &ny);
+            if (memcmp(nyb, yb, 48) < 0) y = ny;
+        }
+        {
+            g1 p, r;
+            p.x = x; p.y = y; p.inf = 0;
+            g1_mul_scalar(&r, &p, cof);
+            if (r.inf) continue;  /* mirror the Python retry */
+            g1_to_bytes(out96, &r);
+            return 0;
+        }
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* prepared pairings: precomputed line coefficients for a fixed Q      */
+/*                                                                     */
+/* A validator verifies every multi-sig against the SAME two G2        */
+/* arguments — the group generator and the pool's aggregated key       */
+/* (cached per participant set). The Miller doubling/addition chain    */
+/* depends only on Q, so its (l0,l1,l4) line coefficients can be       */
+/* computed once per Q and replayed: the per-verify loop then costs    */
+/* one shared fp12 squaring chain plus sparse line evaluations.        */
+/* ------------------------------------------------------------------ */
+
+/* doubling steps (63) + addition steps (popcount(X_ABS)-1) */
+#define MILLER_SLOTS 68
+/* each slot: 3 fp2 = 6 fp = 36 u64 (Montgomery form, opaque blob) */
+#define PREP_SIZE (MILLER_SLOTS * 3 * sizeof(fp2))
+
+int b_prep_size(void) { return (int)PREP_SIZE; }
+
+int b_miller_precompute(const u8 *g2b, u8 *out) {
+    g2 q;
+    fp2 *slots = (fp2 *)out;
+    g2p r;
+    int slot = 0;
+    g2_from_bytes(&q, g2b);
+    if (q.inf) return -1;
+    r.X = q.x;
+    r.Y = q.y;
+    memset(&r.Z, 0, sizeof r.Z);
+    memcpy(r.Z.c0.l, ONE_M, sizeof ONE_M);
+    {
+        int started = 0;
+        for (int b = 63; b >= 0; b--) {
+            if (!started) {
+                if ((X_ABS >> b) & 1) started = 1;
+                continue;
+            }
+            miller_dbl(&r, &slots[slot * 3], &slots[slot * 3 + 1],
+                       &slots[slot * 3 + 2]);
+            slot++;
+            if ((X_ABS >> b) & 1) {
+                miller_add(&r, &q, &slots[slot * 3],
+                           &slots[slot * 3 + 1], &slots[slot * 3 + 2]);
+                slot++;
+            }
+        }
+    }
+    return slot == MILLER_SLOTS ? 0 : -1;
+}
+
+/* shared-squaring multi-Miller over prepared lines: ONE fp12 squaring
+ * chain for all n pairs (the plain path squares per pair), sparse line
+ * evaluation per pair per step. preps = n blobs of PREP_SIZE. */
+int b_multi_pairing_is_one_prepared(int n, const u8 *g1s,
+                                    const u8 *preps) {
+    fp12 f;
+    g1 ps[8];
+    int live[8];
+    int slot = 0;
+    if (n < 1 || n > 8) return 0;
+    for (int i = 0; i < n; i++) {
+        g1_from_bytes(&ps[i], g1s + (size_t)i * 96);
+        live[i] = !ps[i].inf;
+    }
+    fp12_one(&f);
+    {
+        int started = 0;
+        for (int b = 63; b >= 0; b--) {
+            if (!started) {
+                if ((X_ABS >> b) & 1) started = 1;
+                continue;
+            }
+            fp12_sqr(&f, &f);
+            for (int i = 0; i < n; i++) {
+                const fp2 *ln = (const fp2 *)(preps + (size_t)i * PREP_SIZE)
+                    + (size_t)slot * 3;
+                if (live[i])
+                    miller_ell(&f, &ln[0], &ln[1], &ln[2], &ps[i]);
+            }
+            slot++;
+            if ((X_ABS >> b) & 1) {
+                for (int i = 0; i < n; i++) {
+                    const fp2 *ln = (const fp2 *)(preps
+                        + (size_t)i * PREP_SIZE) + (size_t)slot * 3;
+                    if (live[i])
+                        miller_ell(&f, &ln[0], &ln[1], &ln[2], &ps[i]);
+                }
+                slot++;
+            }
+        }
+    }
+    /* x < 0: conj, exactly as miller() does */
+    fp12_conj(&f, &f);
+    final_exp(&f, &f);
+    return fp12_is_one(&f);
+}
+
 int b_multi_pairing_is_one(int n, const u8 *g1s, const u8 *g2s) {
     fp12 acc, fi;
     fp12_one(&acc);

@@ -149,6 +149,9 @@ class NetworkedNode(Prodable):
             if _time.monotonic() - self._pending_since > \
                     self.config.CLIENT_AUTH_TIMEOUT:
                 pending, self._pending_auth = self._pending_auth, None
+                logger.warning("%s: verify batch fallback harvest after "
+                            "%.1fs", self._name,
+                            _time.monotonic() - self._pending_since)
                 self.node.conclude_client_batch(pending)
             else:
                 return count
@@ -156,6 +159,8 @@ class NetworkedNode(Prodable):
             self._client_buf = []
             self._pending_auth = self.node.dispatch_client_batch(buf)
             self._pending_since = _time.monotonic()
+            logger.debug("%s: dispatched verify batch of %d",
+                        self._name, len(buf))
             # a coalescing provider (tpu_hub) needs an explicit flush to
             # start its launch — in this process nothing else will
             self.node.authnr.flush()
@@ -189,7 +194,10 @@ class NetworkedNode(Prodable):
         # harvest a landed verification batch before taking new work
         if self._pending_auth is not None and \
                 self.node.client_batch_ready(self._pending_auth):
+            import time as _time
             pending, self._pending_auth = self._pending_auth, None
+            logger.debug("%s: verify batch landed after %.2fs", self._name,
+                        _time.monotonic() - (self._pending_since or 0))
             self.node.conclude_client_batch(pending)
         c = self.nodestack.service(
             self._on_node_wire_msg,

@@ -182,6 +182,9 @@ class VerifyDaemon:
             order, index = dedup_items(all_items)
             # run on the worker thread so the loop keeps reading frames
             # (batch k+1 coalesces during batch k's device round trip)
+            t_launch = loop.time()
+            logger.debug("batch: %d items (%d unique) from %d requests",
+                        len(all_items), len(order), len(batch))
             try:
                 uniq_results = await loop.run_in_executor(
                     self._pool, self._verify_bucketed, order)
@@ -189,6 +192,7 @@ class VerifyDaemon:
             except Exception:
                 logger.warning("verify batch failed", exc_info=True)
                 results = [False] * len(all_items)
+            logger.debug("batch done in %.2fs", loop.time() - t_launch)
             self.served += len(all_items)
             self.launches += 1
             for (writer, req_id, _), (lo, cnt) in zip(batch, spans):

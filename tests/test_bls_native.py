@@ -129,3 +129,68 @@ def test_subgroup_check_rejects_non_subgroup_points():
     cleared = B.g1_mul(p, ((1 + B.X_ABS) ** 2) // 3)
     assert bls_ops.g1_in_subgroup(cleared)
     assert not bls_ops.g1_in_subgroup(p)
+
+
+def test_native_hash_to_g1_matches_python():
+    """C try-and-increment must be bit-identical to the Python
+    construction — the hash target is consensus state."""
+    import os
+    pytest.importorskip("ctypes")
+    from plenum_tpu.crypto import bls_native as N
+    from plenum_tpu.crypto import bls12_381 as B
+    if not N.available():
+        pytest.skip("no C compiler")
+    rng_msgs = [b"", b"x", b"state-root" * 7] + \
+        [bytes([i]) * (i + 1) for i in range(0, 40, 7)]
+    for msg in rng_msgs:
+        for dst in (b"PLENUM_TPU_BLS_G1", b"BLS_SIG_PLENUMTPU_G1"):
+            assert N.hash_to_g1(msg, dst) == B.hash_to_g1(msg, dst), \
+                (msg, dst)
+
+
+def test_prepared_pairing_matches_plain():
+    """Prepared (precomputed-lines, shared-squaring) pairing must agree
+    with the plain path on valid AND invalid signature relations."""
+    from plenum_tpu.crypto import bls_native as N
+    from plenum_tpu.crypto import bls12_381 as B
+    if not N.available():
+        pytest.skip("no C compiler")
+    if N.miller_precompute is None:
+        pytest.skip("prepared pairing unavailable")
+    neg = B.g2_neg(B.G2_GEN)
+    prep_neg = N.miller_precompute(neg)
+    for sk in (5, 2**200 + 7, B.R - 3):
+        h = B.hash_to_g1(b"m%d" % (sk % 97))
+        sig = B.g1_mul(h, sk)
+        pk = B.g2_mul(B.G2_GEN, sk)
+        prep_pk = N.miller_precompute(pk)
+        ok = N.multi_pairing_is_one_prepared(
+            [(sig, prep_neg), (h, prep_pk)])
+        assert ok == N.multi_pairing_is_one([(sig, neg), (h, pk)])
+        assert ok
+        bad = B.g1_mul(h, sk + 1)
+        assert not N.multi_pairing_is_one_prepared(
+            [(bad, prep_neg), (h, prep_pk)])
+
+
+def test_verifier_prepared_cache_consistency():
+    """The verifier's prepared-pairing caches must never change verify
+    outcomes — same verdicts with cold and warm caches."""
+    from plenum_tpu.crypto.bls import (
+        BlsCryptoSignerPlenum, BlsCryptoVerifierPlenum)
+    msg = b"root"
+    signers = [BlsCryptoSignerPlenum.generate(bytes([i]) * 32)[0]
+               for i in range(4)]
+    sigs = [s.sign(msg) for s in signers]
+    pks = [s.pk for s in signers]
+    v = BlsCryptoVerifierPlenum()
+    multi = v.create_multi_sig(sigs)
+    r1 = v.verify_multi_sig(multi, msg, pks)      # cold
+    r2 = v.verify_multi_sig(multi, msg, pks)      # warm
+    assert r1 is True and r2 is True
+    assert v.verify_multi_sig(multi, b"other", pks) is False
+    assert v.verify_multi_sig(multi, msg, pks[:3]) is False
+    # share path
+    assert v.verify_sig(sigs[0], msg, pks[0])
+    assert v.verify_sig(sigs[0], msg, pks[0])     # warm prep
+    assert not v.verify_sig(sigs[0], msg, pks[1])
