@@ -650,14 +650,25 @@ def micro_bls():
         for _ in range(reps_a):
             multi = verifier.create_multi_sig(sigs)
         agg_s = (time.perf_counter() - t0) / reps_a
-        reps_v = 5
+        # first verify on a FRESH verifier pays one-time work a
+        # long-lived validator amortizes over every later batch: n G2
+        # subgroup checks, the aggregate key, and the prepared Miller
+        # lines — reported separately as the cold cost (a fresh
+        # instance per n, so earlier iterations can't pre-warm it; the
+        # process-wide -G2 preparation, ~0.2 ms, is excluded)
+        cold_verifier = BlsCryptoVerifierPlenum()
+        t0 = time.perf_counter()
+        ok = cold_verifier.verify_multi_sig(multi, msg, pks)
+        cold_s = time.perf_counter() - t0
+        reps_v = 10
         t0 = time.perf_counter()
         for _ in range(reps_v):
-            ok = verifier.verify_multi_sig(multi, msg, pks)
+            ok = cold_verifier.verify_multi_sig(multi, msg, pks)
         ver_s = (time.perf_counter() - t0) / reps_v
         assert ok
         out[str(n)] = {"aggregate_per_s": round(1 / agg_s, 1),
-                       "verify_per_s": round(1 / ver_s, 1)}
+                       "verify_per_s": round(1 / ver_s, 1),
+                       "cold_first_verify_ms": round(cold_s * 1e3, 1)}
     results["by_n"] = out
     # pure-Python pairing floor for context (one verify) — calls the
     # reference implementation directly, no backend switching

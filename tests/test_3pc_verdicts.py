@@ -291,3 +291,30 @@ def test_stashed_future_view_replays_after_view_change():
     assert verdict[0] == STASH_VIEW_3PC
     stashed_before = replica.stasher.stash_size(STASH_VIEW_3PC)
     assert stashed_before >= 0  # router is wired (smoke)
+
+
+def test_batch_size_clamped_to_frame_limit():
+    """A Max3PCBatchSize whose PRE-PREPARE would exceed the transport
+    frame limit is clamped (the stack would otherwise drop the frame
+    and wedge ordering at the first full batch)."""
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.consensus.consensus_shared_data import (
+        ConsensusSharedData)
+    from plenum_tpu.consensus.ordering_service import (
+        OrderingService, SimExecutor)
+    from plenum_tpu.runtime.bus import ExternalBus, InternalBus
+    from plenum_tpu.testing.mock_timer import MockTimer
+
+    def make(batch, limit):
+        conf = Config(Max3PCBatchSize=batch, MSG_LEN_LIMIT=limit)
+        data = ConsensusSharedData("A", ["A", "B", "C", "D"], 0)
+        return OrderingService(
+            data, MockTimer(), InternalBus(),
+            ExternalBus(send_handler=lambda *a, **k: None),
+            SimExecutor(), config=conf)
+
+    assert make(1000, 128 * 1024)._max_batch_size == 1000  # default fits
+    clamped = make(5000, 128 * 1024)._max_batch_size
+    assert clamped < 5000
+    assert clamped * 72 <= 128 * 1024 - 8192
+    assert make(100, 16 * 1024)._max_batch_size == 100
