@@ -116,13 +116,50 @@ class MessageBase:
 
 
 def _plain(v):
+    # Exact-type fast paths first: wire payloads are overwhelmingly
+    # plain scalars/dicts (txn envelopes in Replies, request dicts in
+    # Propagates) and the recursion over them is pure copying — this
+    # runs per field per outgoing message. CONTRACT: a container that
+    # needs no conversion is returned BY REFERENCE, so as_dict()/
+    # to_dict() output must be treated as read-only below the top
+    # level (a nested mutation would write through into the frozen
+    # message). Tuples always convert — as_dict's list normalization
+    # is what keeps local-vs-wire message equality stable.
+    t = type(v)
+    if t is str or t is int or t is bool or t is float or v is None:
+        return v
+    if t is dict:
+        if not _needs_conversion(v):
+            return v
+        return {k: _plain(x) for k, x in v.items()}
     if isinstance(v, MessageBase):
         return v.as_dict()
     if isinstance(v, (list, tuple)):
+        if t is list and not _needs_conversion(v):
+            return v
         return [_plain(x) for x in v]
     if isinstance(v, dict):
         return {k: _plain(x) for k, x in v.items()}
     return v
+
+
+_PLAIN_TYPES = (str, int, bool, float, type(None), bytes)
+
+
+def _needs_conversion(v, _depth=0) -> bool:
+    """True if anything inside a plain container requires _plain to
+    rebuild it: a MessageBase, an exotic type, or a TUPLE (which must
+    normalize to a list so deserialized copies compare equal)."""
+    if _depth > 12:
+        return True  # absurd nesting: fall back to the copying path
+    t = type(v)
+    if t in _PLAIN_TYPES:
+        return False
+    if t is dict:
+        return any(_needs_conversion(x, _depth + 1) for x in v.values())
+    if t is list:
+        return any(_needs_conversion(x, _depth + 1) for x in v)
+    return True  # MessageBase, tuple, or exotic type: must convert
 
 
 def _hashable(v):
