@@ -68,6 +68,15 @@ def best_median_time(fn, runs=3):
     return min(times), statistics.median(times)
 
 
+def best_of_runs(runs, min_ordered, side):
+    """Best (elapsed, ordered) among runs that ordered at least
+    min_ordered requests — a failed/partial run must never become a
+    headline number silently."""
+    complete = [r for r in runs if r[1] >= min_ordered]
+    assert complete, (side, runs)
+    return min(complete, key=lambda r: r[0] / r[1])
+
+
 def make_requests(n, signer):
     """n unique NYM-creation writes by one authenticated author."""
     from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
@@ -709,12 +718,10 @@ def main():
         mp_runs_remote.append(run_multiprocess_pool(mp_reqs, "remote"))
         mp_runs_cpu.append(run_multiprocess_pool(mp_reqs, "cpu"))
 
-    def _best_mp(runs):
-        complete = [r for r in runs if r[1] >= len(mp_reqs) - 1]
-        return min(complete or runs, key=lambda r: r[0] / max(r[1], 1))
-
-    mp_remote_elapsed, mp_remote_ordered = _best_mp(mp_runs_remote)
-    mp_cpu_elapsed, mp_cpu_ordered = _best_mp(mp_runs_cpu)
+    mp_remote_elapsed, mp_remote_ordered = best_of_runs(
+        mp_runs_remote, len(mp_reqs) - 1, "mp-remote")
+    mp_cpu_elapsed, mp_cpu_ordered = best_of_runs(
+        mp_runs_cpu, len(mp_reqs) - 1, "mp-cpu")
     mp_rate = mp_remote_ordered / mp_remote_elapsed
     mp_cpu_rate = mp_cpu_ordered / mp_cpu_elapsed
 
@@ -733,17 +740,12 @@ def main():
     # INTERLEAVED best-of-2: back-to-back tpu-then-cpu blocks let
     # box-load drift bias the ratio whichever way the wind blows —
     # alternating runs exposes both pools to the same load profile
-    def best_of(runs, side):
-        complete = [r for r in runs if r[1] >= POOL_REQS]
-        assert complete, (side, runs)
-        return min(complete, key=lambda r: r[0] / r[1])
-
     tpu_runs, cpu_runs = [], []
     for _ in range(2):
         tpu_runs.append(run_pool(reqs, "tpu_hub"))
         cpu_runs.append(run_pool(reqs, "cpu"))
-    tpu_elapsed, tpu_ordered = best_of(tpu_runs, "tpu_hub")
-    cpu_elapsed, cpu_ordered = best_of(cpu_runs, "cpu")
+    tpu_elapsed, tpu_ordered = best_of_runs(tpu_runs, POOL_REQS, "tpu_hub")
+    cpu_elapsed, cpu_ordered = best_of_runs(cpu_runs, POOL_REQS, "cpu")
     tpu_rate = tpu_ordered / tpu_elapsed
     cpu_rate = cpu_ordered / cpu_elapsed
 

@@ -131,15 +131,28 @@ def _plain(v):
     if t is dict:
         if not _needs_conversion(v):
             return v
-        return {k: _plain(x) for k, x in v.items()}
+        return _convert(v)
     if isinstance(v, MessageBase):
         return v.as_dict()
     if isinstance(v, (list, tuple)):
         if t is list and not _needs_conversion(v):
             return v
-        return [_plain(x) for x in v]
+        return _convert(v)
     if isinstance(v, dict):
-        return {k: _plain(x) for k, x in v.items()}
+        return _convert(v)
+    return v
+
+
+def _convert(v):
+    """Unconditional deep rebuild (the pre-fast-path behavior): used
+    once a subtree is known to need conversion, so clean inner nodes
+    aren't re-scanned per nesting level."""
+    if isinstance(v, MessageBase):
+        return v.as_dict()
+    if isinstance(v, (list, tuple)):
+        return [_convert(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _convert(x) for k, x in v.items()}
     return v
 
 
