@@ -537,31 +537,49 @@ def micro_merkle(n_leaves=None):
     device_leaves_per_s = n_leaves / t_b
     device_leaves_per_s_median = n_leaves / t_m
 
-    # audit-path batch: one gather + one download for 10k proofs
+    # audit-path batch: device gathers for the big bottom levels, the
+    # host-cached top levels joined by vectorized numpy (the tunnel is
+    # ~20 MB/s — the top-level cache cuts per-batch bytes ~3x). The
+    # PIPELINED number is the serving shape: a node answering a stream
+    # of proof batches overlaps each download with the next gather.
     n_proofs = min(10000, n_leaves)
     idx = list(range(0, n_leaves, max(1, n_leaves // n_proofs)))[:n_proofs]
-    paths = dev.audit_path_batch(idx)  # compile gather
-    t_b, t_m = best_median_time(lambda: dev.audit_path_batch(idx))
-    proof_rate, proof_rate_median = len(idx) / t_b, len(idx) / t_m
+    paths = dev.audit_path_batch(idx[:4])  # compile gather + list API
     assert dev.verify_path(leaves[idx[0]], idx[0], paths[0], root)
+    dev.audit_path_batch_array(idx)        # warm the full batch shape
+    t_b, t_m = best_median_time(lambda: dev.audit_path_batch_array(idx))
+    proof_rate, proof_rate_median = len(idx) / t_b, len(idx) / t_m
 
-    # hashlib floor on a smaller tree, normalized per leaf
+    def pipelined_round():
+        h = dev.dispatch_path_batch(idx)
+        for _ in range(3):
+            nxt = dev.dispatch_path_batch(idx)
+            dev.collect_path_batch(h)
+            h = nxt
+        dev.collect_path_batch(h)
+    t_b, t_m = best_median_time(pipelined_round)
+    proof_rate_pipelined = 4 * len(idx) / t_b
+    proof_rate_pipelined_median = 4 * len(idx) / t_m
+
+    # hashlib floor: build throughput normalized on a smaller tree,
+    # but the PROOF floor walks the full n_leaves-deep tree — same
+    # depth, same proof size as the device path
     n_floor = min(100000, n_leaves)
     t0 = time.perf_counter()
     floor_tree = CompactMerkleTree(TreeHasher(), MemoryHashStore())
     for leaf in leaves[:n_floor]:
         floor_tree.append(leaf)
     floor_leaves_per_s = n_floor / (time.perf_counter() - t0)
+    for leaf in leaves[n_floor:]:
+        floor_tree.append(leaf)
 
-    # audit-path CPU floor on the same tree shape: inclusion_proof walks
-    # the hash store per index — the scalar side of the device gather
-    floor_idx = [i % n_floor for i in idx]
     t0 = time.perf_counter()
-    for i in floor_idx:
-        floor_tree.inclusion_proof(i, n_floor)
-    proof_floor_per_s = len(floor_idx) / (time.perf_counter() - t0)
+    for i in idx:
+        floor_tree.inclusion_proof(i, n_leaves)
+    proof_floor_per_s = len(idx) / (time.perf_counter() - t0)
     return (n_leaves, device_leaves_per_s, device_leaves_per_s_median,
-            proof_rate, proof_rate_median, floor_leaves_per_s,
+            proof_rate, proof_rate_median, proof_rate_pipelined,
+            proof_rate_pipelined_median, floor_leaves_per_s,
             proof_floor_per_s)
 
 
@@ -646,7 +664,10 @@ def pool25_backlog():
 def micro_bls():
     """BASELINE config 3: BLS multi-sig aggregate + verify for
     n = 4/25/100 validators (the per-commit state-proof path). Native C
-    backend (the framework's ursa equivalent) vs the pure-Python floor."""
+    backend (the framework's ursa equivalent) single-stream, the JAX
+    batched-aggregation kernel (ops/bls381_jax.py) for throughput, and
+    honest floors: pure Python and a documented optimized-library
+    estimate (blst/ursa-class, not installable in this image)."""
     from plenum_tpu.crypto.bls import (
         BlsCryptoSignerPlenum, BlsCryptoVerifierPlenum)
     from plenum_tpu.crypto import bls_ops
@@ -654,23 +675,38 @@ def micro_bls():
     verifier = BlsCryptoVerifierPlenum()
     msg = b"state-root-commitment"
     out = {}
+    sigs_by_n = {}
     for n in (4, 25, 100):
         signers = [BlsCryptoSignerPlenum.generate(bytes([i]) * 32)[0]
                    for i in range(n)]
         sigs = [s.sign(msg) for s in signers]
+        sigs_by_n[n] = sigs
         pks = [s.pk for s in signers]
         t0 = time.perf_counter()
-        reps_a = 5
+        reps_a = 10
         for _ in range(reps_a):
             multi = verifier.create_multi_sig(sigs)
         agg_s = (time.perf_counter() - t0) / reps_a
-        # first verify on a FRESH verifier pays one-time work a
-        # long-lived validator amortizes over every later batch: n G2
-        # subgroup checks, the aggregate key, and the prepared Miller
-        # lines — reported separately as the cold cost (a fresh
-        # instance per n, so earlier iterations can't pre-warm it; the
-        # process-wide -G2 preparation, ~0.2 ms, is excluded)
+        # the ORDERING-PATH aggregate: process_order only aggregates
+        # shares that validate_commit already pairing-checked, so the
+        # verifier's share-point cache is hot and aggregation is pure
+        # Jacobian point addition (no per-share sqrt)
+        for s, pk in zip(sigs, pks):
+            verifier.verify_sig(s, msg, pk)
+        reps_w = 100
+        t0 = time.perf_counter()
+        for _ in range(reps_w):
+            warm_multi = verifier.create_multi_sig(sigs)
+        agg_warm_s = (time.perf_counter() - t0) / reps_w
+        assert warm_multi == multi
+        # a FRESH verifier's key-dependent setup (n G2 subgroup checks,
+        # aggregate key, prepared Miller lines) is paid by warm_keys at
+        # catchup/membership-change time (node.py wires it); the cold
+        # first verify after that pays only hash-to-curve + 2 pairings
         cold_verifier = BlsCryptoVerifierPlenum()
+        t0 = time.perf_counter()
+        cold_verifier.warm_keys(pks)
+        warm_ms = (time.perf_counter() - t0) * 1e3
         t0 = time.perf_counter()
         ok = cold_verifier.verify_multi_sig(multi, msg, pks)
         cold_s = time.perf_counter() - t0
@@ -680,12 +716,58 @@ def micro_bls():
             ok = cold_verifier.verify_multi_sig(multi, msg, pks)
         ver_s = (time.perf_counter() - t0) / reps_v
         assert ok
-        out[str(n)] = {"aggregate_per_s": round(1 / agg_s, 1),
+        out[str(n)] = {"aggregate_per_s": round(1 / agg_warm_s, 1),
+                       "aggregate_cold_per_s": round(1 / agg_s, 1),
                        "verify_per_s": round(1 / ver_s, 1),
+                       "key_warm_ms": round(warm_ms, 1),
                        "cold_first_verify_ms": round(cold_s * 1e3, 1)}
     results["by_n"] = out
-    # pure-Python pairing floor for context (one verify) — calls the
-    # reference implementation directly, no backend switching
+    results["aggregate_desc"] = (
+        "aggregate_per_s = the ordering money path (process_order "
+        "aggregates shares validate_commit already pairing-checked: "
+        "cached points, pure Jacobian addition); aggregate_cold_per_s "
+        "= from compressed shares never seen (per-share sqrt)")
+    # ---- JAX batched G1 aggregation at n=100 (the TPU half of the
+    # SURVEY §2.9 ursa mapping): B independent 100-share aggregations
+    # per dispatch, pipelined depth 2 to overlap host packing with
+    # device compute. Cross-checked against the C path every run.
+    from plenum_tpu.crypto.bls import _unb58
+    from plenum_tpu.ops import bls381_jax as bjk
+    raw100 = [_unb58(s) for s in sigs_by_n[100]]
+    want = bls_ops.g1_aggregate_compressed(raw100)
+    B_JOBS = 256
+    jobs = [raw100] * B_JOBS
+    h = bjk.aggregate_dispatch(jobs, 100)          # compile + warm
+    pts, okv = bjk.aggregate_collect(h)
+    assert pts[0] == want and all(okv)
+    times = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        h1 = bjk.aggregate_dispatch(jobs, 100)
+        h2 = bjk.aggregate_dispatch(jobs, 100)
+        bjk.aggregate_collect(h1)
+        bjk.aggregate_collect(h2)
+        times.append((time.perf_counter() - t0) / 2)
+    ts = sorted(times)
+    best, med = ts[0], ts[len(ts) // 2]
+    # C batch floor, single stream (same work, one core)
+    t0 = time.perf_counter()
+    reps_c = 20
+    for _ in range(reps_c):
+        bls_ops.g1_aggregate_compressed(raw100)
+    c_rate = reps_c / (time.perf_counter() - t0)
+    results["aggregate_n100_batched"] = {
+        "jobs_per_dispatch": B_JOBS,
+        "device_jobs_per_s": round(B_JOBS / best, 1),
+        "device_jobs_per_s_median": round(B_JOBS / med, 1),
+        "cpu_batch_floor_per_s": round(c_rate, 1),
+        "vs_cpu_floor": round(B_JOBS / best / c_rate, 2),
+    }
+    # ---- floors. Pure-Python pairing measured; optimized-library
+    # (ursa/blst-class) verify is a DOCUMENTED estimate: those libraries
+    # pair in ~1.3-2 ms => ~500-770 verifies/s on one core. Neither
+    # ships in this image (no Rust toolchain), so the bound is cited,
+    # not measured — vs_optimized_floor_est uses the 700/s midpoint.
     from plenum_tpu.crypto import bls12_381 as B
     h = B.hash_to_g1(msg)
     sk = 12345
@@ -694,8 +776,14 @@ def micro_bls():
     t0 = time.perf_counter()
     assert B.multi_pairing(
         [(sig, B.g2_neg(B.G2_GEN)), (h, pk)]) == B.FQ12_ONE
-    results["python_verify_per_s"] = round(
-        1 / (time.perf_counter() - t0), 2)
+    results["floors"] = {
+        "python_verify_per_s": round(1 / (time.perf_counter() - t0), 2),
+        "optimized_library_verify_per_s_est": 700,
+        "note": "blst/ursa-class libraries verify in ~1.3-2 ms; "
+                "documented estimate (not installable here)",
+    }
+    results["vs_optimized_floor_est"] = round(
+        out["100"]["verify_per_s"] / 700, 2)
     return results
 
 
@@ -751,8 +839,8 @@ def main():
 
     (device_rate, device_rate_median, openssl_rate, python_rate,
      ed_sweep) = micro_ed25519()
-    (mk_n, mk_rate, mk_rate_med, mk_proofs, mk_proofs_med, mk_floor,
-     mk_proof_floor) = micro_merkle()
+    (mk_n, mk_rate, mk_rate_med, mk_proofs, mk_proofs_med, mk_proofs_pipe,
+     mk_proofs_pipe_med, mk_floor, mk_proof_floor) = micro_merkle()
     bls_results = micro_bls()
     p25 = pool25_backlog()
 
@@ -793,8 +881,14 @@ def main():
                 "build_leaves_per_s_median": round(mk_rate_med, 1),
                 "audit_paths_per_s": round(mk_proofs, 1),
                 "audit_paths_per_s_median": round(mk_proofs_med, 1),
+                "audit_paths_pipelined_per_s": round(mk_proofs_pipe, 1),
+                "audit_paths_pipelined_per_s_median": round(
+                    mk_proofs_pipe_med, 1),
                 "audit_paths_cpu_floor_per_s": round(mk_proof_floor, 1),
-                "vs_cpu_audit_paths": round(mk_proofs / mk_proof_floor, 2),
+                "vs_cpu_audit_paths": round(
+                    mk_proofs_pipe / mk_proof_floor, 2),
+                "vs_cpu_audit_paths_single_shot": round(
+                    mk_proofs / mk_proof_floor, 2),
                 "hashlib_floor_leaves_per_s": round(mk_floor, 1),
                 "vs_hashlib": round(mk_rate / mk_floor, 2),
             },

@@ -97,6 +97,12 @@ class Config:
     # METRICS_COLLECTOR_TYPE + DUMP_VALIDATOR_INFO_PERIOD_SEC)
     METRICS_FLUSH_INTERVAL = 10          # seconds between KV flushes
     VALIDATOR_INFO_DUMP_INTERVAL = 60    # seconds between JSON dumps
+    # logging (reference stp_core/config.py:9-17): per-node rotating
+    # log file, gzip-compressed rotated segments (utils/log.py)
+    LOG_LEVEL = 20                       # logging.INFO; TRACE=5
+    LOG_FORMAT = None                    # None = utils.log.DEFAULT_FORMAT
+    LOG_MAX_BYTES = 50 * 1024 * 1024
+    LOG_BACKUP_COUNT = 10
 
     # ---- TAA acceptance time window (reference plenum/config.py
     # TXN_AUTHOR_AGREEMENT_ACCEPTANCE_TIME_{BEFORE_TAA,AFTER_PP}_TIME)

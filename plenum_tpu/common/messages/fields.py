@@ -3,6 +3,18 @@
 Reference: plenum/common/messages/fields.py (748 LoC, ~50 validators) — these
 are the wire-compat spec of the protocol. A validator's `validate(value)`
 returns None when valid, else an error string.
+
+Parity delta vs the reference's class list (enumerated r5): the
+reference-only names are `FieldBase` (its ABC — `FieldValidator` here
+fills that role), `LedgerInfoField` (used ONLY by the legacy
+ViewChangeDone message of the pre-"plenum 2.0" view-change protocol,
+node_messages.py:434 — superseded by ViewChange/NewView, which this
+framework implements natively), and `TieAmongField` (no non-test usage
+in the reference at all — vestige of the removed election protocol).
+This module adds `AlphaNumericField`, `Base64Field`, and
+`PositiveNumberField`, which the reference folds into ad-hoc checks.
+Every validator used by a LIVE reference message type has an equivalent
+here.
 """
 import base64
 import ipaddress

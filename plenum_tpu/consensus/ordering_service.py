@@ -22,6 +22,7 @@ from collections import OrderedDict, defaultdict
 from typing import Dict, List, Optional, Set, Tuple
 
 from plenum_tpu.common.config import Config
+from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 from plenum_tpu.common.constants import AUDIT_LEDGER_ID, DOMAIN_LEDGER_ID
 from plenum_tpu.common.messages.internal_messages import (
     CheckpointStabilized, NeedViewChange, NewViewCheckpointsApplied,
@@ -154,6 +155,7 @@ class OrderingService:
         self._network = network
         self._executor = executor
         self._config = config or Config()
+        self.metrics = NullMetricsCollector()  # node injects the real one
         # a PRE-PREPARE carries ~72 wire bytes per request digest; a
         # batch big enough to push it past the transport frame limit
         # would be dropped by the stack and wedge ordering at the first
@@ -269,7 +271,8 @@ class OrderingService:
                 continue
             if not self._data.is_in_watermarks(self.lastPrePrepareSeqNo + 1):
                 break
-            self._send_one_batch(ledger_id, queue)
+            with self.metrics.measure_time(MetricsName.PP_CREATE_TIME):
+                self._send_one_batch(ledger_id, queue)
             sent += 1
         sent += self._send_freshness_batches()
         return sent
@@ -308,6 +311,8 @@ class OrderingService:
         self._send_batch_of(ledger_id, digests)
 
     def _send_batch_of(self, ledger_id: int, digests: List[str]):
+        self.metrics.add_event(MetricsName.THREE_PC_BATCH_SIZE,
+                               len(digests))
         pp_seq_no = self.lastPrePrepareSeqNo + 1
         pp_time = self._get_time()
         pp_digest = self.generate_pp_digest(digests, self.view_no, pp_time)
@@ -360,6 +365,10 @@ class OrderingService:
     # ====================================================== PRE-PREPARE
 
     def process_preprepare(self, pp: PrePrepare, frm: str):
+        with self.metrics.measure_time(MetricsName.PP_PROCESS_TIME):
+            return self._process_preprepare(pp, frm)
+
+    def _process_preprepare(self, pp: PrePrepare, frm: str):
         verdict = self._validate_3pc(pp, frm)
         if verdict is not None:
             return verdict
@@ -494,6 +503,10 @@ class OrderingService:
     # ========================================================== PREPARE
 
     def process_prepare(self, prepare: Prepare, frm: str):
+        with self.metrics.measure_time(MetricsName.PREPARE_PROCESS_TIME):
+            return self._process_prepare(prepare, frm)
+
+    def _process_prepare(self, prepare: Prepare, frm: str):
         verdict = self._validate_3pc(prepare, frm)
         if verdict is not None:
             return verdict
@@ -548,6 +561,10 @@ class OrderingService:
     # =========================================================== COMMIT
 
     def process_commit(self, commit: Commit, frm: str):
+        with self.metrics.measure_time(MetricsName.COMMIT_PROCESS_TIME):
+            return self._process_commit(commit, frm)
+
+    def _process_commit(self, commit: Commit, frm: str):
         verdict = self._validate_3pc(commit, frm)
         if verdict is not None:
             return verdict
@@ -599,6 +616,10 @@ class OrderingService:
                 self._queue_entry_time.pop(digest, None)
 
     def _order(self, pp: PrePrepare):
+        with self.metrics.measure_time(MetricsName.ORDER_TIME):
+            return self._order_inner(pp)
+
+    def _order_inner(self, pp: PrePrepare):
         key = (pp.viewNo, pp.ppSeqNo)
         self.ordered.add(key)
         self._data.last_ordered_3pc = key

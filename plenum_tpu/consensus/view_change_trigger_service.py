@@ -83,6 +83,15 @@ class InstanceChangeCache:
             self._timer.get_current_time()
         self._save()
 
+    def votes_summary(self) -> dict:
+        """view_no -> voter list (validator-info IC_queue block).
+        Expired votes are dropped first — the operator must see the
+        same state the quorum logic counts."""
+        for v in list(self._votes):
+            self._expire(v)
+        return {str(v): sorted(voters)
+                for v, voters in self._votes.items()}
+
     def votes(self, view_no: int) -> int:
         self._expire(view_no)
         return len(self._votes.get(view_no, {}))

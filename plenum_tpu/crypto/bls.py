@@ -108,6 +108,10 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
     def __init__(self):
         self._pk_cache = {}        # b58 pk -> (point, in_subgroup)
         self._agg_cache = {}       # tuple(pks) -> aggregate point | None
+        # b58 sig -> decompressed G1 point: every share was already
+        # decompressed once in validate_commit's verify_sig; ordering
+        # must not pay the ~50 us sqrt per share a second time
+        self._sig_point_cache = {}
         # G2 point (by id of cached object) -> prepared Miller lines:
         # a validator re-verifies against the same pool key-set every
         # batch, so the Q-only pairing work is paid once per set
@@ -165,6 +169,29 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
         self._pk_cache[pk] = (p, valid)
         return p, valid
 
+    def warm_keys(self, pks: Sequence[str]) -> None:
+        """Precompute every key-dependent cost for a pool key-set at
+        catchup/membership-change time instead of at first verify: G2
+        decompression + subgroup check per key, the aggregate key of
+        the full set, and its prepared Miller lines (plus the fixed
+        -G2 preparation). The per-key subgroup checks (~3.5 ms each —
+        the bulk of the lazy cold cost) are warmed for EVERY later
+        key-subset; the aggregate key + Miller lines are warmed for the
+        full set, so a verify against a fresh n-f participant subset
+        still lazily pays that subset's aggregation (microseconds
+        native) + one Miller precompute (~0.2 ms). The reference pays
+        the equivalent at key-deserialization time (ursa
+        VerKey::from_bytes, bls_crypto_indy_crypto.py:84)."""
+        cls = BlsCryptoVerifierPlenum
+        if cls._neg_g2_prep is None and bls.miller_precompute is not None:
+            cls._neg_g2_prep = bls.miller_precompute(bls.g2_neg(bls.G2_GEN))
+        for pk in pks:
+            self._pk_point(pk)
+        key = tuple(pks)
+        agg = self._aggregate_pks(key)
+        if agg is not None:
+            self._prepared(key, agg)
+
     def _aggregate_pks(self, pks: Sequence[str]):
         key = tuple(pks)
         if key in self._agg_cache:
@@ -183,7 +210,12 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
 
     def verify_sig(self, signature: str, message: bytes, pk: str) -> bool:
         try:
-            sig = self._g1(signature)
+            sig = self._sig_point_cache.get(signature)
+            if sig is None:
+                sig = self._g1(signature)
+                if len(self._sig_point_cache) > 8192:
+                    self._sig_point_cache.clear()
+                self._sig_point_cache[signature] = sig
         except (ValueError, KeyError):
             return False
         pub, valid = self._pk_point(pk)
@@ -213,9 +245,34 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
         return self._pairing_is_one(sig, h, key, agg_pk)
 
     def create_multi_sig(self, signatures: Sequence[str]) -> str:
-        agg = None
+        """One backend call for the whole share-set: Jacobian
+        accumulation with a single final inversion, instead of an affine
+        add — and its field inversion — per share. Shares this verifier
+        already pairing-checked (validate_commit path) aggregate from
+        their CACHED decompressed points, skipping the per-share sqrt
+        entirely — on the ordering money path aggregation is then pure
+        point addition."""
+        # NOTE: a cache VALUE of None is legitimate (the infinity
+        # encoding decompresses to None), so membership — not just
+        # get() — distinguishes a miss
+        cache = self._sig_point_cache
+        pts = []
+        misses = []
         for s in signatures:
-            agg = bls.g1_add(agg, self._g1(s))
+            p = cache.get(s)
+            if p is None and s not in cache:
+                misses.append(s)
+            pts.append(p)
+        if len(misses) == len(signatures):
+            # fully cold (no shares seen): one batched native call
+            agg = bls.g1_aggregate_compressed(
+                [_unb58(s) for s in signatures])
+            return _b58(bls.g1_compress(agg))
+        if misses:
+            for i, s in enumerate(signatures):
+                if pts[i] is None and s not in cache:
+                    pts[i] = self._g1(s)
+        agg = bls.g1_aggregate_points(pts)
         return _b58(bls.g1_compress(agg))
 
     def verify_key_proof_of_possession(self, key_proof: str, pk: str) -> bool:

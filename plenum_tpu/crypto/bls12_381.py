@@ -4,10 +4,12 @@ The reference delegates BLS multi-signatures to Hyperledger Ursa (Rust,
 `crypto/bls/indy_crypto/bls_crypto_indy_crypto.py`, SURVEY.md §2.9). This
 module is a from-scratch implementation of the curve arithmetic and the
 optimal ate pairing, used by plenum_tpu.crypto.bls for state-proof
-multi-signatures. It is the correctness/scalar path; batched G1
-aggregation of many signatures rides the JAX path (aggregation is pure
-point addition and vectorizes; pairings stay scalar on host — there are
-only 2 per verify regardless of signer count).
+multi-signatures. It is the correctness/scalar path. The hot paths live
+elsewhere: native/bls12_381.c (pairings, scalar mults, batch
+aggregation) and ops/bls381_jax.py (the TPU kernel batching decompress +
+G1 tree-aggregation over many share-sets per device dispatch); pairings
+stay on the host — there are only 2 per verify regardless of signer
+count.
 
 Scheme layout: signatures in G1 (48 B compressed), public keys in G2
 (96 B compressed) — minimal-signature-size variant.

@@ -49,6 +49,11 @@ def _get_lib():
         lib.b_multi_pairing_is_one_prepared.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]
         lib.b_multi_pairing_is_one_prepared.restype = ctypes.c_int
+        lib.b_g1_aggregate.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]
+        lib.b_g1_aggregate.restype = ctypes.c_int
+        lib.b_g1_aggregate_affine.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]
         _lib = lib
     return _lib
 
@@ -122,6 +127,30 @@ def g2_mul(p: G2Point, k: int) -> G2Point:
     _get_lib().b_g2_mul(_g2_bytes(p), (k % R).to_bytes(32, "big"),
                         out)
     return _g2_from(out.raw)
+
+
+def g1_aggregate_compressed(sigs: Sequence[bytes]) -> G1Point:
+    """Sum of n compressed signatures in ONE call: per-share decompress
+    + Jacobian mixed add, a single field inversion at the end (vs one
+    inversion per share through repeated g1_add). Raises ValueError on
+    any undecodable share, mirroring g1_decompress."""
+    n = len(sigs)
+    out = ctypes.create_string_buffer(96)
+    rc = _get_lib().b_g1_aggregate(n, b"".join(sigs), out)
+    if rc != 0:
+        raise ValueError("invalid G1 signature in aggregate")
+    return _g1_from(out.raw)
+
+
+def g1_aggregate_points(points: Sequence[G1Point]) -> G1Point:
+    """Sum of already-decompressed affine points in ONE call (Jacobian
+    accumulation + single inversion). The ordering path uses this with
+    the verifier's share-point cache: decompression was paid once at
+    COMMIT validation."""
+    out = ctypes.create_string_buffer(96)
+    _get_lib().b_g1_aggregate_affine(
+        len(points), b"".join(_g1_bytes(p) for p in points), out)
+    return _g1_from(out.raw)
 
 
 def multi_pairing_is_one(pairs: Sequence[Tuple[G1Point, G2Point]]) -> bool:

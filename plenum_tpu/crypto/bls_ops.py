@@ -62,6 +62,8 @@ if _native is not None:
     # every verify); None on the Python backend — callers fall back
     miller_precompute = _native.miller_precompute
     multi_pairing_is_one_prepared = _native.multi_pairing_is_one_prepared
+    g1_aggregate_compressed = _native.g1_aggregate_compressed
+    g1_aggregate_points = _native.g1_aggregate_points
 else:
     g1_add = _py.g1_add
     g1_mul = _py.g1_mul
@@ -73,6 +75,18 @@ else:
     def multi_pairing_is_one(
             pairs: Sequence[Tuple[G1Point, G2Point]]) -> bool:
         return _py.multi_pairing(pairs) == _py.FQ12_ONE
+
+    def g1_aggregate_compressed(sigs: Sequence[bytes]) -> G1Point:
+        agg = None
+        for s in sigs:
+            agg = _py.g1_add(agg, _py.g1_decompress(s))
+        return agg
+
+    def g1_aggregate_points(points) -> G1Point:
+        agg = None
+        for p in points:
+            agg = _py.g1_add(agg, p)
+        return agg
 
 
 def hash_to_g1(msg: bytes, dst: bytes = b"PLENUM_TPU_BLS_G1") -> G1Point:

@@ -179,6 +179,58 @@ class CompactMerkleTree:
             return self._path(m, start, start + k) + [self._mth(start + k, end)]
         return self._path(m, start + k, end) + [self._mth(start, start + k)]
 
+    def inclusion_proofs_batch(self, ms, n: int) -> List[List[bytes]]:
+        """Audit paths for MANY leaves of the same size-n prefix with a
+        shared subtree-hash memo. A committed batch's replies all prove
+        against the same tree, and contiguous leaves share nearly every
+        upper node — the memo collapses per-proof cost to the few
+        bottom siblings unique to each leaf (the per-reply
+        inclusion_proof was a top-3 cost on the ordering money path)."""
+        if not ms:
+            return []
+        if not (0 <= min(ms) and max(ms) < n <= self._size):
+            raise IndexError("invalid inclusion proof batch ({}, {}) "
+                             "for size {}".format(min(ms), n, self._size))
+        memo = {}
+        hash_children = self.hasher.hash_children
+        read_leaf = self.hash_store.read_leaf
+        read_subtree = self.hash_store.read_subtree
+
+        def mth(start, end):
+            key = (start, end)
+            h = memo.get(key)
+            if h is not None:
+                return h
+            width = end - start
+            if width == 1:
+                h = read_leaf(start)
+            else:
+                h = None
+                if width & (width - 1) == 0 and start % width == 0:
+                    h = read_subtree(start, width.bit_length() - 1)
+                if h is None:
+                    k = _largest_pow2_lt(width)
+                    h = hash_children(mth(start, start + k),
+                                      mth(start + k, end))
+            memo[key] = h
+            return h
+
+        out = []
+        for m in ms:
+            path = []
+            start, end = 0, n
+            while end - start > 1:
+                k = _largest_pow2_lt(end - start)
+                if m - start < k:
+                    path.append(mth(start + k, end))
+                    end = start + k
+                else:
+                    path.append(mth(start, start + k))
+                    start = start + k
+            path.reverse()
+            out.append(path)
+        return out
+
     def consistency_proof(self, first: int, second: int) -> List[bytes]:
         """PROOF(m, D[0:n]) (RFC 6962 §2.1.2) that size-`first` tree is a
         prefix of the size-`second` tree."""

@@ -247,6 +247,31 @@ class Ledger:
             'auditPath': [self.hashToStr(h) for h in path],
         }
 
+    def merkleInfoBatch(self, seq_nos) -> List[Dict]:
+        """merkleInfo for many txns of one committed batch in one call:
+        the audit paths share a subtree-hash memo AND a digest→b58 memo
+        (the per-hash b58 string is recomputed across overlapping paths
+        otherwise). Order matches `seq_nos`."""
+        size = self.seqNo
+        for s in seq_nos:
+            if not 0 < s <= size:
+                raise ValueError("invalid seqNo {}".format(s))
+        paths = self.tree.inclusion_proofs_batch(
+            [s - 1 for s in seq_nos], size)
+        root = self.hashToStr(self.tree.root_hash)
+        to_str = self.hashToStr
+        str_memo: Dict[bytes, str] = {}
+
+        def enc(h):
+            s = str_memo.get(h)
+            if s is None:
+                s = str_memo[h] = to_str(h)
+            return s
+
+        return [{'seqNo': s, 'rootHash': root,
+                 'auditPath': [enc(h) for h in path]}
+                for s, path in zip(seq_nos, paths)]
+
     auditProof = merkleInfo
 
     # -------------------------------------------------------------- util

@@ -1280,6 +1280,60 @@ int b_g1_decompress(const u8 *in48, u8 *out96) {
     return 0;
 }
 
+/* Aggregate n compressed G1 signatures with Jacobian accumulation and a
+ * single final inversion — the per-add fp_inv in g1_add_aff is what
+ * made scalar aggregation pay ~an inversion per share. Returns 0 ok,
+ * -1 if any share is invalid. out96 = affine x||y (zeros = infinity).
+ * Reference: create_multi_sig in
+ * crypto/bls/indy_crypto/bls_crypto_indy_crypto.py:99. */
+int b_g1_aggregate(int n, const u8 *sigs48, u8 *out96) {
+    g1j acc = {FP_ZERO, FP_ZERO, FP_ZERO};
+    u8 tmp[96];
+    for (int i = 0; i < n; i++) {
+        int rc = b_g1_decompress(sigs48 + (size_t)i * 48, tmp);
+        if (rc < 0) return -1;
+        if (rc == 1) continue;          /* infinity share */
+        fp x, y;
+        fp_from_bytes(&x, tmp);
+        fp_from_bytes(&y, tmp + 48);
+        g1j_madd(&acc, &acc, &x, &y);
+    }
+    if (fp_is_zero(&acc.Z)) { memset(out96, 0, 96); return 0; }
+    fp zi, zi2, zi3, x, y;
+    fp_inv(&zi, &acc.Z);
+    fp_sqr(&zi2, &zi);
+    fp_mul(&zi3, &zi2, &zi);
+    fp_mul(&x, &acc.X, &zi2);
+    fp_mul(&y, &acc.Y, &zi3);
+    fp_to_bytes(out96, &x);
+    fp_to_bytes(out96 + 48, &y);
+    return 0;
+}
+
+/* Aggregate n AFFINE points (96-byte x||y each, zeros = infinity) with
+ * Jacobian accumulation and one final inversion. The consensus path
+ * decompresses each share once at COMMIT-validation time; ordering then
+ * aggregates the cached points here without paying the per-share sqrt
+ * again. out96 = affine x||y (zeros = infinity). */
+void b_g1_aggregate_affine(int n, const u8 *pts96, u8 *out96) {
+    g1j acc = {FP_ZERO, FP_ZERO, FP_ZERO};
+    for (int i = 0; i < n; i++) {
+        g1 p;
+        g1_from_bytes(&p, pts96 + (size_t)i * 96);
+        if (p.inf) continue;
+        g1j_madd(&acc, &acc, &p.x, &p.y);
+    }
+    if (fp_is_zero(&acc.Z)) { memset(out96, 0, 96); return; }
+    fp zi, zi2, zi3, x, y;
+    fp_inv(&zi, &acc.Z);
+    fp_sqr(&zi2, &zi);
+    fp_mul(&zi3, &zi2, &zi);
+    fp_mul(&x, &acc.X, &zi2);
+    fp_mul(&y, &acc.Y, &zi3);
+    fp_to_bytes(out96, &x);
+    fp_to_bytes(out96 + 48, &y);
+}
+
 /* ∏ e(P_i, Q_i) == 1 ? (one shared final exponentiation) */
 /* ------------------------------------------------------------------ */
 /* SHA-256 (FIPS 180-4) — needed by the hash-to-curve construction,    */

@@ -53,6 +53,10 @@ from plenum_tpu.network.keys import NodeKeys
 
 logger = logging.getLogger(__name__)
 
+from plenum_tpu.utils.metrics import MetricsName as _MN
+_ENC_TIME = _MN.WIRE_ENCODE_TIME
+_BYTES_SENT = _MN.TRANSPORT_BYTES_SENT
+
 serializer = MsgPackSerializer()
 
 PING_OP = "ping_"
@@ -167,6 +171,8 @@ class StackBase:
         self._tasks: Set[asyncio.Task] = set()
         self._stopped = False
         self.msg_len_limit = self.config.MSG_LEN_LIMIT
+        from plenum_tpu.utils.metrics import NullMetricsCollector
+        self.metrics = NullMetricsCollector()  # host node injects
 
     # ------------------------------------------------------------ server
 
@@ -236,6 +242,8 @@ class StackBase:
                                  self.name, frm)
             if size_quota is not None and size >= size_quota:
                 break
+        if count:
+            self.metrics.add_event(_MN.TRANSPORT_MSGS_RECV, count)
         return count
 
 
@@ -358,6 +366,11 @@ class NodeStack(StackBase):
         self._unpack_wire(payload, frm)
 
     def _unpack_wire(self, payload: bytes, frm: str):
+        self.metrics.add_event(_MN.TRANSPORT_BYTES_RECV, len(payload))
+        with self.metrics.measure_time(_MN.WIRE_DECODE_TIME):
+            return self._unpack_wire_inner(payload, frm)
+
+    def _unpack_wire_inner(self, payload: bytes, frm: str):
         try:
             msg = serializer.deserialize(payload)
         except Exception:
@@ -512,9 +525,13 @@ class NodeStack(StackBase):
             flushed += len(msgs)
             try:
                 if len(msgs) == 1:
+                    self._count_sent(len(msgs[0]))
                     remote.conn.send_frame(msgs[0])
                 else:
-                    for frame in self._make_batches(msgs):
+                    with self.metrics.measure_time(_ENC_TIME):
+                        frames = self._make_batches(msgs)
+                    for frame in frames:
+                        self._count_sent(len(frame))
                         remote.conn.send_frame(frame)
             except Exception:
                 logger.info("%s: send to %s failed; dropping link",
@@ -524,6 +541,9 @@ class NodeStack(StackBase):
                 flushed -= len(msgs)
         self._emit_connecteds()
         return flushed
+
+    def _count_sent(self, nbytes: int):
+        self.metrics.add_event(_BYTES_SENT, nbytes)
 
     def _make_batches(self, msgs: List[bytes]) -> List[bytes]:
         """Pack serialized messages into signed batches under the size

@@ -73,9 +73,12 @@ class LedgerBatchHandler(BatchRequestHandler):
         count = len(batch.valid_digests)
         _, committed = self.ledger.commitTxns(count)
         if self.state is not None:
-            self.state.commit(
-                rootHash=self.ledger.strToHash(batch.state_root)
-                if batch.state_root else None)
+            from plenum_tpu.utils.metrics import MetricsName
+            root = (self.ledger.strToHash(batch.state_root)
+                    if batch.state_root else None)
+            with self.database_manager.metrics.measure_time(
+                    MetricsName.STATE_COMMIT_TIME):
+                self.state.commit(rootHash=root)
         return committed
 
 

@@ -18,6 +18,8 @@ class Database:
 
 class DatabaseManager:
     def __init__(self):
+        from plenum_tpu.utils.metrics import NullMetricsCollector
+        self.metrics = NullMetricsCollector()  # node injects the real one
         self.databases: Dict[int, Database] = {}
         self.stores: Dict[str, object] = {}
         self._init_hooks = []

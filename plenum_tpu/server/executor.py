@@ -16,6 +16,7 @@ from plenum_tpu.common.request import Request
 from plenum_tpu.consensus.ordering_service import BatchExecutor
 from plenum_tpu.server.three_pc_batch import ThreePcBatch
 from plenum_tpu.server.write_request_manager import WriteRequestManager
+from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
 
@@ -39,6 +40,7 @@ class NodeBatchExecutor(BatchExecutor):
         selects primaries from three_pc_batch.original_view_no)."""
         self.write_manager = write_manager
         self._requests_source = requests_source
+        self.metrics = NullMetricsCollector()  # node injects the real one
         self._get_view_no = get_view_no or (lambda: 0)
         self._primaries_for_view = primaries_for_view or (lambda v: [])
         self._get_pp_seq_no = get_pp_seq_no
@@ -58,6 +60,13 @@ class NodeBatchExecutor(BatchExecutor):
     def apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
                     pp_time: int, pp_digest: str = "",
                     original_view_no: int = None) -> Tuple[str, str, str]:
+        with self.metrics.measure_time(MetricsName.BATCH_APPLY_TIME):
+            return self._apply_batch(pre_prepare_digests, ledger_id,
+                                     pp_time, pp_digest, original_view_no)
+
+    def _apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
+                     pp_time: int, pp_digest: str = "",
+                     original_view_no: int = None) -> Tuple[str, str, str]:
         ledger = self.db.get_ledger(ledger_id)
         state = self.db.get_state(ledger_id)
         valid = []
@@ -123,6 +132,10 @@ class NodeBatchExecutor(BatchExecutor):
     # ------------------------------------------------------------- commit
 
     def commit_batch(self, ordered: Ordered):
+        with self.metrics.measure_time(MetricsName.BATCH_COMMIT_TIME):
+            return self._commit_batch(ordered)
+
+    def _commit_batch(self, ordered: Ordered):
         if not self._staged:
             logger.warning("commit with no staged batch at %s",
                            (ordered.viewNo, ordered.ppSeqNo))

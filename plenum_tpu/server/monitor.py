@@ -102,6 +102,13 @@ class RequestTimeTracker:
         t0 = self._started.pop(digest, None)
         return None if t0 is None else ts - t0
 
+    def peek(self, digest: str, ts: float) -> Optional[float]:
+        """Latency if ordered at `ts`, WITHOUT consuming the entry —
+        backup instances observe latency but only the master's ordering
+        completes a request."""
+        t0 = self._started.get(digest)
+        return None if t0 is None else ts - t0
+
     def unordered(self, now: float) -> List[float]:
         return [now - t0 for t0 in self._started.values()]
 
@@ -170,8 +177,16 @@ class Monitor:
         self.client_latencies = ClientLatencyMeasurement(
             self.config.MIN_LATENCY_COUNT)
         self.latencies = deque(maxlen=50)
+        # per-backup-instance observed latencies for the reference's
+        # Ω check (monitor.py:425-490 isMasterAvgReqLatencyTooHigh):
+        # a master that keeps ordering — slowly — never trips the
+        # throughput ratio, but backups ordering the same requests much
+        # faster expose it here
+        self.backup_latencies: Dict[int, deque] = {}
         self.total_ordered = 0
         self._warm = False
+        from plenum_tpu.utils.metrics import NullMetricsCollector
+        self.metrics = NullMetricsCollector()  # node injects the real one
 
     def _throughput(self, inst_id: int) -> EMAThroughputMeasurement:
         if inst_id not in self.throughputs:
@@ -192,8 +207,13 @@ class Monitor:
         now = self._timer.get_current_time()
         self._throughput(inst_id).add_request(now)
         if inst_id != 0:
-            # backups only feed the throughput comparison; the latency
-            # tracker entry must survive until the MASTER orders it
+            # backups feed the throughput comparison and the Ω latency
+            # comparison; the tracker entry must survive (peek, not
+            # order) until the MASTER orders it
+            lat = self.request_tracker.peek(digest, now)
+            if lat is not None:
+                self.backup_latencies.setdefault(
+                    inst_id, deque(maxlen=50)).append(lat)
             return
         latency = self.request_tracker.order(digest, now)
         if latency is not None:
@@ -209,6 +229,7 @@ class Monitor:
         self.throughputs.clear()
         self.request_tracker.reset()
         self.latencies.clear()
+        self.backup_latencies.clear()
         self.client_latencies.reset()
 
     # --------------------------------------------------------- judgments
@@ -231,12 +252,45 @@ class Monitor:
         mine = self._throughput(inst_id).get_throughput(now) or 0.0
         return mine / max(others)
 
+    def master_latency_excess(self) -> Optional[float]:
+        """Master avg latency minus the best backup's avg latency —
+        the reference's Ω divergence (isMasterAvgReqLatencyTooHigh,
+        monitor.py:466-490). None until BOTH sides have at least
+        MIN_LATENCY_COUNT samples — a single fast backup observation
+        against a backlogged master's average must not read as
+        divergence (the reference gates both sides the same way)."""
+        min_n = self.config.MIN_LATENCY_COUNT
+        backup_avgs = [sum(d) / len(d)
+                       for d in self.backup_latencies.values()
+                       if len(d) >= min_n]
+        if not backup_avgs or len(self.latencies) < min_n:
+            return None
+        master_avg = sum(self.latencies) / len(self.latencies)
+        return master_avg - min(backup_avgs)
+
     def is_master_degraded(self) -> bool:
-        """RBFT check (reference isMasterDegraded :425): throughput ratio
-        below Δ, or (single-instance fallback) requests stuck unordered
-        beyond Λ."""
+        """RBFT check (reference isMasterDegraded :425): throughput
+        ratio below Δ, master-vs-backup avg latency diverging beyond Ω,
+        or (single-instance fallback) requests stuck unordered beyond
+        Λ."""
+        from plenum_tpu.utils.metrics import MetricsName
+        with self.metrics.measure_time(MetricsName.MONITOR_CHECK_TIME):
+            return self._is_master_degraded()
+
+    def _is_master_degraded(self) -> bool:
+        from plenum_tpu.utils.metrics import MetricsName
         ratio = self.instance_throughput_ratio(0)
+        mine = self.instance_throughput(0)
+        if mine is not None:
+            self.metrics.add_event(MetricsName.MASTER_THROUGHPUT, mine)
+        lat = self.avg_latency()
+        if lat is not None:
+            self.metrics.add_event(MetricsName.MASTER_AVG_LATENCY, lat)
         if ratio is not None and ratio < self.config.DELTA:
+            return True
+        excess = self.master_latency_excess()
+        if excess is not None and self._warm \
+                and excess > self.config.OMEGA:
             return True
         now = self._timer.get_current_time()
         stuck = [age for age in self.request_tracker.unordered(now)

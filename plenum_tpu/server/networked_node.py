@@ -199,16 +199,24 @@ class NetworkedNode(Prodable):
             logger.debug("%s: verify batch landed after %.2fs", self._name,
                         _time.monotonic() - (self._pending_since or 0))
             self.node.conclude_client_batch(pending)
-        c = self.nodestack.service(
-            self._on_node_wire_msg,
-            quota=self.config.NODE_TO_NODE_STACK_QUOTA,
-            size_quota=self.config.NODE_TO_NODE_STACK_SIZE)
-        c += self._collect_client_msgs()
+        metrics = self.node.metrics
+        if self.nodestack.metrics is not metrics:
+            self.nodestack.metrics = metrics
+            self.clientstack.metrics = metrics
+        with metrics.measure_time(MetricsName.NODE_RX_TIME):
+            c = self.nodestack.service(
+                self._on_node_wire_msg,
+                quota=self.config.NODE_TO_NODE_STACK_QUOTA,
+                size_quota=self.config.NODE_TO_NODE_STACK_SIZE)
+        with metrics.measure_time(MetricsName.CLIENT_RX_TIME):
+            c += self._collect_client_msgs()
         c += self.node.service()
-        c += self.timer.service()
-        self.nodestack.service_lifecycle()
-        flushed = self.nodestack.flush_outboxes()
+        with metrics.measure_time(MetricsName.TIMER_SERVICE_TIME):
+            c += self.timer.service()
+        with metrics.measure_time(MetricsName.LIFECYCLE_TIME):
+            self.nodestack.service_lifecycle()
+        with metrics.measure_time(MetricsName.TRANSPORT_FLUSH_TIME):
+            flushed = self.nodestack.flush_outboxes()
         if flushed:
-            self.node.metrics.add_event(
-                MetricsName.TRANSPORT_BATCH_SIZE, flushed)
+            metrics.add_event(MetricsName.TRANSPORT_BATCH_SIZE, flushed)
         return c

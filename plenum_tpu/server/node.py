@@ -270,6 +270,9 @@ class Node:
         self.bls_bft_replica = bls_bft_replica
         if bls_bft_replica is not None:
             self.db_manager.bls_store = bls_bft_replica.bls_store
+            # pay the key-dependent verifier setup now (subgroup checks,
+            # prepared pairings), not on the first state-proof verify
+            bls_bft_replica.warm_pool_keys(validators)
 
         self.replica = ReplicaService(
             name, validators, timer, network, executor=self.executor,
@@ -330,6 +333,15 @@ class Node:
             Monitor, PrimaryConnectionMonitorService)
         self.monitor = Monitor(name, timer, self.replica.internal_bus,
                                config=self.config)
+        # one collector, injected into every instrumented stage so the
+        # per-stage breakdown (scripts/metrics_stats) covers the whole
+        # money path with a single flush point
+        for _staged in (self.propagator, self.executor, self.monitor,
+                        self.replica.ordering, bls_bft_replica,
+                        self.write_manager):
+            if _staged is not None:
+                _staged.metrics = self.metrics
+        self.db_manager.metrics = self.metrics
         self.primary_connection_monitor = PrimaryConnectionMonitorService(
             self.replica.data, timer, self.replica.internal_bus, network,
             config=self.config)
@@ -460,6 +472,8 @@ class Node:
         self._primary_selector.validators[:] = new_validators
         self.replicas.adjust_replicas(new_validators)
         self.propagator.update_quorums(self.replica.data.quorums)
+        if self.bls_bft_replica is not None:
+            self.bls_bft_replica.warm_pool_keys(new_validators)
         if self._on_membership_change is not None:
             self._on_membership_change(new_validators)
         if self.name not in new_validators:
@@ -669,6 +683,10 @@ class Node:
 
     def process_client_request(self, msg: dict, client_id: str):
         """Entry for one client REQUEST (reference processRequest :2000)."""
+        with self.metrics.measure_time(MetricsName.REQUEST_INTAKE_TIME):
+            self._process_client_request(msg, client_id)
+
+    def _process_client_request(self, msg: dict, client_id: str):
         try:
             self._validator.validate(msg)
             request = Request.from_dict(msg)
@@ -697,6 +715,10 @@ class Node:
         signature. The caller overlaps other work (other nodes\' batches,
         consensus ticks) before conclude_client_batch harvests — this
         hides the device round-trip latency entirely (SURVEY.md §7)."""
+        with self.metrics.measure_time(MetricsName.DEVICE_DISPATCH_TIME):
+            return self._dispatch_client_batch(msgs)
+
+    def _dispatch_client_batch(self, msgs: List[Tuple[dict, str]]):
         from plenum_tpu.common.constants import CURRENT_PROTOCOL_VERSION
         intake = _fp.request_intake if _fp is not None else None
         parsed = []
@@ -901,6 +923,10 @@ class Node:
 
     def _on_batch_committed(self, ordered: Ordered, committed_txns):
         """Send Replies with audit paths; update dedup index; free reqs."""
+        with self.metrics.measure_time(MetricsName.REPLY_TIME):
+            self._on_batch_committed_inner(ordered, committed_txns)
+
+    def _on_batch_committed_inner(self, ordered: Ordered, committed_txns):
         self.metrics.add_event(MetricsName.ORDERED_BATCH_COMMITTED,
                                len(committed_txns or []))
         self.observable.batch_committed(ordered.ledgerId,
@@ -919,6 +945,7 @@ class Node:
         free_request = self.propagator.requests.free
         inst_id = ordered.instId
         lid_prefix = "%d:" % ordered.ledgerId
+        reply_work = []       # (client_id, txn, seq_no) pending proofs
         for txn in committed_txns or []:
             md = txn.get(TXN_PAYLOAD, {}).get(TXN_PAYLOAD_METADATA, {})
             seq_no = txn.get(TXN_METADATA, {}).get(TXN_METADATA_SEQ_NO)
@@ -933,14 +960,33 @@ class Node:
                 rejected_pop(digest, None)
             client_id = req_clients_pop(digest, None)
             if client_id is not None and self._clients_attached:
-                result = dict(txn)
-                try:
-                    result.update(ledger.merkleInfo(seq_no))
-                except Exception:
-                    pass
-                self._reply_to_client(client_id, Reply(result=result))
+                reply_work.append((client_id, txn, seq_no))
             if digest:
                 free_request(digest)
+        if reply_work:
+            # ONE memoized proof pass for the whole batch: the paths
+            # share all upper tree nodes (merkleInfoBatch), vs an
+            # independent O(log n) walk per reply
+            try:
+                infos = ledger.merkleInfoBatch(
+                    [seq_no for _, _, seq_no in reply_work])
+            except Exception:
+                # one malformed entry must not strip proofs from the
+                # whole batch: degrade per reply, like the old path
+                logger.warning("%s: batch audit-path construction "
+                               "failed; falling back per reply",
+                               self.name, exc_info=True)
+                infos = []
+                for _, _, seq_no in reply_work:
+                    try:
+                        infos.append(ledger.merkleInfo(seq_no))
+                    except Exception:
+                        infos.append(None)
+            for (client_id, txn, seq_no), info in zip(reply_work, infos):
+                result = dict(txn)
+                if info is not None:
+                    result.update(info)
+                self._reply_to_client(client_id, Reply(result=result))
         if ordered.ledgerId == POOL_LEDGER_ID:
             for txn in committed_txns or []:
                 self.pool_manager.process_committed_txn(txn)
@@ -980,9 +1026,8 @@ class Node:
             self.propagator.requests.free(digest)
 
     def _committed_reply(self, request: Request) -> Optional[Reply]:
-        try:
-            raw = self.seq_no_db.get(request.payload_digest.encode())
-        except KeyError:
+        raw = self.seq_no_db.get_or_none(request.payload_digest.encode())
+        if raw is None:
             return None
         lid, seq_no = bytes(raw).decode().split(":")
         ledger = self.db_manager.get_ledger(int(lid))

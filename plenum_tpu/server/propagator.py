@@ -16,6 +16,7 @@ from plenum_tpu.common.messages.node_messages import (
     Propagate, PropagateBatch)
 from plenum_tpu.common.request import Request
 from plenum_tpu.consensus.quorums import Quorums
+from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
 
@@ -169,6 +170,7 @@ class Propagator:
         self._network = network
         self._forward = forward_handler
         self.requests = Requests()
+        self.metrics = NullMetricsCollector()   # node injects the real one
         # queued outgoing propagates, flushed as PROPAGATE_BATCH once
         # per tick: at n validators every request is otherwise its own
         # message n-1 times per node — batching is what lets wide pools
@@ -201,6 +203,10 @@ class Propagator:
         queued count."""
         if not self._out:
             return 0
+        with self.metrics.measure_time(MetricsName.PROPAGATE_FLUSH_TIME):
+            return self._flush()
+
+    def _flush(self) -> int:
         out, self._out = self._out, []
 
         def send_chunk(chunk):
@@ -228,9 +234,14 @@ class Propagator:
     # ---------------------------------------------------------- receiving
 
     def process_propagate(self, msg: Propagate, frm: str):
-        self._process_one(msg.request, msg.senderClient, frm)
+        with self.metrics.measure_time(MetricsName.PROPAGATE_PROCESS_TIME):
+            self._process_one(msg.request, msg.senderClient, frm)
 
     def process_propagate_batch(self, msg: PropagateBatch, frm: str):
+        with self.metrics.measure_time(MetricsName.PROPAGATE_PROCESS_TIME):
+            self._process_propagate_batch(msg, frm)
+
+    def _process_propagate_batch(self, msg: PropagateBatch, frm: str):
         clients = msg.clients or [""] * len(msg.requests)
         if len(clients) != len(msg.requests):
             # malformed (byzantine?) batch: dropping it silently via zip

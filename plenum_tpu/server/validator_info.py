@@ -54,12 +54,136 @@ class ValidatorNodeInfoTool:
                 "Ledger_sizes": self._ledger_sizes(),
             },
             "Pool_info": self._pool_info(),
-            "Software": {"plenum_tpu": _version()},
+            "View_change_info": self._view_change_info(),
+            "Catchup_status": self._catchup_status(),
+            "Freshness_status": self._freshness_status(),
+            "Uncommitted_info": self._uncommitted_info(),
+            "Software": {"plenum_tpu": _version(),
+                         "python": _python_version(),
+                         "jax": _dep_version("jax")},
+            "Hardware_info": self._hardware_info(),
+            "Config_info": self._config_info(),
             "Memory_info": self._memory_info(),
             "Latencies": self._latencies(),
+            "Extractions": self._extractions(),
             "Metrics": (self._metrics.summary()
                         if self._metrics is not None
                         and hasattr(self._metrics, "summary") else {}),
+        }
+
+    def _view_change_info(self) -> dict:
+        """Reference validator_info_tool View_change_status: whether a
+        view change is in flight + the vote state feeding the next."""
+        data = self._node.replica.data
+        out = {
+            "View_No": data.view_no,
+            "VC_in_progress": bool(data.waiting_for_new_view),
+            "Last_complete_view_no": data.view_no
+            if not data.waiting_for_new_view else data.view_no - 1,
+        }
+        trigger = getattr(self._node.replica, "vc_trigger", None)
+        cache = getattr(trigger, "_cache", None)
+        if cache is not None and hasattr(cache, "votes_summary"):
+            out["IC_queue"] = cache.votes_summary()
+        return out
+
+    def _catchup_status(self) -> dict:
+        """Per-ledger sync state (reference Catchup_status block)."""
+        leecher = getattr(self._node, "leecher", None)
+        if leecher is None:
+            return {}
+        out = {"In_progress": bool(leecher.in_progress),
+               "Number_txns_in_catchup": getattr(
+                   self._node, "catchup_txns_total", None),
+               "Ledger_statuses": {}}
+        for lid, name in _LEDGER_NAMES.items():
+            ledger = self._node.db_manager.get_ledger(lid)
+            if ledger is not None:
+                out["Ledger_statuses"][name] = {
+                    "size": ledger.size,
+                    "root": str(ledger.root_hash)}
+        return out
+
+    def _freshness_status(self) -> dict:
+        """Last signed-state update per ledger + staleness (reference
+        FreshnessChecker view in validator info)."""
+        checker = getattr(self._node, "freshness_checker", None)
+        if checker is None:
+            return {}
+        now = self._get_time()
+        out = {}
+        last = getattr(checker, "_last_updated", {})
+        timeout = getattr(checker, "_timeout",
+                          getattr(checker, "freshness_timeout", None))
+        for lid, ts in last.items():
+            name = _LEDGER_NAMES.get(lid, str(lid))
+            out[name] = {
+                "Last_updated_time": ts,
+                "Age_s": round(now - ts, 1),
+                "Has_write_consensus": timeout is None
+                or (now - ts) <= timeout,
+            }
+        return out
+
+    def _uncommitted_info(self) -> dict:
+        """Staged-but-unordered work: uncommitted txns per ledger and
+        ordering queue depths — the numbers that say where a wedged
+        pool is stuck."""
+        out = {"Uncommitted_txns": {}, "Request_queues": {}}
+        for lid, name in _LEDGER_NAMES.items():
+            ledger = self._node.db_manager.get_ledger(lid)
+            if ledger is not None:
+                out["Uncommitted_txns"][name] = len(
+                    getattr(ledger, "uncommittedTxns", ()) or ())
+        ordering = getattr(self._node.replica, "ordering", None)
+        if ordering is not None:
+            for lid, queue in getattr(ordering, "requestQueues",
+                                      {}).items():
+                out["Request_queues"][
+                    _LEDGER_NAMES.get(lid, str(lid))] = len(queue)
+        reqs = getattr(self._node.propagator, "requests", None)
+        if reqs is not None:
+            out["In_flight_requests"] = len(reqs)
+        return out
+
+    def _hardware_info(self) -> dict:
+        out = {}
+        try:
+            st = os.statvfs(".")
+            out["HDD_free_Mb"] = st.f_bavail * st.f_frsize // (1 << 20)
+        except OSError:
+            pass
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemAvailable"):
+                        out["RAM_available_Mb"] = \
+                            int(line.split()[1]) // 1024
+                        break
+        except OSError:
+            pass
+        return out
+
+    def _config_info(self) -> dict:
+        """The consensus-relevant knobs (reference dumps the whole
+        config; the load-bearing subset keeps the file greppable)."""
+        cfg = self._node.config
+        keys = ("Max3PCBatchSize", "Max3PCBatchWait",
+                "Max3PCBatchesInFlight", "CHK_FREQ", "LOG_SIZE",
+                "DELTA", "LAMBDA", "OMEGA", "MSG_LEN_LIMIT")
+        return {k: getattr(cfg, k, None) for k in keys}
+
+    def _extractions(self) -> dict:
+        """Derived rates (reference Extractions block): lifetime write
+        throughput from the ordered-txn counter."""
+        uptime = max(1e-9, self._get_time() - self._started_at)
+        monitor = getattr(self._node, "monitor", None)
+        total = getattr(monitor, "total_ordered", 0) if monitor else 0
+        return {
+            "Total_ordered_requests": total,
+            "Avg_write_throughput_rps": round(total / uptime, 2),
+            "Master_throughput": (monitor.instance_throughput(0)
+                                  if monitor else None),
         }
 
     def _memory_info(self) -> dict:
@@ -161,3 +285,19 @@ def _version() -> str:
         return __version__
     except ImportError:
         return "dev"
+
+
+def _python_version() -> str:
+    import sys
+    return sys.version.split()[0]
+
+
+def _dep_version(name: str):
+    """Installed version WITHOUT importing the package — importing
+    jax inside the periodic info dump would stall an ordering tick
+    (and can initialize a device runtime as a side effect)."""
+    try:
+        from importlib.metadata import version
+        return version(name)
+    except Exception:
+        return None

@@ -27,6 +27,10 @@ logger = logging.getLogger(__name__)
 
 class WriteRequestManager:
     def __init__(self, database_manager: DatabaseManager):
+        from plenum_tpu.utils.metrics import (
+            MetricsName, NullMetricsCollector)
+        self._mn = MetricsName
+        self.metrics = NullMetricsCollector()  # node injects the real one
         self.database_manager = database_manager
         self.request_handlers: Dict[str, WriteRequestHandler] = {}
         self.batch_handlers: Dict[int, List[BatchRequestHandler]] = {}
@@ -138,12 +142,15 @@ class WriteRequestManager:
 
     def commit_batch(self, three_pc_batch: ThreePcBatch):
         committed = []
-        for handler in self.batch_handlers.get(three_pc_batch.ledger_id, []):
-            result = handler.commit_batch(three_pc_batch)
-            if result:
-                committed = result
-        for handler in self.batch_handlers.get(AUDIT_LEDGER_ID, []):
-            handler.commit_batch(three_pc_batch)
+        with self.metrics.measure_time(self._mn.LEDGER_COMMIT_TIME):
+            for handler in self.batch_handlers.get(
+                    three_pc_batch.ledger_id, []):
+                result = handler.commit_batch(three_pc_batch)
+                if result:
+                    committed = result
+        with self.metrics.measure_time(self._mn.AUDIT_BATCH_TIME):
+            for handler in self.batch_handlers.get(AUDIT_LEDGER_ID, []):
+                handler.commit_batch(three_pc_batch)
         for txn in committed:
             self.txn_version_controller.update_version(txn)
         if self._applied_batches:

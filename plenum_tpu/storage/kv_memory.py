@@ -24,6 +24,9 @@ class KeyValueStorageInMemory(KeyValueStorage):
     def get(self, key) -> bytes:
         return self._dict[key if type(key) is bytes else to_bytes(key)]
 
+    def get_or_none(self, key):
+        return self._dict.get(key if type(key) is bytes else to_bytes(key))
+
     def remove(self, key):
         self._dict.pop(key if type(key) is bytes else to_bytes(key), None)
 

@@ -125,6 +125,12 @@ class KeyValueStorageNative(KeyValueStorage):
                 return buf.raw[:n]
             cap = n
 
+    def get_or_none(self, key):
+        key = to_bytes(key)
+        if key not in self._keys:
+            return None
+        return self.get(key)
+
     def remove(self, key):
         key = to_bytes(key)
         if self._lib.kv_remove(self._handle(), key, len(key)) != 0:

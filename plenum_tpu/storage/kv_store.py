@@ -27,6 +27,15 @@ class KeyValueStorage(ABC):
     def get(self, key) -> bytes:
         """Raises KeyError if absent."""
 
+    def get_or_none(self, key):
+        """get() without the exception cost on misses — the dedup
+        index probes EVERY incoming request and nearly always misses;
+        impls override with a native miss path."""
+        try:
+            return self.get(key)
+        except KeyError:
+            return None
+
     @abstractmethod
     def remove(self, key) -> None:
         ...

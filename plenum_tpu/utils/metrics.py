@@ -18,26 +18,69 @@ from typing import Dict, Iterator, Optional, Tuple
 
 
 class MetricsName(IntEnum):
-    # prod loop
+    """~50 load-bearing ids wrapping every prod stage, the 3PC money
+    path, transport, storage commits, and device dispatch — the subset
+    of the reference's ~300-name MetricsName IntEnum
+    (plenum/common/metrics_collector.py:19-326) that locates
+    bottlenecks. scripts/metrics_stats renders the per-stage breakdown
+    offline."""
+    # ---- prod loop stages (reference node.py:1036-1076 wraps each)
     NODE_PROD_TIME = 1            # seconds per Node.service tick
-    # ordering pipeline
+    NODE_RX_TIME = 2              # nodestack recv+decode+route per tick
+    CLIENT_RX_TIME = 3            # clientstack recv + intake dispatch
+    TIMER_SERVICE_TIME = 4        # TimerService callbacks per tick
+    TRANSPORT_FLUSH_TIME = 5      # outbox coalesce+seal+send per tick
+    LIFECYCLE_TIME = 6            # reconnects/pings per tick
+    # ---- ordering pipeline (per 3PC batch, not per request)
     ORDERED_BATCH_COMMITTED = 11  # txns committed per batch
     BACKUP_ORDERED = 13           # batches ordered by backup instances
-    # client intake
+    THREE_PC_BATCH_SIZE = 14      # digests per PrePrepare sent
+    PP_CREATE_TIME = 15           # send_3pc_batch: pop+apply+build
+    PP_PROCESS_TIME = 16          # process_preprepare incl. batch apply
+    PREPARE_PROCESS_TIME = 17
+    COMMIT_PROCESS_TIME = 18
+    ORDER_TIME = 19               # _order: Ordered emit + BLS aggregate
+    # ---- client intake / request pipeline
     CLIENT_AUTH_BATCH_SIZE = 20   # signatures per device dispatch
     CLIENT_AUTH_TIME = 21         # device-harvest (conclude) seconds
-    # catchup
+    REQUEST_INTAKE_TIME = 22      # process_client_request per request
+    PROPAGATE_PROCESS_TIME = 24   # PROPAGATE receive path per message
+    PROPAGATE_FLUSH_TIME = 25     # propagator outbox flush per tick
+    BATCH_APPLY_TIME = 26         # executor.apply_batch (uncommitted)
+    BATCH_COMMIT_TIME = 27        # executor.commit_batch (ledger+state)
+    REPLY_TIME = 28               # reply construct + merkle audit path
+    # ---- catchup
     CATCHUP_TXNS_RECEIVED = 30
-    # transport
+    # ---- view change
+    VIEW_CHANGE_TIME = 40         # NeedViewChange -> NewView accepted
+    INSTANCE_CHANGE_SENT = 41
+    # ---- transport
     TRANSPORT_BATCH_SIZE = 50     # messages per outbox flush
-    # garbage collector (reference gc_trackers.py GcTimeTracker): the
-    # three *_TIME names MUST stay consecutive — the tracker indexes
-    # them as GC_GEN0_TIME + generation
+    TRANSPORT_BYTES_SENT = 51     # wire bytes per sealed frame batch
+    TRANSPORT_BYTES_RECV = 52
+    TRANSPORT_MSGS_RECV = 53
+    WIRE_ENCODE_TIME = 54         # serialize+seal per outbox flush
+    WIRE_DECODE_TIME = 55         # open+decode per service() call
+    # ---- garbage collector (reference gc_trackers.py GcTimeTracker):
+    # the three *_TIME names MUST stay consecutive — the tracker
+    # indexes them as GC_GEN0_TIME + generation
     GC_GEN0_TIME = 60             # seconds paused in a gen-0 collection
     GC_GEN1_TIME = 61
     GC_GEN2_TIME = 62
     GC_COLLECTED_OBJECTS = 63     # objects freed per collection
     GC_UNCOLLECTABLE_OBJECTS = 64
+    # ---- device dispatch + crypto
+    DEVICE_DISPATCH_TIME = 70     # host-side launch cost per dispatch
+    BLS_AGGREGATE_TIME = 72       # process_order share aggregation
+    BLS_VALIDATE_TIME = 73        # validate_commit pairing check
+    # ---- storage commits (inside BATCH_COMMIT_TIME)
+    LEDGER_COMMIT_TIME = 75       # merkle append + txn log write
+    STATE_COMMIT_TIME = 76        # MPT commit to new root
+    AUDIT_BATCH_TIME = 77         # audit txn build + append
+    # ---- monitor observations
+    MASTER_THROUGHPUT = 80
+    MASTER_AVG_LATENCY = 81
+    MONITOR_CHECK_TIME = 82
 
 
 class ValueAccumulator:

@@ -1,0 +1,126 @@
+"""JAX BLS12-381 G1 kernel tests (ops/bls381_jax.py).
+
+Field arithmetic and the complete-addition formula compile in seconds on
+CPU and are cross-checked against the pure-Python reference
+(crypto/bls12_381.py) unconditionally. The full decompress+aggregate
+kernel traces a 379-bit sqrt exponentiation — minutes of CPU compile —
+so it is opt-in via RUN_SLOW_OPS=1 (the driver's bench runs exercise it
+on real TPU every round). Reference parity target: ursa aggregation in
+crypto/bls/indy_crypto/bls_crypto_indy_crypto.py:99.
+"""
+import os
+import random
+
+import numpy as np
+import pytest
+
+from plenum_tpu.crypto import bls12_381 as B
+
+
+def _limbs(v):
+    from plenum_tpu.ops import bls381_jax as K
+    return K._int_to_limbs(v)
+
+
+def test_montgomery_field_ops_cross_check():
+    import jax.numpy as jnp
+    from plenum_tpu.ops import bls381_jax as K
+
+    rng = random.Random(11)
+    vals = [0, 1, B.Q - 1, B.Q // 2] + [rng.randrange(B.Q) for _ in range(12)]
+    others = [1, B.Q - 1, 2, B.Q // 3] + [rng.randrange(B.Q) for _ in range(12)]
+    a = jnp.asarray(np.stack([_limbs(v) for v in vals]))
+    b = jnp.asarray(np.stack([_limbs(v) for v in others]))
+    am, bm = K.to_mont(a), K.to_mont(b)
+
+    back = np.asarray(K.fcanon(K.from_mont(am)))
+    assert [K.limbs_to_int(r) for r in back] == vals
+
+    prod = np.asarray(K.fcanon(K.from_mont(K.mont_mul(am, bm))))
+    sq = np.asarray(K.fcanon(K.from_mont(K.fsq(am))))
+    s = np.asarray(K.fcanon(K.from_mont(K.fadd(am, bm))))
+    d = np.asarray(K.fcanon(K.from_mont(K.fsub(am, bm))))
+    n = np.asarray(K.fcanon(K.from_mont(K.fneg(am))))
+    for i, (x, y) in enumerate(zip(vals, others)):
+        assert K.limbs_to_int(prod[i]) == x * y % B.Q
+        assert K.limbs_to_int(sq[i]) == x * x % B.Q
+        assert K.limbs_to_int(s[i]) == (x + y) % B.Q
+        assert K.limbs_to_int(d[i]) == (x - y) % B.Q
+        assert K.limbs_to_int(n[i]) == (-x) % B.Q
+
+
+def test_complete_addition_vs_reference():
+    """RCB complete formulas against the scalar reference, including the
+    exceptional inputs that break incomplete formulas: identity either
+    side, doubling, P + (-P)."""
+    import jax.numpy as jnp
+    from plenum_tpu.ops import bls381_jax as K
+
+    rng = random.Random(5)
+    pts = [B.g1_mul(B.G1_GEN, rng.randrange(1, B.R)) for _ in range(4)]
+    neg0 = (pts[0][0], B.Q - pts[0][1])
+    cases = ([(p, q) for p in pts[:3] for q in pts[:3]]
+             + [(None, pts[0]), (pts[0], None), (None, None),
+                (pts[0], neg0), (pts[2], pts[2])])
+
+    def to_proj_m(p):
+        if p is None:
+            return (0, 1, 0)
+        return (p[0], p[1], 1)
+
+    P1 = np.stack([[_limbs(c) for c in to_proj_m(p)] for p, _ in cases])
+    P2 = np.stack([[_limbs(c) for c in to_proj_m(q)] for _, q in cases])
+    m1 = tuple(K.to_mont(jnp.asarray(P1[:, i])) for i in range(3))
+    m2 = tuple(K.to_mont(jnp.asarray(P2[:, i])) for i in range(3))
+    X, Y, Z = K.padd(m1, m2)
+    X = np.asarray(K.fcanon(K.from_mont(X)))
+    Y = np.asarray(K.fcanon(K.from_mont(Y)))
+    Z = np.asarray(K.fcanon(K.from_mont(Z)))
+    for i, (p, q) in enumerate(cases):
+        got = K._proj_to_affine(K.limbs_to_int(X[i]), K.limbs_to_int(Y[i]),
+                                K.limbs_to_int(Z[i]))
+        assert got == B.g1_add(p, q), (i, p, q)
+
+
+def test_pack_compressed_flags_and_range():
+    from plenum_tpu.ops import bls381_jax as K
+
+    good = B.g1_compress(B.G1_GEN)
+    inf = bytes([0xC0] + [0] * 47)
+    not_compressed = bytes([0x00] * 48)
+    bad_inf = bytes([0xC0] + [0] * 46 + [1])
+    over_q = bytes([0x9F] + [0xFF] * 47)      # x >= q
+    raw = np.stack([np.frombuffer(s, dtype=np.uint8)
+                    for s in (good, inf, not_compressed, bad_inf, over_q)])
+    limbs, sign_big, is_inf, valid = K.pack_compressed(raw)
+    assert list(valid) == [True, True, False, False, False]
+    assert list(is_inf) == [False, True, False, False, False]
+    assert K.limbs_to_int(limbs[0]) == B.G1_GEN[0]
+
+
+@pytest.mark.skipif(not os.environ.get("RUN_SLOW_OPS"),
+                    reason="set RUN_SLOW_OPS=1 to compile the sqrt chain")
+def test_aggregate_jobs_cross_check():
+    from plenum_tpu.ops import bls381_jax as K
+
+    rng = random.Random(3)
+    pts = [B.g1_mul(B.G1_GEN, rng.randrange(1, B.R)) for _ in range(9)]
+    sigs = [B.g1_compress(p) for p in pts]
+    inf = B.g1_compress(None)
+    jobs = [sigs[:4], sigs[:1], sigs, [inf] + sigs[:2], [inf] * 3]
+    want = []
+    for job in jobs:
+        agg = None
+        for s in job:
+            agg = B.g1_add(agg, B.g1_decompress(s))
+        want.append(agg)
+    got, ok = K.aggregate_g1_jobs(jobs)
+    assert list(ok) == [True] * len(jobs)
+    assert got == want
+
+    # invalid shares poison only their own job
+    bad = bytearray(sigs[0])
+    bad[0] &= 0x7F                            # compressed bit cleared
+    got2, ok2 = K.aggregate_g1_jobs([[bytes(bad)] + sigs[:2], sigs[:3]])
+    assert not ok2[0] and ok2[1]
+    assert got2[1] == want[0] if sigs[:3] == jobs[0] else got2[1] is not None

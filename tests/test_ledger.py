@@ -399,3 +399,27 @@ def test_device_merkle_single_leaf_paths():
     paths = dev.audit_path_batch([0])
     assert paths == [[]]
     assert dev.verify_path(b"only", 0, paths[0], root)
+
+
+def test_inclusion_proofs_batch_matches_single(tmp_path):
+    """The memoized batch audit-path API must be bit-identical to the
+    per-leaf inclusion_proof it replaces on the reply path."""
+    from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+    from plenum_tpu.ledger.hash_store import MemoryHashStore
+    from plenum_tpu.ledger.tree_hasher import TreeHasher
+    import pytest
+    tree = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    for i in range(137):                      # ragged (non-pow2) size
+        tree.append(b"leaf-%d" % i)
+    idx = [0, 1, 2, 64, 77, 135, 136]
+    batch = tree.inclusion_proofs_batch(idx, 137)
+    for m, path in zip(idx, batch):
+        assert path == tree.inclusion_proof(m, 137), m
+    # prefix-tree proofs (smaller n) and edge/error cases
+    batch = tree.inclusion_proofs_batch([0, 99], 100)
+    assert batch[1] == tree.inclusion_proof(99, 100)
+    assert tree.inclusion_proofs_batch([], 137) == []
+    with pytest.raises(IndexError):
+        tree.inclusion_proofs_batch([137], 137)
+    with pytest.raises(IndexError):
+        tree.inclusion_proofs_batch([0], 200)

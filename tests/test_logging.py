@@ -1,0 +1,78 @@
+"""Logging subsystem tests (utils/log.py).
+
+Reference parity: stp_core/common/log.py:29 (TRACE/DISPLAY levels,
+Singleton Logger) + CompressingFileHandler (gzip-rotated segments).
+"""
+import gzip
+import logging
+import os
+
+from plenum_tpu.utils.log import (
+    DISPLAY, TRACE, CompressingFileHandler, Logger, getlogger)
+
+
+def test_custom_levels_registered():
+    assert logging.getLevelName(TRACE) == "TRACE"
+    assert logging.getLevelName(DISPLAY) == "DISPLAY"
+    assert TRACE < logging.DEBUG < logging.INFO < DISPLAY < logging.WARNING
+
+
+def test_logger_trace_and_display_methods(tmp_path):
+    log = getlogger("plenum_tpu.test.levels")
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    log.addHandler(handler)
+    log.setLevel(TRACE)
+    try:
+        log.trace("wire frame %d", 1)
+        log.display("node started")
+        assert [r.levelno for r in records] == [TRACE, DISPLAY]
+        log.setLevel(logging.INFO)
+        log.trace("suppressed below INFO")
+        assert len(records) == 2
+        log.display("still visible above INFO")
+        assert len(records) == 3
+    finally:
+        log.removeHandler(handler)
+
+
+def test_compressing_rotation_gzips_segments(tmp_path):
+    path = str(tmp_path / "node.log")
+    handler = CompressingFileHandler(path, maxBytes=2000, backupCount=3)
+    log = logging.getLogger("plenum_tpu.test.rotation")
+    log.propagate = False
+    log.addHandler(handler)
+    log.setLevel(logging.INFO)
+    try:
+        for i in range(200):
+            log.info("a log line with some padding %04d %s", i, "x" * 40)
+    finally:
+        log.removeHandler(handler)
+        handler.close()
+    assert os.path.exists(path)
+    rotated = sorted(p for p in os.listdir(str(tmp_path))
+                     if p.endswith(".gz"))
+    assert rotated, "rotation must have produced gz segments"
+    assert len(rotated) <= 3
+    # rotated segments decompress to valid log lines
+    with gzip.open(str(tmp_path / rotated[0]), "rt") as f:
+        lines = f.read().splitlines()
+    assert lines and "a log line with some padding" in lines[0]
+
+
+def test_singleton_logger_file_wiring(tmp_path):
+    log = Logger()
+    path = str(tmp_path / "logs" / "Alpha.log")
+    log.enableFileLogging(path)
+    try:
+        assert log.log_file == path
+        logging.getLogger("plenum_tpu.test.file").warning("hello file")
+        for h in (log._file_handler,):
+            h.flush()
+        with open(path) as f:
+            assert "hello file" in f.read()
+    finally:
+        log.disableFileLogging()
+    assert log.log_file is None
+    assert Logger() is log

@@ -150,6 +150,15 @@ def test_validator_info_shape_and_dump(pool, tdir):
     pi = info["Pool_info"]
     assert pi["Total_nodes_count"] == 4 and pi["f_value"] == 1
     assert info["Metrics"]["ORDERED_BATCH_COMMITTED"]["sum"] >= 1
+    # round-5 depth sections (reference validator_info_tool.py:54)
+    assert info["View_change_info"]["VC_in_progress"] is False
+    assert info["Catchup_status"]["In_progress"] is False
+    assert info["Catchup_status"]["Ledger_statuses"]["domain"]["size"] >= 1
+    assert info["Uncommitted_info"]["Uncommitted_txns"]["domain"] == 0
+    assert "Max3PCBatchSize" in info["Config_info"]
+    assert info["Extractions"]["Total_ordered_requests"] >= 1
+    fresh = info["Freshness_status"]
+    assert not fresh or all("Age_s" in v for v in fresh.values())
     path = tool.dump_json_file(os.path.join(tdir, "info"))
     with open(path) as f:
         assert json.load(f)["alias"] == "Alpha"

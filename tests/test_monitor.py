@@ -79,3 +79,42 @@ def test_primary_disconnection_votes_view_change():
     timer.run_for(10)
     assert votes, "expected a view-change vote after tolerance elapsed"
     svc.stop()
+
+
+def test_master_latency_divergence_triggers_degradation():
+    """Reference monitor.py:466-490 (isMasterAvgReqLatencyTooHigh): a
+    master that keeps ordering — slowly — never trips the throughput
+    ratio, but backups ordering the same requests much faster expose an
+    avg-latency divergence beyond Ω and the master is judged degraded on
+    latency alone."""
+    timer = MockTimer(0)
+    conf = Config(ThroughputWindowSize=10, DELTA=0.1, OMEGA=20,
+                  LAMBDA=10_000, MIN_LATENCY_COUNT=10)
+    m = Monitor("N1", timer, InternalBus(), config=conf)
+    for i in range(30):
+        timer.set_time(2 * i)
+        m.request_received("d%d" % i)
+    # backups (instance 1) order everything promptly...
+    timer.set_time(100)
+    for i in range(30):
+        m.request_ordered("d%d" % i, inst_id=1)
+    # ...the master orders the same requests 30 s later (> omega=20)
+    timer.set_time(130)
+    for i in range(30):
+        m.request_ordered("d%d" % i, inst_id=0)
+    excess = m.master_latency_excess()
+    assert excess is not None and excess > conf.OMEGA
+    assert m.is_master_degraded()
+
+    # healthy pool: master and backup latencies comparable -> no trigger
+    m2 = Monitor("N1", timer, InternalBus(), config=conf)
+    for i in range(30):
+        timer.set_time(10_000 + 2 * i)
+        m2.request_received("h%d" % i)
+    timer.set_time(10_100)
+    for i in range(30):
+        m2.request_ordered("h%d" % i, inst_id=1)
+    timer.set_time(10_101)
+    for i in range(30):
+        m2.request_ordered("h%d" % i, inst_id=0)
+    assert not m2.is_master_degraded()
