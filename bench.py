@@ -283,6 +283,7 @@ def run_multiprocess_pool(reqs, provider, run_label=""):
                 "CLIENT_TO_NODE_STACK_SIZE = %d\n"
                 "VERIFIER_PROVIDER = %r\n"
                 "VERIFIER_DAEMON_PORT = %d\n"
+                "METRICS_FLUSH_INTERVAL = 2\n"
                 % (CLIENT_BATCH, 16 << 20, 16 << 20, provider,
                    daemon_port))
 
@@ -461,10 +462,36 @@ def micro_ed25519():
                                         msg_prefix=b"bench-req")
     ok = edj.verify_batch(msgs, sigs, vks)  # warmup/compile
     assert bool(np.all(ok))
-    t_best, t_med = best_median_time(
+    # PIPELINED sustained rate is the headline: the deployment shape is
+    # a stream of batches (intake pipeline keeps >=1 launch in flight),
+    # so each dispatch hides the predecessor's ~150 ms tunnel RTT. The
+    # single-shot number (one launch incl. full RTT) is kept for
+    # transparency — it is what a one-off batch pays.
+    rounds = 6
+
+    def make_pipe(pm, ps, pv, n_rounds, depth=2):
+        """Depth-bounded pipelined verify driver shared by the
+        headline and the sweep — one place owns the pend/drain shape."""
+        def run_pipe():
+            pend = []
+            for _ in range(n_rounds):
+                pend.append(edj.verify_batch_async(pm, ps, pv))
+                if len(pend) > depth:
+                    okd, _valid, _cnt = pend.pop(0)
+                    np.asarray(okd)
+            for okd, _valid, _cnt in pend:
+                np.asarray(okd)
+        return run_pipe
+
+    run_pipe = make_pipe(msgs, sigs, vks, rounds)
+    run_pipe()
+    t_best, t_med = best_median_time(run_pipe, runs=3)
+    device_rate = rounds * MICRO_BATCH / t_best
+    device_rate_median = rounds * MICRO_BATCH / t_med
+    t_ss_b, t_ss_m = best_median_time(
         lambda: edj.verify_batch(msgs, sigs, vks), runs=4)
-    device_rate = MICRO_BATCH / t_best
-    device_rate_median = MICRO_BATCH / t_med
+    single_shot_rate = MICRO_BATCH / t_ss_b
+    single_shot_rate_median = MICRO_BATCH / t_ss_m
 
     cpu = create_verifier("cpu")
     n_cpu = min(2000, MICRO_BATCH)
@@ -507,13 +534,27 @@ def micro_ed25519():
         flo = min(n, 2000)
         t0 = time.perf_counter()
         cpu.verify_batch(list(zip(sm[:flo], ss[:flo], sv[:flo])))
-        sweep[str(n)] = {
+        entry = {
             "device_best_per_s": round(n / t_b, 1),
             "device_median_per_s": round(n / t_m, 1),
             "openssl_per_s": round(flo / (time.perf_counter() - t0), 1),
         }
-    return (device_rate, device_rate_median, openssl_rate, python_rate,
-            sweep)
+        if 1 < n <= MICRO_BATCH:
+            # PIPELINED: the deployment shape for repeated batches —
+            # consensus orders batch after batch, so dispatch i+1 hides
+            # dispatch i's ~150 ms tunnel round trip. Single-shot is
+            # the latency floor; this is the sustained rate a pool
+            # actually gets from n-sized batches.
+            rounds = 6
+            run_sweep_pipe = make_pipe(sm, ss, sv, rounds)
+            run_sweep_pipe()
+            t_b2, t_m2 = best_median_time(run_sweep_pipe, runs=3)
+            entry["device_pipelined_per_s"] = round(rounds * n / t_b2, 1)
+            entry["device_pipelined_per_s_median"] = round(
+                rounds * n / t_m2, 1)
+        sweep[str(n)] = entry
+    return (device_rate, device_rate_median, single_shot_rate,
+            single_shot_rate_median, openssl_rate, python_rate, sweep)
 
 
 def micro_merkle(n_leaves=None):
@@ -583,12 +624,14 @@ def micro_merkle(n_leaves=None):
             proof_floor_per_s)
 
 
-def pool25_backlog():
+def pool25_backlog(provider=None):
     """BASELINE config 5: 25-node simulated pool, mixed read/write
-    against a 50k-request backlog, TPU-batched verification via the
-    shared coalescing hub. The sim drains the backlog for a bounded
-    wall budget (BENCH_P25_WALL seconds) and reports sustained
-    ordered-write + served-read throughput."""
+    against a 50k-request backlog. Default provider is the shared TPU
+    coalescing hub; provider="cpu" runs the IDENTICAL config on the
+    OpenSSL per-node verifier — the CPU-verify comparison VERDICT r4
+    asked for. The sim drains the backlog for a bounded wall budget
+    (BENCH_P25_WALL seconds) and reports sustained ordered-write +
+    served-read throughput."""
     from plenum_tpu.common.config import Config
     from plenum_tpu.common.constants import GET_TXN, NYM, TARGET_NYM, VERKEY
     from plenum_tpu.crypto.signer import SimpleSigner
@@ -604,7 +647,8 @@ def pool25_backlog():
 
     # no client_reply_handler: the headline config skips Reply-payload
     # construction too, keeping the two pools comparable
-    nodes, timer = make_sim_pool(names, "tpu_hub", seed=25, batch=batch)
+    provider = provider or "tpu_hub"
+    nodes, timer = make_sim_pool(names, provider, seed=25, batch=batch)
     reads_served = [0]
 
     signer = SimpleSigner(seed=b"\x26" * 32)
@@ -624,12 +668,13 @@ def pool25_backlog():
             req["signature"] = signer.sign(dict(req))
             writes.append(req)
 
-    # warm the FUSED verification bucket (all nodes' chunks coalesce in
-    # the hub) so XLA compile stays out of the timed window
-    from plenum_tpu.crypto.fixtures import make_signed_batch
-    from plenum_tpu.ops import ed25519_jax as edj
-    wm_, ws_, wv_ = make_signed_batch(n_nodes * batch, seed=2)
-    edj.verify_batch(wm_, ws_, wv_)
+    if provider == "tpu_hub":
+        # warm the FUSED verification bucket (all nodes' chunks
+        # coalesce in the hub) so XLA compile stays out of the window
+        from plenum_tpu.crypto.fixtures import make_signed_batch
+        from plenum_tpu.ops import ed25519_jax as edj
+        wm_, ws_, wv_ = make_signed_batch(n_nodes * batch, seed=2)
+        edj.verify_batch(wm_, ws_, wv_)
 
     t0 = time.perf_counter()
     deadline = t0 + wall_budget
@@ -659,6 +704,20 @@ def pool25_backlog():
         "mixed_req_per_s": round((ordered + reads_served[0]) / elapsed, 1),
         "drained": ordered >= len(writes),
     }
+
+
+def pool25_both():
+    """TPU hub vs CPU verify on the identical 25-node config; the CPU
+    side gets the same wall budget, so not-drained shows up as a lower
+    sustained rate rather than a disqualified run."""
+    tpu = pool25_backlog("tpu_hub")
+    cpu = pool25_backlog("cpu")
+    tpu["cpu_write_req_per_s"] = cpu["write_req_per_s"]
+    tpu["cpu_mixed_req_per_s"] = cpu["mixed_req_per_s"]
+    tpu["cpu_drained"] = cpu["drained"]
+    tpu["vs_cpu"] = round(
+        tpu["write_req_per_s"] / max(1e-9, cpu["write_req_per_s"]), 2)
+    return tpu
 
 
 def micro_bls():
@@ -837,12 +896,12 @@ def main():
     tpu_rate = tpu_ordered / tpu_elapsed
     cpu_rate = cpu_ordered / cpu_elapsed
 
-    (device_rate, device_rate_median, openssl_rate, python_rate,
-     ed_sweep) = micro_ed25519()
+    (device_rate, device_rate_median, ed_single_shot, ed_single_shot_med,
+     openssl_rate, python_rate, ed_sweep) = micro_ed25519()
     (mk_n, mk_rate, mk_rate_med, mk_proofs, mk_proofs_med, mk_proofs_pipe,
      mk_proofs_pipe_med, mk_floor, mk_proof_floor) = micro_merkle()
     bls_results = micro_bls()
-    p25 = pool25_backlog()
+    p25 = pool25_both()
 
     print(json.dumps({
         "metric": "ordered write-reqs/s, 4-node MULTI-PROCESS pool over "
@@ -868,6 +927,12 @@ def main():
             "ed25519_batch_verify_per_chip": round(device_rate, 1),
             "ed25519_batch_verify_per_chip_median": round(
                 device_rate_median, 1),
+            "ed25519_verify_desc": "per_chip = pipelined sustained "
+                "(the deployment shape: a stream of batches hides the "
+                "tunnel RTT); single_shot = one launch incl. full RTT",
+            "ed25519_single_shot_per_s": round(ed_single_shot, 1),
+            "ed25519_single_shot_per_s_median": round(
+                ed_single_shot_med, 1),
             "batch": MICRO_BATCH,
             "ed25519_sweep": ed_sweep,
             "floors": {

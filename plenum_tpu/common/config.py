@@ -124,6 +124,13 @@ class Config:
     # ---- BLS (networked nodes derive the signer from the transport
     # seed; False skips BLS share generation/aggregation entirely)
     BLS_SIGN = True
+    # Optimistic batch verification of commit shares: COMMIT arrival
+    # does only cheap share decoding; ordering verifies the AGGREGATE
+    # once (2 pairings per batch instead of one pairing per share) and
+    # falls back to per-share checks to assign blame if the aggregate
+    # fails. False restores the reference's verify-each-share-on-
+    # arrival behavior (a bad share then rejects that COMMIT message).
+    BLS_DEFER_SHARE_VERIFY = True
 
     # ---- TPU crypto dispatch (new — the north-star gated boundary)
     # provider: 'cpu' (scalar C path via `cryptography`) or 'tpu_batch'

@@ -35,6 +35,9 @@ class Request:
         self.protocolVersion = protocolVersion
         self.taaAcceptance = taaAcceptance
         self.endorser = endorser
+        # cached: read ~6x per request across intake/apply/commit, and
+        # the operation dict never mutates after construction
+        self.txn_type = (operation or {}).get('type')
         self._digest = None
         self._payload_digest = None
         self._payload_state = None  # cached signingPayloadState()
@@ -106,10 +109,6 @@ class Request:
     @property
     def key(self) -> str:
         return self.digest
-
-    @property
-    def txn_type(self) -> Optional[str]:
-        return self.operation.get('type')
 
     def all_identifiers(self):
         ids = []

@@ -61,8 +61,15 @@ class BlsBftReplica:
                  bls_verifier: BlsCryptoVerifier,
                  key_register: BlsKeyRegister,
                  bls_store: Optional[BlsStore] = None,
-                 get_pool_root=None):
+                 get_pool_root=None,
+                 defer_share_verify: bool = True):
         self._name = node_name
+        # optimistic batch verification (config BLS_DEFER_SHARE_VERIFY):
+        # per-share pairings move off the COMMIT hot path; ordering
+        # checks the aggregate once and only unrolls per share on
+        # failure (classic optimistic batch-verify; the per-share
+        # fallback preserves blame assignment)
+        self._defer_share_verify = defer_share_verify
         self.metrics = NullMetricsCollector()  # node injects the real one
         self._signer = bls_signer
         self._verifier = bls_verifier
@@ -143,6 +150,10 @@ class BlsBftReplica:
         if pk is None:
             return None  # unknown key: can't check, don't block consensus
         self._remember_value(pp)
+        if self._defer_share_verify:
+            # cryptographic check deferred to process_order's single
+            # aggregate pairing; nothing to reject here
+            return None
         value = self._pp_values[(commit.viewNo, commit.ppSeqNo)]
         if not self._verifier.verify_sig(sig, value.as_single_value(), pk):
             return "invalid BLS signature share from {}".format(sender)
@@ -173,7 +184,8 @@ class BlsBftReplica:
         if value is None:
             return
         signed = value.as_single_value()
-        sigs, participants = [], []
+        sigs, participants, pks = [], [], []
+        deferred_unchecked = []      # indices never pairing-checked
         for sender, commit in commits.items():
             sig = getattr(commit, "blsSig", None)
             if sig is None:
@@ -181,14 +193,55 @@ class BlsBftReplica:
             pk = self._keys.get_key_by_name(sender)
             if pk is None:
                 continue
-            if self._verified_shares.get(
-                    (pp.viewNo, pp.ppSeqNo, sender)) != sig \
-                    and not self._verifier.verify_sig(sig, signed, pk):
-                logger.warning("%s dropping invalid BLS share from %s at %s",
-                               self._name, sender, key)
-                continue
+            checked = self._verified_shares.get(
+                (pp.viewNo, pp.ppSeqNo, sender)) == sig
+            if not checked and not self._defer_share_verify:
+                if not self._verifier.verify_sig(sig, signed, pk):
+                    logger.warning(
+                        "%s dropping invalid BLS share from %s at %s",
+                        self._name, sender, key)
+                    continue
+                checked = True
+            if not checked:
+                deferred_unchecked.append(len(sigs))
             sigs.append(sig)
             participants.append(sender)
+            pks.append(pk)
+        if deferred_unchecked:
+            # OPTIMISTIC BATCH VERIFY: one aggregate pairing covers all
+            # shares (what the stored proof's verification checks is
+            # exactly this aggregate). Only on failure unroll per share
+            # to drop the bad ones and assign blame — the honest-path
+            # cost is 2 pairings per ordered batch, not 2 per share.
+            # Deferred shares are UNVERIFIED attacker-controlled strings:
+            # an undecodable one must route to the per-share unroll
+            # (verify_sig absorbs decode errors), never crash ordering.
+            try:
+                agg = self._verifier.create_multi_sig(sigs)
+            except Exception:
+                agg = None
+            if agg is not None and \
+                    self._verifier.verify_multi_sig(agg, signed, pks):
+                multi = MultiSignature(signature=agg,
+                                       participants=sorted(participants),
+                                       value=value)
+                if quorums is None \
+                        or quorums.bls_signatures.is_reached(len(sigs)):
+                    self.bls_store.put(multi)
+                self._gc(pp.ppSeqNo)
+                return
+            keep = []
+            for i, (sig, sender, pk) in enumerate(
+                    zip(sigs, participants, pks)):
+                if i not in deferred_unchecked \
+                        or self._verifier.verify_sig(sig, signed, pk):
+                    keep.append(i)
+                else:
+                    logger.warning(
+                        "%s dropping invalid BLS share from %s at %s",
+                        self._name, sender, key)
+            sigs = [sigs[i] for i in keep]
+            participants = [participants[i] for i in keep]
         if quorums is not None \
                 and not quorums.bls_signatures.is_reached(len(sigs)):
             return

@@ -87,7 +87,8 @@ class Ledger:
         seq_no = self.seqNo + 1
         append_txn_metadata(txn, seq_no=seq_no)
         serialized = self.serialize_for_tree(txn)
-        self.tree.append(serialized)
+        self.tree._append_hash(self.hasher.hash_leaf(serialized),
+                               want_path=False)
         self._store.put(_seq_key(seq_no), serialized)
         self.seqNo = seq_no
         return seq_no
@@ -123,11 +124,15 @@ class Ledger:
         if self.uncommittedTree is None:
             self.uncommittedTree = self.tree.copy_shadow()
         first = self.uncommitted_size + 1
+        shadow_append = self.uncommittedTree._append_hash
+        blob_append = self._uncommitted_blobs.append
+        serialize = self.serialize_for_tree
+        hash_leaf = self.hasher.hash_leaf
         for txn in txns:
-            serialized = self.serialize_for_tree(txn)
-            leaf_hash = self.hasher.hash_leaf(serialized)
-            self.uncommittedTree._append_hash(leaf_hash)
-            self._uncommitted_blobs.append((serialized, leaf_hash))
+            serialized = serialize(txn)
+            leaf_hash = hash_leaf(serialized)
+            shadow_append(leaf_hash, want_path=False)
+            blob_append((serialized, leaf_hash))
         self.uncommittedTxns.extend(txns)
         # root is NOT folded here: staging runs once per request, the
         # root is read once per batch — uncommitted_root_hash computes
@@ -149,7 +154,7 @@ class Ledger:
                 self.uncommittedTxns[:count],
                 self._uncommitted_blobs[:count]):
             seq_no = self.seqNo + 1
-            tree_append(leaf_hash)
+            tree_append(leaf_hash, want_path=False)
             store_put(_seq_key(seq_no), serialized)
             self.seqNo = seq_no
             committed.append(txn)

@@ -602,6 +602,14 @@ class ClientStack(StackBase):
         self._clients: Dict[str, Connection] = {}
         self._order: deque = deque()  # client ids, accept order
         self._counter = 0
+        # per-client outbox for tick-coalesced replies: a committed
+        # batch produces hundreds of Replies to the same client, and
+        # one AEAD frame per Reply made the reply path the measured
+        # wall of the multi-process pool (~150 us/reply). Queued sends
+        # coalesce into BATCH envelopes at flush (reference batched.py
+        # does this for the node stack; the client stack needs it just
+        # as much under load)
+        self._outboxes: Dict[str, List[bytes]] = {}
 
     def _close_connections(self):
         for conn in list(self._clients.values()):
@@ -648,6 +656,10 @@ class ClientStack(StackBase):
                 conn.close()
 
     def send_to_client(self, client_id: str, msg_dict: dict) -> bool:
+        """Immediate single-frame send (scripts/net_diag echo; tests).
+        Production replies go through queue_to_client — do not mix the
+        two for one client in the same tick or replies can reorder
+        relative to the queued batch."""
         conn = self._clients.get(client_id)
         if conn is None or not conn.alive:
             return False
@@ -658,6 +670,63 @@ class ClientStack(StackBase):
             conn.close()
             self._clients.pop(client_id, None)
             return False
+
+    def queue_to_client(self, client_id: str, msg_dict: dict) -> bool:
+        """Coalescing variant of send_to_client: the message rides the
+        next flush_client_outboxes() as part of a BATCH envelope."""
+        conn = self._clients.get(client_id)
+        if conn is None or not conn.alive:
+            return False
+        self._outboxes.setdefault(client_id, []).append(
+            serializer.serialize(msg_dict))
+        return True
+
+    def flush_client_outboxes(self) -> int:
+        """One frame (or a few, under the size limit) per client per
+        tick instead of one per message. Client batches are NOT signed —
+        the AEAD channel already authenticates the node end-to-end
+        (unlike node-stack batches, which peers re-verify by verkey)."""
+        if not self._outboxes:
+            return 0
+        flushed = 0
+        outboxes, self._outboxes = self._outboxes, {}
+        budget = self.msg_len_limit - 512
+        for client_id, msgs in outboxes.items():
+            conn = self._clients.get(client_id)
+            if conn is None or not conn.alive:
+                continue
+            try:
+                if len(msgs) == 1:
+                    conn.send_frame(msgs[0])
+                    flushed += 1
+                    continue
+                group: List[bytes] = []
+                group_size = 0
+                for m in msgs:
+                    # same oversize guard as the node stack
+                    # (_make_batches): a single message past the frame
+                    # limit is dropped loudly, not sent for the peer's
+                    # read_frame check to kill the connection over
+                    if len(m) > self.msg_len_limit:
+                        logger.error(
+                            "%s: client message of %d bytes exceeds the "
+                            "%d-byte frame limit - dropped", self.name,
+                            len(m), self.msg_len_limit)
+                        continue
+                    if group and group_size + len(m) + 8 > budget:
+                        conn.send_frame(serializer.serialize(
+                            {OP_FIELD_NAME: BATCH_OP, "messages": group}))
+                        group, group_size = [], 0
+                    group.append(m)
+                    group_size += len(m) + 8
+                if group:
+                    conn.send_frame(serializer.serialize(
+                        {OP_FIELD_NAME: BATCH_OP, "messages": group}))
+                flushed += len(msgs)
+            except Exception:
+                conn.close()
+                self._clients.pop(client_id, None)
+        return flushed
 
 
 class ClientConnection:
@@ -696,7 +765,15 @@ class ClientConnection:
                 self.conn.close()
                 break
             try:
-                self.rx.append(serializer.deserialize(payload))
+                msg = serializer.deserialize(payload)
+                if isinstance(msg, dict) and \
+                        msg.get(OP_FIELD_NAME) == BATCH_OP:
+                    # coalesced node->client frame: unpack in order
+                    for raw in msg.get("messages", []):
+                        self.rx.append(serializer.deserialize(
+                            raw if isinstance(raw, bytes) else bytes(raw)))
+                else:
+                    self.rx.append(msg)
             except Exception:
                 pass
 

@@ -104,7 +104,9 @@ class NetworkedNode(Prodable):
         self.nodestack.send(message.to_dict(), dst)
 
     def _reply_to_client(self, client_id: str, msg):
-        self.clientstack.send_to_client(client_id, msg.to_dict())
+        # queued: a committed batch's replies coalesce into per-client
+        # BATCH frames at the end-of-tick flush
+        self.clientstack.queue_to_client(client_id, msg.to_dict())
 
     def _on_conns_changed(self, connecteds):
         self.bus.update_connecteds(set(connecteds))
@@ -217,6 +219,7 @@ class NetworkedNode(Prodable):
             self.nodestack.service_lifecycle()
         with metrics.measure_time(MetricsName.TRANSPORT_FLUSH_TIME):
             flushed = self.nodestack.flush_outboxes()
+            self.clientstack.flush_client_outboxes()
         if flushed:
             metrics.add_event(MetricsName.TRANSPORT_BATCH_SIZE, flushed)
         return c

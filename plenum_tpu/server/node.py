@@ -266,7 +266,9 @@ class Node:
                                           or {}).get(BLS_KEY)),
                 bls_store=BlsStore(make_kv("bls_store")),
                 get_pool_root=lambda: pool_state.committedHeadHash_b58
-                if pool_state is not None else "")
+                if pool_state is not None else "",
+                defer_share_verify=getattr(
+                    self.config, "BLS_DEFER_SHARE_VERIFY", True))
         self.bls_bft_replica = bls_bft_replica
         if bls_bft_replica is not None:
             self.db_manager.bls_store = bls_bft_replica.bls_store
@@ -916,10 +918,10 @@ class Node:
 
     def _on_backup_ordered(self, ordered: Ordered):
         """Backup instances never execute; they only feed the monitor's
-        master-vs-backup throughput comparison (RBFT ratio path)."""
+        master-vs-backup throughput + latency comparisons (RBFT)."""
         self.metrics.add_event(MetricsName.BACKUP_ORDERED, 1)
-        for digest in ordered.valid_reqIdr:
-            self.monitor.request_ordered(digest, ordered.instId)
+        self.monitor.requests_ordered_bulk(
+            [(d, None) for d in ordered.valid_reqIdr], ordered.instId)
 
     def _on_batch_committed(self, ordered: Ordered, committed_txns):
         """Send Replies with audit paths; update dedup index; free reqs."""
@@ -941,11 +943,11 @@ class Node:
         seq_no_put = self.seq_no_db.put
         req_clients_pop = self._req_clients.pop
         rejected_pop = self._rejected_digests.pop
-        request_ordered = self.monitor.request_ordered
         free_request = self.propagator.requests.free
         inst_id = ordered.instId
         lid_prefix = "%d:" % ordered.ledgerId
         reply_work = []       # (client_id, txn, seq_no) pending proofs
+        ordered_pairs = []    # (digest, author) for ONE monitor call
         for txn in committed_txns or []:
             md = txn.get(TXN_PAYLOAD, {}).get(TXN_PAYLOAD_METADATA, {})
             seq_no = txn.get(TXN_METADATA, {}).get(TXN_METADATA_SEQ_NO)
@@ -955,14 +957,16 @@ class Node:
                            (lid_prefix + str(seq_no)).encode())
             digest = md.get(TXN_PAYLOAD_METADATA_DIGEST)
             if digest:
-                request_ordered(digest, inst_id,
-                                identifier=md.get(TXN_PAYLOAD_METADATA_FROM))
+                ordered_pairs.append(
+                    (digest, md.get(TXN_PAYLOAD_METADATA_FROM)))
                 rejected_pop(digest, None)
             client_id = req_clients_pop(digest, None)
             if client_id is not None and self._clients_attached:
                 reply_work.append((client_id, txn, seq_no))
             if digest:
                 free_request(digest)
+        if ordered_pairs:
+            self.monitor.requests_ordered_bulk(ordered_pairs, inst_id)
         if reply_work:
             # ONE memoized proof pass for the whole batch: the paths
             # share all upper tree nodes (merkleInfoBatch), vs an

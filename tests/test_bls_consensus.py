@@ -56,12 +56,14 @@ def test_pool_produces_verifiable_multisig(bls_keys, mock_timer):
 
 
 def test_bad_bls_share_detected(bls_keys, mock_timer):
-    """A commit with a wrong share fails validate_commit."""
+    """A commit with a wrong share fails validate_commit when arrival-
+    time verification is on (BLS_DEFER_SHARE_VERIFY=False — the
+    reference behavior)."""
     from plenum_tpu.common.messages.node_messages import Commit, PrePrepare
     verifier = BlsCryptoVerifierPlenum()
     key_register = BlsKeyRegister(lambda n: bls_keys[n].pk)
     replica = BlsBftReplica("Node1", bls_keys["Node1"], verifier,
-                            key_register)
+                            key_register, defer_share_verify=False)
     pp = PrePrepare(
         instId=0, viewNo=0, ppSeqNo=1, ppTime=SIM_EPOCH,
         reqIdr=["d"], discarded="0", digest="x", ledgerId=1,
@@ -251,3 +253,80 @@ def test_single_node_read_with_multisig_proof(bls_keys, mock_timer):
     plain.submit_request(read4)
     plain.receive(first, Reply(result=r4))
     assert not plain.is_confirmed(read4)
+
+
+def test_deferred_share_verify_drops_bad_share_at_order(bls_keys,
+                                                        mock_timer):
+    """Optimistic batch verification (BLS_DEFER_SHARE_VERIFY=True, the
+    default): a bad share passes COMMIT arrival but is excluded at
+    ordering — the aggregate check fails, the per-share fallback
+    assigns blame, and the stored multi-sig contains only valid
+    shares."""
+    from plenum_tpu.common.messages.node_messages import Commit, PrePrepare
+    verifier = BlsCryptoVerifierPlenum()
+    key_register = BlsKeyRegister(lambda n: bls_keys[n].pk)
+    names = ["Node1", "Node2", "Node3", "Node4"]
+    replica = BlsBftReplica("Node1", bls_keys["Node1"], verifier,
+                            key_register, defer_share_verify=True)
+    pp = PrePrepare(
+        instId=0, viewNo=0, ppSeqNo=1, ppTime=SIM_EPOCH,
+        reqIdr=["d"], discarded="0", digest="x", ledgerId=1,
+        stateRootHash=None, txnRootHash=None, sub_seq_no=0, final=False,
+        poolStateRootHash=None)
+    replica.process_pre_prepare(pp, "Node1")
+    commits = {}
+    for name in names[:3]:
+        params = BlsBftReplica(name, bls_keys[name], verifier,
+                               key_register).update_commit(
+            dict(instId=0, viewNo=0, ppSeqNo=1), pp)
+        c = Commit(**params)
+        # deferred mode accepts at arrival even a share that will turn
+        # out bad (Node3's share attributed to Node4's key below)
+        assert replica.validate_commit(c, name, pp) is None
+        commits[name] = c
+    # Node4 replays Node3's share under its own identity: invalid
+    commits["Node4"] = commits["Node3"]
+    replica.process_order((0, 1), commits, pp, quorums=None)
+    root = pp.stateRootHash or ""
+    multi = replica.bls_store.get("")
+    assert multi is not None
+    assert multi.participants == ["Node1", "Node2", "Node3"]
+    # and the stored aggregate verifies against its participants
+    value = multi.value
+    pks = [bls_keys[n].pk for n in multi.participants]
+    assert verifier.verify_multi_sig(multi.signature,
+                                     value.as_single_value(), pks)
+
+
+def test_deferred_garbage_share_cannot_wedge_ordering(bls_keys,
+                                                      mock_timer):
+    """Regression: an UNDECODABLE share (not just a wrong one) accepted
+    under deferred verification must not raise out of process_order —
+    that call sits inside the ordering path after state mutation, so an
+    exception there would wedge the replica."""
+    from plenum_tpu.common.messages.node_messages import Commit, PrePrepare
+    verifier = BlsCryptoVerifierPlenum()
+    key_register = BlsKeyRegister(lambda n: bls_keys[n].pk)
+    names = ["Node1", "Node2", "Node3"]
+    replica = BlsBftReplica("Node1", bls_keys["Node1"], verifier,
+                            key_register, defer_share_verify=True)
+    pp = PrePrepare(
+        instId=0, viewNo=0, ppSeqNo=1, ppTime=SIM_EPOCH,
+        reqIdr=["d"], discarded="0", digest="x", ledgerId=1,
+        stateRootHash=None, txnRootHash=None, sub_seq_no=0, final=False,
+        poolStateRootHash=None)
+    replica.process_pre_prepare(pp, "Node1")
+    commits = {}
+    for name in names[:2]:
+        params = BlsBftReplica(name, bls_keys[name], verifier,
+                               key_register).update_commit(
+            dict(instId=0, viewNo=0, ppSeqNo=1), pp)
+        commits[name] = Commit(**params)
+    garbage = Commit(instId=0, viewNo=0, ppSeqNo=1,
+                     blsSig="0!!!not-base58-at-all")
+    assert replica.validate_commit(garbage, "Node3", pp) is None  # deferred
+    commits["Node3"] = garbage
+    replica.process_order((0, 1), commits, pp, quorums=None)  # no raise
+    multi = replica.bls_store.get("")
+    assert multi is not None
+    assert multi.participants == ["Node1", "Node2"]
