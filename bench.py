@@ -262,13 +262,24 @@ def run_multiprocess_pool(reqs, provider, run_label=""):
             from plenum_tpu.crypto.fixtures import make_signed_batch
             from plenum_tpu.crypto.remote_verifier import RemoteVerifier
             rv = RemoteVerifier(("127.0.0.1", daemon_port), timeout=600)
-            wm, ws, wv = make_signed_batch(4096, seed=3)
-            items = list(zip(wm, ws, wv))
-            # several warm launches: a fresh process's first device
-            # calls through the tunnel pay staged executable/load costs
-            # beyond the first compile — one launch does not absorb them
-            for _ in range(3):
-                assert all(rv.verify_batch(items))
+            # warm the EXACT power-of-two buckets the run dispatches:
+            # the pool's chunks are CLIENT_BATCH-sized (deduped across
+            # nodes), padding to the next pow2 — warming a different
+            # bucket leaves the first timed run paying that bucket's
+            # compile/executable-load inside the measurement (the cold
+            # 5x first-run syndrome). Several launches per bucket: a
+            # fresh process's early device calls pay staged load costs
+            # beyond the first compile.
+            sizes = {1 << (min(CLIENT_BATCH, POOL_REQS) - 1).bit_length()}
+            if POOL_REQS % CLIENT_BATCH:
+                sizes.add(1 << ((POOL_REQS % CLIENT_BATCH) - 1)
+                          .bit_length())
+            sizes.add(4096)
+            for size in sorted(sizes):
+                wm, ws, wv = make_signed_batch(size, seed=3)
+                items = list(zip(wm, ws, wv))
+                for _ in range(3):
+                    assert all(rv.verify_batch(items))
             rv.close()
 
         with open(os.path.join(base_dir, "plenum_tpu_config.py"), "w") as f:

@@ -70,6 +70,13 @@ class BlsBftReplica:
         # failure (classic optimistic batch-verify; the per-share
         # fallback preserves blame assignment)
         self._defer_share_verify = defer_share_verify
+        self._defer_configured = defer_share_verify
+        # adaptive defense: if an invalid deferred share ever costs a
+        # batch its multi-sig (it ate a quorum slot that arrival-time
+        # verification would have rejected), switch to strict
+        # arrival-time checks for a while so a byzantine peer cannot
+        # SUSTAIN proof suppression, then retry the fast path
+        self._strict_until_seq = -1
         self.metrics = NullMetricsCollector()  # node injects the real one
         self._signer = bls_signer
         self._verifier = bls_verifier
@@ -150,7 +157,8 @@ class BlsBftReplica:
         if pk is None:
             return None  # unknown key: can't check, don't block consensus
         self._remember_value(pp)
-        if self._defer_share_verify:
+        if self._defer_share_verify \
+                and commit.ppSeqNo > self._strict_until_seq:
             # cryptographic check deferred to process_order's single
             # aggregate pairing; nothing to reject here
             return None
@@ -242,6 +250,17 @@ class BlsBftReplica:
                         self._name, sender, key)
             sigs = [sigs[i] for i in keep]
             participants = [participants[i] for i in keep]
+            if quorums is not None \
+                    and not quorums.bls_signatures.is_reached(len(sigs)):
+                # an invalid deferred share ate a quorum slot and cost
+                # this batch its state proof — arrival-time checks
+                # would have rejected that COMMIT. Go strict for a
+                # window so the attacker cannot sustain suppression.
+                self._strict_until_seq = pp.ppSeqNo + 100
+                logger.warning(
+                    "%s: deferred BLS share verification abused at %s —"
+                    " strict arrival checks until seq %d", self._name,
+                    key, self._strict_until_seq)
         if quorums is not None \
                 and not quorums.bls_signatures.is_reached(len(sigs)):
             return

@@ -64,6 +64,42 @@ PONG_OP = "pong_"
 BATCH_OP = "BATCH"
 
 
+def pack_message_groups(msgs, budget, msg_len_limit, who=""):
+    """Shared size-budgeted packing for outbox flushes (node batches
+    and client-reply coalescing use the SAME rules): yields
+    ('raw', msg) for messages that must travel alone and
+    ('group', [msgs]) for batchable runs under `budget`. A single
+    message past `msg_len_limit` is dropped loudly — sending it would
+    make the peer's read_frame limit check kill the connection.
+    Each grouped message also costs a msgpack bin header (<=5 bytes);
+    at thousands of small messages per batch that per-item overhead
+    alone can push the sealed frame past the limit, so it is part of
+    the size accounting."""
+    PER_MSG = 8
+    group, group_size = [], 0
+    for m in msgs:
+        if len(m) > msg_len_limit:
+            logger.error(
+                "%s: message of %d bytes exceeds the %d-byte frame "
+                "limit - dropped (%r...)", who, len(m), msg_len_limit,
+                m[:128])
+            continue
+        if len(m) + PER_MSG > budget:
+            # too big to share an envelope, fine as its own raw frame
+            if group:
+                yield ('group', group)
+                group, group_size = [], 0
+            yield ('raw', m)
+            continue
+        if group and group_size + len(m) + PER_MSG > budget:
+            yield ('group', group)
+            group, group_size = [], 0
+        group.append(m)
+        group_size += len(m) + PER_MSG
+    if group:
+        yield ('group', group)
+
+
 class HA(NamedTuple):
     host: str
     port: int
@@ -547,41 +583,14 @@ class NodeStack(StackBase):
 
     def _make_batches(self, msgs: List[bytes]) -> List[bytes]:
         """Pack serialized messages into signed batches under the size
-        limit (reference prepare_batch.py split_messages_on_batches).
-        A SINGLE message over the limit is dropped with an error — the
-        reference does the same; sending it anyway would make the
-        receiver kill the connection on the oversize frame."""
+        limit (reference prepare_batch.py split_messages_on_batches) —
+        the packing rules live in pack_message_groups, shared with the
+        client-reply coalescer."""
         frames = []
-        group: List[bytes] = []
-        group_size = 0
-        budget = self.msg_len_limit - 512  # fixed envelope overhead
-        # each message inside the envelope also costs a msgpack bin
-        # header (≤5 bytes) — at thousands of small messages per batch
-        # that per-item overhead alone can push the sealed frame past
-        # the limit, so it must be part of the size accounting
-        PER_MSG = 8
-        for m in msgs:
-            if len(m) > self.msg_len_limit:
-                logger.error(
-                    "%s: message of %d bytes exceeds the %d-byte frame "
-                    "limit — dropped (%r...)", self.name, len(m),
-                    self.msg_len_limit, m[:128])
-                continue
-            if len(m) + PER_MSG > budget:
-                # too big to share a batch envelope, but fine as its own
-                # raw frame (singletons are sent unenveloped)
-                if group:
-                    frames.append(self._seal_batch(group))
-                    group, group_size = [], 0
-                frames.append(m)
-                continue
-            if group and group_size + len(m) + PER_MSG > budget:
-                frames.append(self._seal_batch(group))
-                group, group_size = [], 0
-            group.append(m)
-            group_size += len(m) + PER_MSG
-        if group:
-            frames.append(self._seal_batch(group))
+        for kind, val in pack_message_groups(
+                msgs, self.msg_len_limit - 512, self.msg_len_limit,
+                who=self.name):
+            frames.append(val if kind == 'raw' else self._seal_batch(val))
         return frames
 
     def _seal_batch(self, group: List[bytes]) -> bytes:
@@ -685,43 +694,26 @@ class ClientStack(StackBase):
         """One frame (or a few, under the size limit) per client per
         tick instead of one per message. Client batches are NOT signed —
         the AEAD channel already authenticates the node end-to-end
-        (unlike node-stack batches, which peers re-verify by verkey)."""
+        (unlike node-stack batches, which peers re-verify by verkey).
+        Packing rules (incl. the oversize-drop guard) come from
+        pack_message_groups, shared with the node stack."""
         if not self._outboxes:
             return 0
         flushed = 0
         outboxes, self._outboxes = self._outboxes, {}
-        budget = self.msg_len_limit - 512
         for client_id, msgs in outboxes.items():
             conn = self._clients.get(client_id)
             if conn is None or not conn.alive:
                 continue
             try:
-                if len(msgs) == 1:
-                    conn.send_frame(msgs[0])
-                    flushed += 1
-                    continue
-                group: List[bytes] = []
-                group_size = 0
-                for m in msgs:
-                    # same oversize guard as the node stack
-                    # (_make_batches): a single message past the frame
-                    # limit is dropped loudly, not sent for the peer's
-                    # read_frame check to kill the connection over
-                    if len(m) > self.msg_len_limit:
-                        logger.error(
-                            "%s: client message of %d bytes exceeds the "
-                            "%d-byte frame limit - dropped", self.name,
-                            len(m), self.msg_len_limit)
-                        continue
-                    if group and group_size + len(m) + 8 > budget:
+                for kind, val in pack_message_groups(
+                        msgs, self.msg_len_limit - 512,
+                        self.msg_len_limit, who=self.name):
+                    if kind == 'raw' or len(val) == 1:
+                        conn.send_frame(val if kind == 'raw' else val[0])
+                    else:
                         conn.send_frame(serializer.serialize(
-                            {OP_FIELD_NAME: BATCH_OP, "messages": group}))
-                        group, group_size = [], 0
-                    group.append(m)
-                    group_size += len(m) + 8
-                if group:
-                    conn.send_frame(serializer.serialize(
-                        {OP_FIELD_NAME: BATCH_OP, "messages": group}))
+                            {OP_FIELD_NAME: BATCH_OP, "messages": val}))
                 flushed += len(msgs)
             except Exception:
                 conn.close()
@@ -758,7 +750,17 @@ class ClientConnection:
 
     async def _read_loop(self):
         while self.conn is not None and self.conn.alive:
-            payload = await self.conn.read_frame(Config.MSG_LEN_LIMIT)
+            try:
+                payload = await self.conn.read_frame(Config.MSG_LEN_LIMIT)
+            except Exception:
+                # oversize/corrupt frame or transport error: the link is
+                # unusable — close it so owners polling conn.alive
+                # (NetworkedPoolClient.pump) redial instead of hanging
+                # on a dead reader task forever
+                logger.info("client read loop failed; closing link",
+                            exc_info=True)
+                self.conn.close()
+                break
             if payload is None:
                 # peer went away: mark the link dead so owners polling
                 # `conn.alive` (NetworkedPoolClient.pump) can redial
@@ -768,10 +770,19 @@ class ClientConnection:
                 msg = serializer.deserialize(payload)
                 if isinstance(msg, dict) and \
                         msg.get(OP_FIELD_NAME) == BATCH_OP:
-                    # coalesced node->client frame: unpack in order
+                    # coalesced node->client frame: unpack in order;
+                    # one undecodable entry costs ONE message (same
+                    # blast radius as un-coalesced frames), not the
+                    # tail of the envelope
                     for raw in msg.get("messages", []):
-                        self.rx.append(serializer.deserialize(
-                            raw if isinstance(raw, bytes) else bytes(raw)))
+                        try:
+                            self.rx.append(serializer.deserialize(
+                                raw if isinstance(raw, bytes)
+                                else bytes(raw)))
+                        except Exception:
+                            logger.warning(
+                                "undecodable entry in client batch "
+                                "frame - skipped")
                 else:
                     self.rx.append(msg)
             except Exception:

@@ -70,13 +70,21 @@ class NodeBatchExecutor(BatchExecutor):
         ledger = self.db.get_ledger(ledger_id)
         state = self.db.get_state(ledger_id)
         valid = []
+        # state updates happen per request (later requests' validation
+        # must see them), but the ledger staging of the whole batch is
+        # ONE appendTxns call at the end — txns group by their
+        # handler's ledger (one group for a normal per-ledger batch)
+        staged: Dict[int, List[dict]] = {}
+        seq_base: Dict[int, int] = {}
+        validate = self.write_manager.dynamic_validation
+        apply_deferred = self.write_manager.apply_request_deferred
         for digest in pre_prepare_digests:
             request = self._requests_source(digest)
             if request is None:
                 raise KeyError(
                     "request {} not available for apply".format(digest))
             try:
-                self.write_manager.dynamic_validation(request, pp_time)
+                validate(request, pp_time)
             except Exception as e:
                 logger.info("request %s failed dynamic validation: %s",
                             digest, e)
@@ -84,8 +92,19 @@ class NodeBatchExecutor(BatchExecutor):
                     else self._pp_seq_no + 1
                 self._on_request_rejected(digest, str(e), seq)
                 continue
-            self.write_manager.apply_request(request, pp_time)
+            handler_lid = self.write_manager.ledger_id_for_request(request)
+            group = staged.get(handler_lid)
+            if group is None:
+                group = staged[handler_lid] = []
+                seq_base[handler_lid] = self.db.get_ledger(
+                    handler_lid).uncommitted_size
+            txn, _lgr = apply_deferred(
+                request, pp_time,
+                seq_base[handler_lid] + len(group) + 1)
+            group.append(txn)
             valid.append(digest)
+        for lid, txns in staged.items():
+            self.db.get_ledger(lid).appendTxns(txns)
         if self._get_pp_seq_no is not None:
             self._pp_seq_no = self._get_pp_seq_no()
         else:

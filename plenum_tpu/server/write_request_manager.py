@@ -128,6 +128,27 @@ class WriteRequestManager:
         handler.update_state(txn, None, request)
         return txn
 
+    def ledger_id_for_request(self, request: Request) -> int:
+        return self.request_handlers[request.txn_type].ledger_id
+
+    def apply_request_deferred(self, request: Request, batch_ts: int,
+                               seq_no: int) -> Tuple[dict, object]:
+        """apply_request minus the ledger staging: state updates run
+        now (later requests' dynamic validation must see them), the txn
+        is returned with metadata for the caller to stage in ONE
+        appendTxns call per batch — a per-request appendTxns([txn]) was
+        measurable overhead on the apply hot path. → (txn, ledger)."""
+        from plenum_tpu.common.constants import (
+            TXN_METADATA, TXN_METADATA_SEQ_NO, TXN_METADATA_TIME)
+        handler = self.request_handlers[request.txn_type]
+        txn = reqToTxn(request)
+        txn[TXN_METADATA] = {
+            TXN_METADATA_SEQ_NO: seq_no,
+            TXN_METADATA_TIME: batch_ts,
+        }
+        handler.update_state(txn, None, request)
+        return txn, handler.ledger
+
     def post_apply_batch(self, three_pc_batch: ThreePcBatch):
         """Run the batch-handler chain after a batch's requests applied
         (audit txn creation happens here)."""

@@ -330,3 +330,48 @@ def test_deferred_garbage_share_cannot_wedge_ordering(bls_keys,
     multi = replica.bls_store.get("")
     assert multi is not None
     assert multi.participants == ["Node1", "Node2"]
+
+
+def test_quorum_slot_abuse_trips_strict_mode(bls_keys, mock_timer):
+    """A bad deferred share that costs a batch its multi-sig (it ate a
+    quorum slot) flips the replica to strict arrival-time verification
+    for a window — a byzantine peer cannot SUSTAIN proof suppression."""
+    from plenum_tpu.common.messages.node_messages import Commit, PrePrepare
+    from plenum_tpu.consensus.quorums import Quorums
+    verifier = BlsCryptoVerifierPlenum()
+    key_register = BlsKeyRegister(lambda n: bls_keys[n].pk)
+    replica = BlsBftReplica("Node1", bls_keys["Node1"], verifier,
+                            key_register, defer_share_verify=True)
+    quorums = Quorums(4)
+
+    def make_pp(seq):
+        return PrePrepare(
+            instId=0, viewNo=0, ppSeqNo=seq, ppTime=SIM_EPOCH,
+            reqIdr=["d%d" % seq], discarded="0", digest="x%d" % seq,
+            ledgerId=1, stateRootHash=None, txnRootHash=None,
+            sub_seq_no=0, final=False, poolStateRootHash=None)
+
+    pp = make_pp(1)
+    replica.process_pre_prepare(pp, "Node1")
+    commits = {}
+    for name in ("Node1", "Node2"):
+        params = BlsBftReplica(name, bls_keys[name], verifier,
+                               key_register).update_commit(
+            dict(instId=0, viewNo=0, ppSeqNo=1), pp)
+        commits[name] = Commit(**params)
+    # byzantine share fills the LAST quorum slot (bls quorum = 3 of 4)
+    bad = Commit(instId=0, viewNo=0, ppSeqNo=1, blsSig=commits["Node2"]
+                 .blsSig)  # Node2's share claimed by Node3: invalid
+    assert replica.validate_commit(bad, "Node3", pp) is None  # deferred
+    commits["Node3"] = bad
+    replica.process_order((0, 1), commits, pp, quorums)
+    assert replica.bls_store.get("") is None  # proof suppressed once
+    # ...but the abuse tripped strict mode: the same trick at the next
+    # seq is rejected at ARRIVAL, so it cannot eat a quorum slot again
+    pp2 = make_pp(2)
+    replica.process_pre_prepare(pp2, "Node1")
+    bad2_src = BlsBftReplica("Node2", bls_keys["Node2"], verifier,
+                             key_register).update_commit(
+        dict(instId=0, viewNo=0, ppSeqNo=2), pp2)
+    bad2 = Commit(**bad2_src)
+    assert replica.validate_commit(bad2, "Node3", pp2) is not None
