@@ -21,6 +21,7 @@ from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
 from plenum_tpu.common.config import Config
+from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 from plenum_tpu.common.messages.internal_messages import (
     NeedMasterCatchup, NeedViewChange, NewViewAccepted,
     NewViewCheckpointsApplied, VoteForViewChange, ViewChangeStarted)
@@ -171,6 +172,7 @@ class ViewChangeService:
         self._bus = bus
         self._network = network
         self._config = config or Config()
+        self.metrics = NullMetricsCollector()  # node injects the real one
         self._selector = primaries_selector or \
             RoundRobinConstantNodesPrimariesSelector(data.validators)
         self._builder = NewViewBuilder(data)
@@ -198,6 +200,7 @@ class ViewChangeService:
     # ------------------------------------------------------------ trigger
 
     def process_need_view_change(self, msg: NeedViewChange):
+        self._vc_started_at = __import__("time").perf_counter()
         proposed = msg.view_no if msg.view_no is not None \
             else self._data.view_no + 1
         if proposed <= self._data.view_no and self._data.view_no != 0:
@@ -393,6 +396,12 @@ class ViewChangeService:
             return
         self._data.waiting_for_new_view = False
         self._cancel_timers()
+        started = getattr(self, "_vc_started_at", None)
+        if started is not None:
+            self.metrics.add_event(
+                MetricsName.VIEW_CHANGE_TIME,
+                __import__("time").perf_counter() - started)
+            self._vc_started_at = None
         self._bus.send(NewViewAccepted(
             view_no=view_no,
             view_changes=list(nv.viewChanges),

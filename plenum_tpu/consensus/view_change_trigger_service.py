@@ -14,6 +14,7 @@ from typing import Dict, Optional
 from plenum_tpu.common.config import Config
 from plenum_tpu.common.messages.internal_messages import (
     NeedViewChange, VoteForViewChange)
+from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 from plenum_tpu.common.messages.node_messages import InstanceChange
 from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
 from plenum_tpu.runtime.stashing_router import DISCARD
@@ -126,6 +127,7 @@ class ViewChangeTriggerService:
         self._bus = bus
         self._network = network
         self._config = config or Config()
+        self.metrics = NullMetricsCollector()  # node injects the real one
         self._cache = InstanceChangeCache(
             timer, self._config.OUTDATED_INSTANCE_CHANGES_CHECK_INTERVAL,
             store=vote_store)
@@ -142,6 +144,7 @@ class ViewChangeTriggerService:
         if not isinstance(code, int):
             code = GENERIC_SUSPICION_CODE
         msg = InstanceChange(viewNo=proposed_view_no, reason=code)
+        self.metrics.add_event(MetricsName.INSTANCE_CHANGE_SENT, 1)
         logger.info("%s voting for view change to %d (%s)",
                     self._data.name, proposed_view_no, reason)
         self._cache.add_vote(proposed_view_no, self._data.name)

@@ -340,7 +340,9 @@ class Node:
         # money path with a single flush point
         for _staged in (self.propagator, self.executor, self.monitor,
                         self.replica.ordering, bls_bft_replica,
-                        self.write_manager):
+                        self.write_manager,
+                        getattr(self.replica, "view_changer", None),
+                        getattr(self.replica, "vc_trigger", None)):
             if _staged is not None:
                 _staged.metrics = self.metrics
         self.db_manager.metrics = self.metrics
@@ -1050,6 +1052,7 @@ class Node:
         if self.leecher.in_progress:
             return
         logger.info("%s starting catchup", self.name)
+        self._catchup_started_at = __import__("time").perf_counter()
         self.mode_participating = False
         for replica in self.replicas:
             replica.data.node_mode_participating = False
@@ -1125,6 +1128,12 @@ class Node:
             # negligence — restart the watchdog clocks or a freshly
             # caught-up node votes out a healthy primary
             self.freshness_checker.reset_all(self.timer.get_current_time())
+        started = getattr(self, "_catchup_started_at", None)
+        if started is not None:
+            self.metrics.add_event(
+                MetricsName.CATCHUP_TIME,
+                __import__("time").perf_counter() - started)
+            self._catchup_started_at = None
         logger.info("%s catchup finished; last_ordered=%s", self.name,
                     self.replica.data.last_ordered_3pc)
 

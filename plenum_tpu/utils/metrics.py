@@ -51,6 +51,7 @@ class MetricsName(IntEnum):
     REPLY_TIME = 28               # reply construct + merkle audit path
     # ---- catchup
     CATCHUP_TXNS_RECEIVED = 30
+    CATCHUP_TIME = 31             # start_catchup -> caught up, seconds
     # ---- view change
     VIEW_CHANGE_TIME = 40         # NeedViewChange -> NewView accepted
     INSTANCE_CHANGE_SENT = 41
