@@ -42,6 +42,17 @@ class BlsStore:
             return None
         return MultiSignature.from_dict(json.loads(bytes(raw).decode()))
 
+    def items(self):
+        """→ [(state_root_b58, MultiSignature)] — audit/invariant
+        tooling walks every stored proof (backed by the KV iterator)."""
+        import json
+        out = []
+        for k, v in self._kv.iterator(include_value=True):
+            out.append((bytes(k).decode(),
+                        MultiSignature.from_dict(
+                            json.loads(bytes(v).decode()))))
+        return out
+
 
 class BlsKeyRegister:
     """node name → BLS public key (reference
@@ -89,6 +100,12 @@ class BlsBftReplica:
         # process_order doesn't pay a second ~5 ms pairing per share:
         # (view_no, pp_seq_no, sender) -> sig string
         self._verified_shares: Dict[tuple, str] = {}
+        # batches ordered WITHOUT a bls_signatures quorum of valid
+        # shares (e.g. a byzantine share ate a quorum slot): kept so
+        # late valid COMMITs can backfill the multi-sig — a poisoned
+        # share may delay a state proof but never suppress it for good.
+        # (view_no, pp_seq_no) -> True; values live in _pp_values.
+        self._pending_backfill: Dict[tuple, bool] = {}
 
     def warm_pool_keys(self, validators) -> None:
         """Front-load the verifier's key-dependent work (G2 subgroup
@@ -236,6 +253,9 @@ class BlsBftReplica:
                 if quorums is None \
                         or quorums.bls_signatures.is_reached(len(sigs)):
                     self.bls_store.put(multi)
+                    self._pending_backfill.pop(key, None)
+                else:
+                    self._pending_backfill[key] = True
                 self._gc(pp.ppSeqNo)
                 return
             keep = []
@@ -256,22 +276,59 @@ class BlsBftReplica:
                 # this batch its state proof — arrival-time checks
                 # would have rejected that COMMIT. Go strict for a
                 # window so the attacker cannot sustain suppression.
-                self._strict_until_seq = pp.ppSeqNo + 100
+                # max(): a backfill retry for an OLD batch must never
+                # REWIND a window armed by later abuse.
+                self._strict_until_seq = max(self._strict_until_seq,
+                                             pp.ppSeqNo + 100)
                 logger.warning(
                     "%s: deferred BLS share verification abused at %s —"
                     " strict arrival checks until seq %d", self._name,
                     key, self._strict_until_seq)
         if quorums is not None \
                 and not quorums.bls_signatures.is_reached(len(sigs)):
+            self._pending_backfill[key] = True
             return
         if not sigs:
+            self._pending_backfill[key] = True
             return
         multi = MultiSignature(
             signature=self._verifier.create_multi_sig(sigs),
             participants=sorted(participants),
             value=value)
         self.bls_store.put(multi)
+        self._pending_backfill.pop(key, None)
         self._gc(pp.ppSeqNo)
+
+    # ----------------------------------------------------------- backfill
+
+    def retry_backfill(self, key, commits: Dict[str, "Commit"], pp,
+                       quorums=None) -> bool:
+        """Late valid COMMITs for a batch that missed its bls_signatures
+        quorum at ordering time retry the aggregation (ADVICE: a
+        byzantine share may DELAY a stored state proof, never suppress
+        it permanently). Called by the ordering service whenever a
+        COMMIT lands on an already-ordered batch; cheap no-op unless the
+        batch is registered proof-less AND enough candidate shares have
+        now accumulated. → True once a multi-sig got stored."""
+        if key not in self._pending_backfill:
+            return False
+        if (pp.viewNo, pp.ppSeqNo) not in self._pp_values:
+            # value GC'd — the proof window for this batch has passed
+            del self._pending_backfill[key]
+            return False
+        candidates = sum(
+            1 for sender, commit in commits.items()
+            if getattr(commit, "blsSig", None) is not None
+            and self._keys.get_key_by_name(sender) is not None)
+        if quorums is not None \
+                and not quorums.bls_signatures.is_reached(candidates):
+            return False    # still short — wait for more late shares
+        self._process_order(key, commits, pp, quorums)
+        done = key not in self._pending_backfill
+        if done:
+            logger.info("%s: backfilled BLS multi-sig for %s from late "
+                        "COMMIT shares", self._name, key)
+        return done
 
     def _gc(self, below_seq: int):
         for k in [k for k in self._pp_values if k[1] < below_seq - 10]:
@@ -279,3 +336,6 @@ class BlsBftReplica:
         for k in [k for k in self._verified_shares
                   if k[1] < below_seq - 10]:
             del self._verified_shares[k]
+        for k in [k for k in self._pending_backfill
+                  if k[1] < below_seq - 10]:
+            del self._pending_backfill[k]

@@ -583,6 +583,14 @@ class OrderingService:
         pp = self.prePrepares.get(key)
         if pp is not None:
             self._try_order(pp)
+            if key in self.ordered and self._bls is not None:
+                # late COMMIT on an already-ordered batch: if the batch
+                # missed its bls_signatures quorum at ordering time
+                # (e.g. a poisoned deferred share ate a slot), this
+                # share may complete the multi-sig now — no batch stays
+                # proof-less forever (cheap no-op otherwise)
+                self._bls.retry_backfill(key, self.commits[key], pp,
+                                         self._data.quorums)
         return None
 
     def _has_committed(self, key: Tuple[int, int]) -> bool:

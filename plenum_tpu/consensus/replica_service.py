@@ -134,6 +134,19 @@ class ReplicaService:
         self.internal_bus.send(VoteForViewChange(suspicion="external",
                                                  view_no=view_no))
 
+    # -------------------------------------------------- interception seam
+
+    def install_network_tap(self, tap) -> None:
+        """The ONLY supported seam for fault-injection tooling
+        (testing/adversary): every message this replica sends or
+        receives flows through ``tap`` (see ExternalBus.set_tap for the
+        protocol). Behavior lives entirely in the tap — this class and
+        the services it aggregates stay byzantine-logic-free."""
+        self.network.set_tap(tap)
+
+    def uninstall_network_tap(self) -> None:
+        self.network.clear_tap()
+
     # ------------------------------------------------------------- hooks
 
     def _on_ordered(self, ordered: Ordered):

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
@@ -200,11 +201,14 @@ class ViewChangeService:
     # ------------------------------------------------------------ trigger
 
     def process_need_view_change(self, msg: NeedViewChange):
-        self._vc_started_at = __import__("time").perf_counter()
         proposed = msg.view_no if msg.view_no is not None \
             else self._data.view_no + 1
         if proposed <= self._data.view_no and self._data.view_no != 0:
             return
+        # stamp only once the proposal is ACCEPTED — a rejected (stale)
+        # NeedViewChange must not restart the duration clock of a view
+        # change already in flight
+        self._vc_started_at = time.perf_counter()
         self._start_view_change(proposed)
 
     def _start_view_change(self, proposed_view_no: int):
@@ -400,7 +404,7 @@ class ViewChangeService:
         if started is not None:
             self.metrics.add_event(
                 MetricsName.VIEW_CHANGE_TIME,
-                __import__("time").perf_counter() - started)
+                time.perf_counter() - started)
             self._vc_started_at = None
         self._bus.send(NewViewAccepted(
             view_no=view_no,

@@ -66,21 +66,32 @@ class ScalarVerifier:
         return _Ready(self.verify_batch(items))
 
 
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (  # noqa
+        Ed25519PublicKey as _OpenSSLEd25519PublicKey)
+    HAVE_OPENSSL = True
+except ImportError:        # soft dep: scalar RFC 8032 fallback below
+    HAVE_OPENSSL = False
+
+
 class OpenSSLVerifier:
     """The CPU production floor (libsodium-equivalent): OpenSSL Ed25519
-    via `cryptography`. Reference: stp_core/crypto/nacl_wrappers.py."""
+    via `cryptography`. Reference: stp_core/crypto/nacl_wrappers.py.
+    When `cryptography` is not installed, falls back to the
+    pure-Python RFC 8032 implementation — identical verdicts, scalar
+    speed floor."""
 
     name = "cpu"
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        if not HAVE_OPENSSL:
+            return ScalarVerifier().verify_batch(items)
         from cryptography.exceptions import InvalidSignature
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PublicKey)
         out = []
         for msg, sig, vk in items:
             try:
-                Ed25519PublicKey.from_public_bytes(bytes(vk)).verify(
-                    bytes(sig), bytes(msg))
+                _OpenSSLEd25519PublicKey.from_public_bytes(
+                    bytes(vk)).verify(bytes(sig), bytes(msg))
                 out.append(True)
             except (InvalidSignature, ValueError):
                 out.append(False)

@@ -29,14 +29,20 @@ from __future__ import annotations
 import hashlib
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey, Ed25519PublicKey)
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.exceptions import InvalidSignature
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey, Ed25519PublicKey)
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.exceptions import InvalidSignature
+except ImportError:        # soft dep: pure-Python RFC-vetted fallback
+    from plenum_tpu.crypto.pure_channel_crypto import (
+        ChaCha20Poly1305, Ed25519PrivateKey, Ed25519PublicKey, HKDF,
+        InvalidSignature, X25519PrivateKey, X25519PublicKey, hashes,
+        serialization)
 
 PROTO_MAGIC = b"PTX1"
 ANON_VK = b"\x00" * 32

@@ -13,9 +13,13 @@ from __future__ import annotations
 import os
 from typing import Dict, Optional
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey)
-from cryptography.hazmat.primitives import serialization
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+    from cryptography.hazmat.primitives import serialization
+except ImportError:        # soft dep: pure-Python RFC-vetted fallback
+    from plenum_tpu.crypto.pure_channel_crypto import (
+        Ed25519PrivateKey, serialization)
 
 from plenum_tpu.common.serializers.base58 import b58decode, b58encode
 

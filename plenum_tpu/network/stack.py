@@ -209,6 +209,11 @@ class StackBase:
         self.msg_len_limit = self.config.MSG_LEN_LIMIT
         from plenum_tpu.utils.metrics import NullMetricsCollector
         self.metrics = NullMetricsCollector()  # host node injects
+        # interception seam for fault-injection tooling
+        # (testing/adversary): on_send(msg, dst) / on_incoming(msg, frm)
+        # may rewrite, duplicate, or drop wire traffic; None =
+        # pass-through. The stack itself carries no fault behavior.
+        self.wire_tap = None
 
     # ------------------------------------------------------------ server
 
@@ -271,6 +276,17 @@ class StackBase:
             msg, frm = self.rx.popleft()
             count += 1
             size += len(str(msg))
+            if self.wire_tap is not None:
+                routed = self.wire_tap.on_incoming(msg, frm)
+                if routed is not None:
+                    for m, f in routed:
+                        try:
+                            on_message(m, f)
+                        except Exception:
+                            logger.exception(
+                                "%s: handler failed for msg from %s",
+                                self.name, f)
+                    continue
             try:
                 on_message(msg, frm)
             except Exception:
@@ -432,9 +448,8 @@ class NodeStack(StackBase):
         sig = batch.get("signature")
         if not sig:
             return False
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PublicKey)
-        from cryptography.exceptions import InvalidSignature
+        from plenum_tpu.network.crypto_channel import (
+            Ed25519PublicKey, InvalidSignature)
         content = b"".join(bytes(m) for m in batch.get("messages", []))
         try:
             Ed25519PublicKey.from_public_bytes(
@@ -526,6 +541,15 @@ class NodeStack(StackBase):
 
     def send(self, msg_dict: dict, dst=None):
         """Enqueue; dst None = broadcast, str or list of names."""
+        if self.wire_tap is not None:
+            routed = self.wire_tap.on_send(msg_dict, dst)
+            if routed is not None:
+                for m, d in routed:
+                    self._send_untapped(m, d)
+                return
+        self._send_untapped(msg_dict, dst)
+
+    def _send_untapped(self, msg_dict: dict, dst=None):
         raw = serializer.serialize(msg_dict)
         if len(raw) > self.msg_len_limit:
             logger.warning("%s: dropping oversized %dB message",
@@ -704,6 +728,11 @@ class ClientStack(StackBase):
         for client_id, msgs in outboxes.items():
             conn = self._clients.get(client_id)
             if conn is None or not conn.alive:
+                # reply loss under churn must be diagnosable
+                logger.debug(
+                    "%s: dropping %d queued repl(y/ies) for %s — "
+                    "connection gone before flush", self.name, len(msgs),
+                    client_id)
                 continue
             try:
                 for kind, val in pack_message_groups(
@@ -716,6 +745,9 @@ class ClientStack(StackBase):
                             {OP_FIELD_NAME: BATCH_OP, "messages": val}))
                 flushed += len(msgs)
             except Exception:
+                logger.debug(
+                    "%s: connection to %s died mid-flush — dropping its "
+                    "%d-message outbox", self.name, client_id, len(msgs))
                 conn.close()
                 self._clients.pop(client_id, None)
         return flushed

@@ -30,8 +30,12 @@ generic 381-bit prime):
    scales across a device mesh with zero collectives.
 
 The scalar/native paths stay authoritative for single aggregates (a
-device dispatch costs more than one 100-share aggregate on CPU);
-crypto/bls_ops routes by queue depth.
+device dispatch costs more than one 100-share aggregate on CPU). This
+kernel is currently exercised by bench.py, the multichip dryrun
+(__graft_entry__) and tests only — the ordering path aggregates through
+crypto/bls_ops (native C / pure Python); wiring a queue-depth router
+that batches concurrent ordering-path aggregations onto this kernel is
+future work and NOT yet a production code path.
 """
 from __future__ import annotations
 

@@ -35,7 +35,12 @@ class InternalBus(Router):
 class ExternalBus(Router):
     """Network-facing bus: `send` goes out via the transport handler;
     `process_incoming` dispatches received messages with their sender name.
-    Tracks connected peers (reference event_bus.py:11)."""
+    Tracks connected peers (reference event_bus.py:11).
+
+    An optional TAP is the single interception seam for fault-injection
+    tooling (testing/adversary): it sees every send/receive and may
+    rewrite, duplicate, or drop traffic. The bus itself carries no
+    behavior — it only routes what the tap returns."""
 
     class Connected(NamedTuple):
         pass
@@ -47,16 +52,53 @@ class ExternalBus(Router):
         super().__init__()
         self._send_handler = send_handler
         self._connecteds = set()
+        self._tap = None
 
     @property
     def connecteds(self) -> set:
         return self._connecteds
 
+    def set_tap(self, tap) -> None:
+        """Install a send/recv tap: an object with
+        ``on_send(message, dst) -> Optional[List[(message, dst)]]`` and
+        ``on_incoming(message, frm) -> Optional[List[(message, frm)]]``.
+        ``None`` means pass-through; a list replaces the original
+        (empty list = drop). Only one tap per bus — chaining belongs in
+        the tap implementation, not here."""
+        if self._tap is not None and tap is not None:
+            raise ValueError("tap already installed")
+        self._tap = tap
+
+    def clear_tap(self) -> None:
+        self._tap = None
+
     def send(self, message: Any, dst=None) -> None:
         """dst None = broadcast; str = single peer; list = multiple peers."""
+        if self._tap is not None:
+            routed = self._tap.on_send(message, dst)
+            if routed is not None:
+                for m, d in routed:
+                    self._send_handler(m, d)
+                return
+        self._send_handler(message, dst)
+
+    def send_raw(self, message: Any, dst=None) -> None:
+        """Send bypassing the tap — used by the tap itself to release
+        held/rewritten traffic without re-entering interception."""
         self._send_handler(message, dst)
 
     def process_incoming(self, message: Any, frm: str):
+        if self._tap is not None and not isinstance(
+                message, (self.Connected, self.Disconnected)):
+            routed = self._tap.on_incoming(message, frm)
+            if routed is not None:
+                result = None
+                for m, f in routed:
+                    result = self._dispatch(m, f)
+                return result
+        return self._dispatch(message, frm)
+
+    def _dispatch(self, message: Any, frm: str):
         result = None
         for handler in self.handlers(type(message)):
             result = handler(message, frm)

@@ -11,7 +11,10 @@ import logging
 from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple, Type
 
-from sortedcontainers import SortedList
+try:
+    from sortedcontainers import SortedList
+except ImportError:            # soft dep: stdlib fallback
+    from plenum_tpu.utils.sorted_fallback import SortedList
 
 logger = logging.getLogger(__name__)
 

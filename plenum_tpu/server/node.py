@@ -295,9 +295,26 @@ class Node:
             on_backup_pp_sent=self.last_sent_pp_store.store_last_sent)
 
         # ---- propagation
+        # gate for peer-relayed requests (client-intake requests were
+        # authenticated at intake): a node must not vote for content
+        # whose client signature it cannot verify. Deliberately a LOCAL
+        # verifier, not self.authnr's configured provider — a remote or
+        # device-batched provider would block (or deadlock) the prod
+        # loop for what is a low-volume synchronous check.
+        propagate_authnr = CoreAuthNr(
+            verkey_provider=self._verkey_from_domain_state)
+
+        def authenticate_propagated(request) -> bool:
+            try:
+                propagate_authnr.authenticate(request)
+                return True
+            except Exception:
+                return False
+
         self.propagator = Propagator(
             name, self.replica.data.quorums, network,
-            forward_handler=self._forward_finalised)
+            forward_handler=self._forward_finalised,
+            authenticator=authenticate_propagated)
         network.subscribe(Propagate, self.propagator.process_propagate)
         network.subscribe(PropagateBatch,
                           self.propagator.process_propagate_batch)

@@ -105,7 +105,12 @@ class Requests(dict):
         state = self.get(key)
         if state is None:
             state = self[key] = ReqState(req)
-            self._by_ref[(req.identifier, req.reqId)] = state
+        # first writer wins: a later same-(identifier, reqId) variant
+        # must not hijack the fast-path index and starve the request
+        # that is already collecting votes — but a still-live state
+        # DOES re-claim a slot vacated by free(), or every later gossip
+        # copy would pay the full digest + auth path the index avoids
+        self._by_ref.setdefault((req.identifier, req.reqId), state)
         return state
 
     def ref_state(self, payload: dict) -> Optional[ReqState]:
@@ -162,13 +167,21 @@ class Propagator:
     BATCH_SIZE_BUDGET = 128 * 1024 - 8 * 1024
 
     def __init__(self, name: str, quorums: Quorums, network,
-                 forward_handler: Callable[[Request], None]):
+                 forward_handler: Callable[[Request], None],
+                 authenticator: Callable[[Request], bool] = None):
         """network: ExternalBus; forward_handler: called exactly once per
-        finalised request (feeds ordering queues)."""
+        finalised request (feeds ordering queues). authenticator(request)
+        → bool gates requests FIRST LEARNED from a peer's PROPAGATE: a
+        node must never echo-vote (or forward) content it cannot
+        authenticate — otherwise a single byzantine relay plus the
+        honest echo reaches the f+1 quorum with a forged payload (found
+        by the TamperedPropagate adversary scenario). Requests from the
+        client intake path were authenticated there already."""
         self.name = name
         self.quorums = quorums
         self._network = network
         self._forward = forward_handler
+        self._authenticator = authenticator
         self.requests = Requests()
         self.metrics = NullMetricsCollector()   # node injects the real one
         # queued outgoing propagates, flushed as PROPAGATE_BATCH once
@@ -267,7 +280,23 @@ class Propagator:
             return
         state = self.requests.lookup_state(payload)
         if state is None:
-            state = self.requests.add(Request.from_dict(payload))
+            # first sighting of this exact content — it must
+            # authenticate before it may collect votes or be echoed
+            try:
+                request = Request.from_dict(payload)
+            except Exception:
+                logger.warning("%s: malformed PROPAGATE payload from %s "
+                               "— ignored", self.name, frm)
+                return
+            if self._authenticator is not None \
+                    and not self._authenticator(request):
+                logger.warning(
+                    "%s: PROPAGATE from %s fails authentication "
+                    "(identifier=%s reqId=%s) — ignored, not echoed",
+                    self.name, frm, payload.get("identifier"),
+                    payload.get("reqId"))
+                return
+            state = self.requests.add(request)
         propagates = state.propagates
         propagates.add(frm)
         # echo our own propagate if we haven't yet (so slow clients still

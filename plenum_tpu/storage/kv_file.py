@@ -14,7 +14,10 @@ import os
 import struct
 from typing import Iterable, Tuple
 
-from sortedcontainers import SortedDict
+try:
+    from sortedcontainers import SortedDict
+except ImportError:            # soft dep: stdlib fallback
+    from plenum_tpu.utils.sorted_fallback import SortedDict
 
 from plenum_tpu.storage.kv_store import KeyValueStorage, to_bytes
 

@@ -15,7 +15,10 @@ import os
 import struct
 from typing import Iterable, Iterator, Tuple
 
-from sortedcontainers import SortedSet
+try:
+    from sortedcontainers import SortedSet
+except ImportError:            # soft dep: stdlib fallback
+    from plenum_tpu.utils.sorted_fallback import SortedSet
 
 from plenum_tpu.storage.kv_store import KeyValueStorage, to_bytes
 

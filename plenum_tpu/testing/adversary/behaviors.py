@@ -1,0 +1,303 @@
+"""Pluggable malicious behaviors, mirroring the reference corpus
+(plenum/test/malicious_behaviors_node.py): equivocating primary,
+duplicate/conflicting 3PC, tampered PROPAGATE payloads, poisoned
+deferred BLS shares, and per-link delay/reorder/drop/corrupt faults.
+
+A Behavior is a send/recv transformer installed on ONE adversarial
+node's network seam by the AdversaryController. Both hooks follow the
+ExternalBus tap protocol: return ``None`` to pass the message through
+unchanged, or a list of (message, destination) pairs that replaces it
+(empty list = swallow). All randomness MUST come from
+``self.controller.random`` so a fixed seed reproduces the identical
+fault trace."""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from plenum_tpu.common.messages.node_messages import (
+    Commit, PrePrepare, Prepare, Propagate, PropagateBatch)
+
+logger = logging.getLogger(__name__)
+
+
+class Behavior:
+    """Base: benign pass-through. Subclasses override on_send /
+    on_incoming / on_tick."""
+
+    name = "behavior"
+
+    def __init__(self):
+        self.controller = None
+        self.node_name = None
+
+    def attach(self, node_name: str, controller) -> None:
+        self.node_name = node_name
+        self.controller = controller
+
+    def detach(self) -> None:
+        pass
+
+    def record(self, event: str) -> None:
+        self.controller.record("{}[{}] {}".format(
+            self.name, self.node_name, event))
+
+    def on_send(self, msg, dst) -> Optional[List[Tuple]]:
+        return None
+
+    def on_incoming(self, msg, frm) -> Optional[List[Tuple]]:
+        return None
+
+    def on_tick(self) -> None:
+        """Deterministic scheduler tick (release held messages etc.)."""
+
+
+def _broadcast_targets(controller, node_name, dst) -> List[str]:
+    """Materialize a send's destination set from the pool roster."""
+    if dst is None:
+        return [n for n in controller.pool_names() if n != node_name]
+    if isinstance(dst, str):
+        return [dst]
+    return list(dst)
+
+
+class EquivocatingPrimary(Behavior):
+    """The primary proposes DIFFERENT batches to different replicas
+    (reference: malicious send of conflicting PRE-PREPAREs). Half the
+    recipients get the real PRE-PREPARE; the other half get a forged
+    variant with the batch contents stripped and the digest recomputed
+    (so it passes the digest check and fails only at the apply-and-
+    compare defense — the strongest equivocation an adversary without
+    the honest executor state can mount)."""
+
+    name = "equivocate-pp"
+
+    def __init__(self, real_count: Optional[int] = None):
+        """real_count: how many recipients get the REAL PrePrepare
+        (None = half). 0 = everyone gets the forged variant — the
+        stall-inducing extreme; >=1 leaves a seed for MessageReq
+        self-healing."""
+        super().__init__()
+        self._real_count = real_count
+
+    def on_send(self, msg, dst):
+        if not isinstance(msg, PrePrepare):
+            return None
+        targets = _broadcast_targets(self.controller, self.node_name, dst)
+        if len(targets) < 2:
+            return None
+        shuffled = self.controller.random.shuffle(sorted(targets))
+        half = max(1, len(shuffled) // 2) if self._real_count is None \
+            else max(0, min(self._real_count, len(shuffled)))
+        group_a, group_b = shuffled[:half], shuffled[half:]
+        if not group_b:
+            return None
+        from plenum_tpu.consensus.ordering_service import OrderingService
+        params = dict(msg.as_dict())
+        params["reqIdr"] = []
+        ov = params.get("originalViewNo")
+        params["digest"] = OrderingService.generate_pp_digest(
+            [], ov if ov is not None else msg.viewNo, msg.ppTime)
+        forged = PrePrepare(**params)
+        self.record("pp seq={} real->{} forged->{}".format(
+            msg.ppSeqNo, ",".join(sorted(group_a)) or "-",
+            ",".join(sorted(group_b))))
+        out = [(forged, group_b)]
+        if group_a:
+            out.insert(0, (msg, group_a))
+        return out
+
+
+class DuplicateThreePC(Behavior):
+    """Every outgoing 3PC message is sent `copies` times (reference
+    duplicate-3PC malicious behavior). Honest nodes must count each
+    sender once per (view, seq)."""
+
+    name = "duplicate-3pc"
+
+    def __init__(self, copies: int = 3, message_types=(PrePrepare,
+                                                       Prepare, Commit)):
+        super().__init__()
+        self._copies = copies
+        self._types = tuple(message_types)
+
+    def on_send(self, msg, dst):
+        if not isinstance(msg, self._types):
+            return None
+        self.record("x{} {} seq={}".format(
+            self._copies, type(msg).__name__,
+            getattr(msg, "ppSeqNo", "?")))
+        return [(msg, dst)] * self._copies
+
+
+class ConflictingPrepare(Behavior):
+    """A non-primary vote-splitter: victims receive a PREPARE whose
+    digest disagrees with the PRE-PREPARE (reference conflicting-3PC
+    behavior); everyone else gets the real vote. Honest nodes must
+    discard the conflicting vote (PR_DIGEST_WRONG) and still reach
+    quorum from honest votes."""
+
+    name = "conflicting-prepare"
+
+    def __init__(self, victims=None):
+        super().__init__()
+        self._victims = set(victims) if victims is not None else None
+
+    def on_send(self, msg, dst):
+        if not isinstance(msg, Prepare):
+            return None
+        targets = _broadcast_targets(self.controller, self.node_name, dst)
+        victims = [t for t in targets
+                   if self._victims is None or t in self._victims]
+        rest = [t for t in targets if t not in victims]
+        if not victims:
+            return None
+        params = dict(msg.as_dict())
+        params["digest"] = "f" * len(msg.digest)
+        conflicting = Prepare(**params)
+        self.record("seq={} conflicting->{}".format(
+            msg.ppSeqNo, ",".join(sorted(victims))))
+        out = [(conflicting, victims)]
+        if rest:
+            out.append((msg, rest))
+        return out
+
+
+class TamperedPropagate(Behavior):
+    """Request tampering (reference malicious_behaviors_node
+    changesRequest): every relayed PROPAGATE carries a mutated
+    operation. The tampered copy hashes to a different digest, so it
+    can never join the f+1 identical-propagate quorum of the honest
+    request — finalization must come from honest relays only."""
+
+    name = "tamper-propagate"
+
+    def _tamper(self, request: dict) -> dict:
+        req = dict(request)
+        op = dict(req.get("operation") or {})
+        op["dest"] = "Tampered" + str(op.get("dest", ""))[:20]
+        req["operation"] = op
+        return req
+
+    def on_send(self, msg, dst):
+        if isinstance(msg, Propagate):
+            self.record("tampered propagate req={}".format(
+                (msg.request or {}).get("reqId")))
+            return [(Propagate(request=self._tamper(msg.request),
+                               senderClient=msg.senderClient), dst)]
+        if isinstance(msg, PropagateBatch):
+            self.record("tampered propagate batch n={}".format(
+                len(msg.requests)))
+            return [(PropagateBatch(
+                requests=[self._tamper(r) for r in msg.requests],
+                clients=list(msg.clients)), dst)]
+        return None
+
+
+class PoisonedBlsShare(Behavior):
+    """COMMITs carry a BLS share that decodes fine but signs the WRONG
+    value (a stale share from an earlier batch), or — every `garble_every`
+    poisonings — an undecodable string. Drives the deferred-verification
+    defense in consensus/bls_bft_replica.py: the aggregate check fails,
+    the per-share unroll assigns blame, the adaptive strict window
+    engages, and the multi-sig backfill recovers the proof from late
+    honest shares."""
+
+    name = "poison-bls"
+
+    def __init__(self, garble_every: int = 0):
+        super().__init__()
+        self._stale_sig = None
+        self._garble_every = garble_every
+        self._count = 0
+
+    def on_send(self, msg, dst):
+        if not isinstance(msg, Commit) or \
+                getattr(msg, "blsSig", None) is None:
+            return None
+        self._count += 1
+        stale, self._stale_sig = self._stale_sig, msg.blsSig
+        if self._garble_every and self._count % self._garble_every == 0:
+            poisoned = "!!not-base58!!"
+        elif stale is not None and stale != msg.blsSig:
+            poisoned = stale          # valid share over the wrong value
+        else:
+            poisoned = msg.blsSig[::-1]
+        params = dict(msg.as_dict())
+        params["blsSig"] = poisoned
+        self.record("seq={} poisoned".format(msg.ppSeqNo))
+        return [(Commit(**params), dst)]
+
+
+class LinkFault(Behavior):
+    """Per-link chaos: probabilistic drop / corrupt / delay (delay with
+    jitter ⇒ reorder) on matching sends. All draws come from the
+    controller's seeded SimRandom; held messages are released by the
+    controller's deterministic tick, so the whole fault pattern replays
+    bit-identically for a fixed seed."""
+
+    name = "link-fault"
+
+    def __init__(self, drop_p: float = 0.0, corrupt_p: float = 0.0,
+                 delay_p: float = 0.0, delay: float = 1.0,
+                 jitter: float = 0.5, dst=None, message_types=None):
+        super().__init__()
+        self._drop_p = drop_p
+        self._corrupt_p = corrupt_p
+        self._delay_p = delay_p
+        self._delay = delay
+        self._jitter = jitter
+        self._dst = set(dst) if dst is not None else None
+        self._types = tuple(message_types) if message_types else None
+        self._held: List[Tuple[float, object, object]] = []
+
+    def _matches(self, msg, dst) -> bool:
+        if self._types is not None and not isinstance(msg, self._types):
+            return False
+        if self._dst is not None:
+            targets = _broadcast_targets(self.controller, self.node_name,
+                                         dst)
+            return bool(set(targets) & self._dst)
+        return True
+
+    def _corrupt(self, msg):
+        if hasattr(msg, "digest") and isinstance(msg.digest, str):
+            params = dict(msg.as_dict())
+            params["digest"] = "0" * len(msg.digest)
+            return type(msg)(**params)
+        return msg
+
+    def on_send(self, msg, dst):
+        if not self._matches(msg, dst):
+            return None
+        rng = self.controller.random
+        roll = rng.float(0.0, 1.0)
+        if roll < self._drop_p:
+            self.record("drop {}".format(type(msg).__name__))
+            return []
+        if roll < self._drop_p + self._corrupt_p:
+            self.record("corrupt {}".format(type(msg).__name__))
+            return [(self._corrupt(msg), dst)]
+        if roll < self._drop_p + self._corrupt_p + self._delay_p:
+            extra = self._delay + rng.float(0.0, self._jitter)
+            release = self.controller.now() + extra
+            self._held.append((release, msg, dst))
+            self.record("hold {} for {:.2f}s".format(
+                type(msg).__name__, extra))
+            return []
+        return None
+
+    def on_tick(self):
+        now = self.controller.now()
+        due = [h for h in self._held if h[0] <= now]
+        if not due:
+            return
+        self._held = [h for h in self._held if h[0] > now]
+        for _, msg, dst in due:
+            self.controller.raw_send(self.node_name, msg, dst)
+
+    def detach(self):
+        # flush anything still held so messages are not lost forever
+        for _, msg, dst in self._held:
+            self.controller.raw_send(self.node_name, msg, dst)
+        self._held = []

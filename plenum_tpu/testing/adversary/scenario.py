@@ -1,0 +1,102 @@
+"""Scenario runner: drives a sim pool tick by tick, evaluating the
+safety invariant checkers after EVERY tick, with bounded-window
+liveness assertions for the recovery phase of a fault plan."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from plenum_tpu.testing.adversary.invariants import InvariantChecker
+
+
+class LivenessViolation(AssertionError):
+    """The pool failed to make progress inside the bounded window."""
+
+
+class Scenario:
+    def __init__(self, timer, nodes, adversary=None,
+                 honest: Optional[List[str]] = None,
+                 checker: Optional[InvariantChecker] = None,
+                 step: float = 0.05):
+        self.timer = timer
+        self.nodes = list(nodes)
+        self.adversary = adversary
+        if honest is None:
+            corrupted = set(adversary.adversaries) if adversary else set()
+            honest = [n.name for n in nodes if n.name not in corrupted]
+        self.honest_names = list(honest)
+        self.checker = checker or InvariantChecker(nodes, honest)
+        self.step = step
+        if adversary is not None and not adversary.pool_names():
+            adversary.set_pool(nodes)
+
+    # ------------------------------------------------------------- drive
+
+    @property
+    def honest(self) -> List:
+        return [n for n in self.nodes if n.name in self.honest_names]
+
+    def run(self, seconds: float) -> "Scenario":
+        """Pump the pool for `seconds` of sim time, checking every
+        safety invariant after every tick."""
+        end = self.timer.get_current_time() + seconds
+        while self.timer.get_current_time() < end:
+            self._tick()
+        return self
+
+    def run_until(self, condition: Callable[[], bool], timeout: float,
+                  desc: str) -> "Scenario":
+        """Pump until condition() holds; LivenessViolation on timeout —
+        the bounded-window liveness assertion."""
+        deadline = self.timer.get_current_time() + timeout
+        while not condition():
+            if self.timer.get_current_time() >= deadline:
+                raise LivenessViolation(
+                    "liveness: {} not reached within {}s (t={})".format(
+                        desc, timeout, self.timer.get_current_time()))
+            self._tick()
+        return self
+
+    def _tick(self) -> None:
+        for node in self.nodes:
+            node.service()
+        self.timer.run_for(self.step)
+        self.checker.check()
+
+    # ------------------------------------------------- liveness helpers
+
+    def await_ordering_resumes(self, extra_batches: int = 1,
+                               within: float = 30.0) -> "Scenario":
+        """Honest nodes must each order `extra_batches` more batches
+        within the window (the fault is over / absorbed)."""
+        base = {n.name: _last_seq(n) for n in self.honest}
+
+        def resumed():
+            return all(_last_seq(n) >= base[n.name] + extra_batches
+                       for n in self.honest)
+
+        return self.run_until(
+            resumed, within,
+            "+{} ordered batches on every honest node".format(
+                extra_batches))
+
+    def await_view_change(self, min_view: int = 1,
+                          within: float = 60.0) -> "Scenario":
+        """Every honest node must complete a view change to at least
+        `min_view` (adversarial-primary recovery)."""
+
+        def done():
+            return all(
+                _replica(n).view_no >= min_view
+                and not _replica(n).data.waiting_for_new_view
+                for n in self.honest)
+
+        return self.run_until(
+            done, within, "view change to >= {}".format(min_view))
+
+
+def _replica(node):
+    return getattr(node, "replica", node)
+
+
+def _last_seq(node) -> int:
+    return _replica(node).last_ordered[1]

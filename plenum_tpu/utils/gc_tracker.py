@@ -111,8 +111,11 @@ def process_memory_info() -> dict:
                         int(val.strip().split()[0])
     except OSError:
         pass
-    if "rss_kb" not in out:
+    if "rss_kb" not in out or "peak_rss_kb" not in out:
+        # sandboxed /proc (e.g. gVisor) may expose VmRSS without VmHWM
         import resource
-        ru = resource.getrusage(resource.RUSAGE_SELF)
-        out["peak_rss_kb"] = ru.ru_maxrss  # KiB on Linux
+        ru = resource.getrusage(resource.RUSAGE_SELF)  # KiB on Linux
+        out.setdefault("peak_rss_kb", max(ru.ru_maxrss,
+                                          out.get("rss_kb", 0)))
+        out.setdefault("rss_kb", ru.ru_maxrss)
     return out

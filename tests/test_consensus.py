@@ -260,7 +260,10 @@ def test_wrong_digest_preprepare_rejected(mock_timer):
 
 # ----------------------------------------------------- randomized (seeded)
 
-@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606, 707])
+@pytest.mark.parametrize("seed", [
+    101, 202, 303, 404, 505, 606, 707, 808, 909, 1010,
+    11, 23, 37, 41, 53, 67, 79, 83, 97, 113,
+    1234, 2345, 3456, 4567, 5678, 6789])
 def test_ordering_with_lossy_network(seed, mock_timer):
     """With 20% random message loss the pool still converges (quorums +
     retransmission-free design tolerance: batches only need n-f)."""
