@@ -569,19 +569,24 @@ def micro_ed25519():
 
 
 def micro_merkle(n_leaves=None):
-    """BASELINE config 4: 1M-leaf merkle build + audit-path batch on the
-    device-resident tree (ops/merkle.py: one fused jit for all levels,
-    gather kernel for proof batches) vs the hashlib (OpenSSL) scalar
-    floor on a smaller tree, normalized per leaf."""
+    """BASELINE config 4: 1M-leaf merkle build + audit-path batches on
+    the device-resident tree (ops/merkle.py: one fused jit for all
+    levels; FUSED gather+pack proof batches; lazy host mirror of the
+    top levels) vs the hashlib (OpenSSL) scalar floor. Also reported:
+    the ragged-size proof path (frontier decomposition), incremental
+    device append throughput (the ordered-batch shape), and the
+    ProofPipeline chunked double-buffered serving rate."""
+    import numpy as _np
     from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
     from plenum_tpu.ledger.hash_store import MemoryHashStore
+    from plenum_tpu.ledger.merkle_verifier import MerkleVerifier
     from plenum_tpu.ledger.tree_hasher import TreeHasher
-    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    from plenum_tpu.ops.merkle import DeviceMerkleTree, ProofPipeline
 
     n_leaves = n_leaves or int(os.environ.get("BENCH_MERKLE_LEAVES",
                                               str(1 << 20)))
-    # batched audit paths need a power-of-two tree: round down
-    n_leaves = max(2, 1 << (n_leaves.bit_length() - 1))
+    # the dense audit-path config uses a power-of-two tree: round down
+    n_leaves = max(4, 1 << (n_leaves.bit_length() - 1))
     leaves = [b"txn-%020d" % i for i in range(n_leaves)]
     dev = DeviceMerkleTree()
     root = dev.build(leaves)  # compile + warm
@@ -589,26 +594,34 @@ def micro_merkle(n_leaves=None):
     device_leaves_per_s = n_leaves / t_b
     device_leaves_per_s_median = n_leaves / t_m
 
-    # audit-path batch: device gathers for the big bottom levels, the
-    # host-cached top levels joined by vectorized numpy (the tunnel is
-    # ~20 MB/s — the top-level cache cuts per-batch bytes ~3x). The
-    # PIPELINED number is the serving shape: a node answering a stream
-    # of proof batches overlaps each download with the next gather.
+    # audit-path batch: device gathers the big bottom levels FUSED with
+    # big-endian packing (one dense uint8 download, no host byteswap);
+    # the lazily host-mirrored top levels join by vectorized numpy (the
+    # tunnel is ~20 MB/s — the mirror keeps per-batch bytes to the
+    # bottom levels only). The PIPELINED number is the serving shape: a
+    # node answering a stream of proof batches overlaps each download
+    # with the next gather (ProofPipeline, chunked).
     n_proofs = min(10000, n_leaves)
     idx = list(range(0, n_leaves, max(1, n_leaves // n_proofs)))[:n_proofs]
-    paths = dev.audit_path_batch(idx[:4])  # compile gather + list API
+    paths = dev.audit_path_batch(idx[:4])  # compile + fill lazy mirror
     assert dev.verify_path(leaves[idx[0]], idx[0], paths[0], root)
     dev.audit_path_batch_array(idx)        # warm the full batch shape
     t_b, t_m = best_median_time(lambda: dev.audit_path_batch_array(idx))
     proof_rate, proof_rate_median = len(idx) / t_b, len(idx) / t_m
 
+    pipe_depth = int(os.environ.get("BENCH_MERKLE_PIPE_DEPTH", "3"))
+    pipe_chunk = int(os.environ.get("BENCH_MERKLE_CHUNK",
+                                    str(max(1, len(idx) // 4))))
+    chunks = [idx[i:i + pipe_chunk]
+              for i in range(0, len(idx), pipe_chunk)]
+    pipe = ProofPipeline(dev, depth=pipe_depth, dense=True)
+    stream_batches = [c for _ in range(4) for c in chunks]
+    for _ in pipe.stream(stream_batches):
+        pass  # warm every chunk shape
+
     def pipelined_round():
-        h = dev.dispatch_path_batch(idx)
-        for _ in range(3):
-            nxt = dev.dispatch_path_batch(idx)
-            dev.collect_path_batch(h)
-            h = nxt
-        dev.collect_path_batch(h)
+        for _ in pipe.stream(stream_batches):
+            pass
     t_b, t_m = best_median_time(pipelined_round)
     proof_rate_pipelined = 4 * len(idx) / t_b
     proof_rate_pipelined_median = 4 * len(idx) / t_m
@@ -629,10 +642,91 @@ def micro_merkle(n_leaves=None):
     for i in idx:
         floor_tree.inclusion_proof(i, n_leaves)
     proof_floor_per_s = len(idx) / (time.perf_counter() - t0)
-    return (n_leaves, device_leaves_per_s, device_leaves_per_s_median,
-            proof_rate, proof_rate_median, proof_rate_pipelined,
-            proof_rate_pipelined_median, floor_leaves_per_s,
-            proof_floor_per_s)
+
+    # ---- ragged-size proof batch: RFC 6962 proofs for the size-n_rag
+    # prefix tree served by the frontier-decomposition device path
+    # (exactly what Ledger.merkleInfoBatch routes through), verified
+    # against MerkleVerifier; floor = the host memoized batch walk.
+    n_rag = max(3, n_leaves - 123)
+    rag_idx = [i for i in idx if i < n_rag]
+    rag_pipe = ProofPipeline(dev, depth=pipe_depth)
+    rag_paths = rag_pipe.run(rag_idx, n=n_rag, chunk=pipe_chunk)  # warm
+    rag_root = floor_tree.merkle_tree_hash(0, n_rag)
+    verifier = MerkleVerifier(TreeHasher())
+    for j in (0, len(rag_idx) // 2, len(rag_idx) - 1):
+        assert verifier.verify_leaf_inclusion(
+            leaves[rag_idx[j]], rag_idx[j], rag_paths[j], n_rag, rag_root)
+
+    def ragged_round():
+        rag_pipe.run(rag_idx, n=n_rag, chunk=pipe_chunk)
+    t_b, t_m = best_median_time(ragged_round)
+    ragged_rate, ragged_rate_median = len(rag_idx) / t_b, len(rag_idx) / t_m
+
+    t0 = time.perf_counter()
+    floor_tree.inclusion_proofs_batch(rag_idx, n_rag)
+    ragged_floor_per_s = len(rag_idx) / (time.perf_counter() - t0)
+
+    # ---- incremental device append: b leaves onto an n_leaves tree in
+    # ~2b device hashes (one small dispatch per level) — the ordered-
+    # 3PC-batch shape — vs the host level-wise bulk extend and the
+    # scalar frontier-merge floor.
+    app_b = int(os.environ.get("BENCH_MERKLE_APPEND_B", "8192"))
+    rng = _np.random.RandomState(42)
+    base = rng.randint(0, 256, size=(n_leaves, 32)).astype(_np.uint8)
+    inc = DeviceMerkleTree()
+    inc.build_from_leaf_hashes(base)
+    app = rng.randint(0, 256, size=(app_b, 32)).astype(_np.uint8)
+    inc.append_leaf_hashes(app)
+    inc.root_hash  # warm (forces the level dispatch chain + root read)
+
+    def append_round():
+        inc.append_leaf_hashes(app)
+        return inc.root_hash
+    t_b, t_m = best_median_time(append_round)
+    append_rate, append_rate_median = app_b / t_b, app_b / t_m
+
+    app_hashes = [app[i].tobytes() for i in range(app_b)]
+    shadow = floor_tree.copy_shadow()
+    t0 = time.perf_counter()
+    for h in app_hashes:
+        shadow._append_hash(h, want_path=False)
+    append_scalar_per_s = app_b / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    floor_tree.extend_hashes(app_hashes)  # level-wise host bulk extend
+    append_bulk_host_per_s = app_b / (time.perf_counter() - t0)
+
+    return {
+        "leaves": n_leaves,
+        "build_leaves_per_s": round(device_leaves_per_s, 1),
+        "build_leaves_per_s_median": round(device_leaves_per_s_median, 1),
+        "audit_paths_per_s": round(proof_rate, 1),
+        "audit_paths_per_s_median": round(proof_rate_median, 1),
+        "audit_paths_pipelined_per_s": round(proof_rate_pipelined, 1),
+        "audit_paths_pipelined_per_s_median": round(
+            proof_rate_pipelined_median, 1),
+        "pipeline": {"depth": pipe_depth, "chunk": pipe_chunk},
+        "audit_paths_cpu_floor_per_s": round(proof_floor_per_s, 1),
+        "vs_cpu_audit_paths": round(
+            proof_rate_pipelined / proof_floor_per_s, 2),
+        "vs_cpu_audit_paths_single_shot": round(
+            proof_rate / proof_floor_per_s, 2),
+        "hashlib_floor_leaves_per_s": round(floor_leaves_per_s, 1),
+        "vs_hashlib": round(device_leaves_per_s / floor_leaves_per_s, 2),
+        "ragged": {
+            "leaves": n_rag,
+            "paths_per_s": round(ragged_rate, 1),
+            "paths_per_s_median": round(ragged_rate_median, 1),
+            "host_memo_floor_per_s": round(ragged_floor_per_s, 1),
+            "vs_host_memo": round(ragged_rate / ragged_floor_per_s, 2),
+        },
+        "incremental_append": {
+            "batch": app_b,
+            "device_leaves_per_s": round(append_rate, 1),
+            "device_leaves_per_s_median": round(append_rate_median, 1),
+            "host_bulk_leaves_per_s": round(append_bulk_host_per_s, 1),
+            "host_scalar_leaves_per_s": round(append_scalar_per_s, 1),
+        },
+    }
 
 
 def pool25_backlog(provider=None):
@@ -909,8 +1003,7 @@ def main():
 
     (device_rate, device_rate_median, ed_single_shot, ed_single_shot_med,
      openssl_rate, python_rate, ed_sweep) = micro_ed25519()
-    (mk_n, mk_rate, mk_rate_med, mk_proofs, mk_proofs_med, mk_proofs_pipe,
-     mk_proofs_pipe_med, mk_floor, mk_proof_floor) = micro_merkle()
+    mk = micro_merkle()
     bls_results = micro_bls()
     p25 = pool25_both()
 
@@ -951,23 +1044,7 @@ def main():
                 "pure_python": round(python_rate, 1),
             },
             "vs_openssl_core": round(device_rate / openssl_rate, 2),
-            "merkle": {
-                "leaves": mk_n,
-                "build_leaves_per_s": round(mk_rate, 1),
-                "build_leaves_per_s_median": round(mk_rate_med, 1),
-                "audit_paths_per_s": round(mk_proofs, 1),
-                "audit_paths_per_s_median": round(mk_proofs_med, 1),
-                "audit_paths_pipelined_per_s": round(mk_proofs_pipe, 1),
-                "audit_paths_pipelined_per_s_median": round(
-                    mk_proofs_pipe_med, 1),
-                "audit_paths_cpu_floor_per_s": round(mk_proof_floor, 1),
-                "vs_cpu_audit_paths": round(
-                    mk_proofs_pipe / mk_proof_floor, 2),
-                "vs_cpu_audit_paths_single_shot": round(
-                    mk_proofs / mk_proof_floor, 2),
-                "hashlib_floor_leaves_per_s": round(mk_floor, 1),
-                "vs_hashlib": round(mk_rate / mk_floor, 2),
-            },
+            "merkle": mk,
             "bls": bls_results,
             "pool25_backlog": p25,
         },
@@ -983,7 +1060,8 @@ def main():
             "cpu_floor": round(mp_cpu_rate, 1),
             "sim_pool_tpu": round(tpu_rate, 1),
             "ed25519_per_chip": round(device_rate, 1),
-            "merkle_paths_pipelined": round(mk_proofs_pipe, 1),
+            "merkle_paths_pipelined": mk["audit_paths_pipelined_per_s"],
+            "merkle_vs_cpu_audit_paths": mk["vs_cpu_audit_paths"],
             "bls_n100_aggregate": (bls_results.get("by_n", {})
                                    .get("100", {})
                                    .get("aggregate_per_s")),

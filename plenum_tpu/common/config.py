@@ -54,8 +54,21 @@ class Config:
     SHA256_BACKEND = "jax"       # "jax" (batched device kernel) | "scalar"
     SHA256_BATCH_THRESHOLD = 512  # below this, hashlib wins on latency
 
+    # ---- device merkle proof engine (ops/merkle.py + ledger routing):
+    # large reply-proof / catchup-proof batches are served from the
+    # device-resident tree; small batches keep the host memo path
+    MERKLE_DEVICE_PROOFS = True
+    MERKLE_DEVICE_PROOF_MIN = 2048   # below this the host memo path wins
+    MERKLE_DEVICE_PROOF_CHUNK = 4096  # pipelined sub-batch size
+    MERKLE_DEVICE_PIPELINE_DEPTH = 2  # gathers kept in flight
+
     # ---- catchup
     CATCHUP_BATCH_SIZE = 5
+    CATCHUP_REP_CHUNK = 1000      # txns per CatchupRep message
+    # attach per-txn audit paths to CatchupReps (lets leechers reject a
+    # lying chunk at rep time; costs ~2-3x rep wire size — integrity is
+    # still guaranteed by the whole-range root replay when off)
+    CATCHUP_REP_AUDIT_PATHS = True
     CATCHUP_TXN_TIMEOUT = 6
     CatchupTransactionsTimeout = 6
     MAX_CATCHUP_RETRY = 3

@@ -292,6 +292,13 @@ class CatchupRep(MessageBase):
         ("ledgerId", LedgerIdField()),
         ("txns", MapField(StringifiedNonNegativeNumberField(), AnyMapField())),
         ("consProof", IterableField(NonEmptyStringField())),
+        # optional per-txn RFC 6962 audit paths (seqNo → b58 sibling
+        # hashes) against the leecher's agreed (target_size, target_root)
+        # — lets a leecher reject a lying chunk at rep time instead of
+        # after buffering the whole range; absent from legacy reps
+        ("auditPaths", MapField(StringifiedNonNegativeNumberField(),
+                                IterableField(NonEmptyStringField()),
+                                optional=True, nullable=True)),
     )
 
 

@@ -7,13 +7,43 @@ full aligned subtrees are persisted by (start, height) in the HashStore, so
 the RFC 6962 proof algorithms (§2.1.1/§2.1.2) read straight from storage.
 Batched audit-path generation for catchup rides the TreeHasher TPU seam.
 """
+import logging
 from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from plenum_tpu.ledger.hash_store import HashStore, MemoryHashStore, NullHashStore
 from plenum_tpu.ledger.tree_hasher import TreeHasher, _largest_pow2_lt
 
+logger = logging.getLogger(__name__)
+
+
+def _array_to_digest_list(arr: 'np.ndarray') -> List[bytes]:
+    """[B, 32] u8 → 32-byte bytes objects via ONE flat copy (hash-store
+    writes are the only consumer that still needs bytes)."""
+    flat = np.ascontiguousarray(arr, dtype=np.uint8).tobytes()
+    return [flat[i:i + 32] for i in range(0, len(flat), 32)]
+
+
+from plenum_tpu.common.config import Config as _Config
+
 
 class CompactMerkleTree:
+    # batches at/above this go level-wise instead of scalar frontier
+    # merges (extend), and are eligible for the device engine
+    BULK_MIN = 1024
+    # proof batches below this stay on the host memo path — it WINS for
+    # small batches (BENCH_r05: per-batch device latency is the floor).
+    # Defaults come from Config so there is ONE place to tune them.
+    _device_proof_min = _Config.MERKLE_DEVICE_PROOF_MIN
+    _device_proof_chunk = _Config.MERKLE_DEVICE_PROOF_CHUNK
+    _device_pipeline_depth = _Config.MERKLE_DEVICE_PIPELINE_DEPTH
+    _device_engine = None
+    # consecutive device failures before the engine is detached (every
+    # failure already falls back to the host memo path)
+    _DEVICE_MAX_FAILURES = 3
+    _device_fail_count = 0
+
     def __init__(self, hasher: TreeHasher = None,
                  hash_store: HashStore = None):
         self.hasher = hasher or TreeHasher()
@@ -92,16 +122,36 @@ class CompactMerkleTree:
         return audit_path
 
     def extend(self, new_leaves: Sequence[bytes]):
-        """Batched append: leaf hashing goes through the TPU seam; a bulk
-        rebuild from empty additionally hashes interior nodes level-by-
-        level in batches (the 1M-leaf path: ~2n hashes in ~log n device
-        dispatches instead of n scalar frontier merges)."""
-        leaf_hashes = self.hasher.hash_leaves(list(new_leaves))
-        if self._size == 0 and len(leaf_hashes) >= 1024:
-            self._bulk_build(leaf_hashes)
+        """Batched append: leaf hashing goes through the TPU seam;
+        large batches additionally hash interior nodes level-by-level in
+        batches — from empty (_bulk_build) OR onto an existing tree
+        (_bulk_extend): ~2n hashes in ~log n seam dispatches instead of
+        n scalar frontier merges."""
+        self.extend_hashes(self.hasher.hash_leaves(list(new_leaves)))
+
+    def extend_hashes(self, leaf_hashes: List[bytes]):
+        """Append precomputed RFC 6962 leaf digests (same routing as
+        extend, for callers that already hold the hashes)."""
+        if len(leaf_hashes) >= self.BULK_MIN:
+            if self._size == 0:
+                self._bulk_build(leaf_hashes)
+                if self._device_engine is not None \
+                        and self._device_engine.tree_size == 0:
+                    # keep the engine warm through the big growth event
+                    # (recovery/catchup) — one fused dispatch now, so a
+                    # later proof batch only syncs the scalar delta
+                    try:
+                        self._device_engine.build_from_leaf_hashes(
+                            leaf_hashes)
+                    except Exception:
+                        logger.warning("device engine bulk warm-up "
+                                       "failed; it will retry lazily",
+                                       exc_info=True)
+            else:
+                self._bulk_extend(leaf_hashes)
             return
         for leaf_hash in leaf_hashes:
-            self._append_hash(leaf_hash)
+            self._append_hash(leaf_hash, want_path=False)
 
     def _bulk_build(self, leaf_hashes: List[bytes]):
         """Construct the whole tree from scratch with level-wise batched
@@ -124,14 +174,172 @@ class CompactMerkleTree:
                 start = (len(level) - 1) << height
                 frontier_rev.append((start, height, level[-1]))
                 level = level[:-1]
-            pairs = [(level[i], level[i + 1])
-                     for i in range(0, len(level), 2)]
-            level = self.hasher.hash_node_pairs(pairs)
+            level = self._hash_level_pairs(level)
             height += 1
             for i, h in enumerate(level):
                 self.hash_store.write_subtree(i << height, height, h)
         self._frontier = [entry for entry in reversed(frontier_rev)]
         self._size = len(leaf_hashes)
+
+    def _hash_level_pairs(self, children: List[bytes]) -> List[bytes]:
+        """Pair-hash one level: children[2i], children[2i+1] → parent i.
+        Large levels go through the ARRAY seam — one flat join + one
+        dispatch, skipping the ~n per-pair tuple/message objects the
+        list seam would build (the digests here are immediately
+        re-consumed by the next level and the hash store)."""
+        m = len(children) // 2
+        hasher = self.hasher
+        if m >= getattr(hasher, "_batch_threshold", 1 << 62) \
+                and hasattr(hasher, "hash_node_pairs_array"):
+            arr = np.frombuffer(b"".join(children[:2 * m]),
+                                dtype=np.uint8).reshape(m, 64)
+            return _array_to_digest_list(hasher.hash_node_pairs_array(arr))
+        return hasher.hash_node_pairs(
+            [(children[i], children[i + 1]) for i in range(0, 2 * m, 2)])
+
+    def _bulk_extend(self, leaf_hashes: List[bytes]):
+        """Level-wise batched append onto a NON-empty tree: the same
+        ~2n node hashes the scalar frontier merges would compute, one
+        seam dispatch per level (or the attached device engine's
+        incremental append), with identical hash-store contents and
+        frontier. At height h the only pre-existing child a new parent
+        can need is the old frontier entry at h (the odd tail node)."""
+        old_n = self._size
+        new_n = old_n + len(leaf_hashes)
+        write_leaf = self.hash_store.write_leaf
+        for i, h in enumerate(leaf_hashes):
+            write_leaf(old_n + i, h)
+        write_subtree = self.hash_store.write_subtree
+        fr = {height: value for _, height, value in self._frontier}
+        new_levels = {0: leaf_hashes}
+        eng = self._device_engine
+        if eng is not None and eng.tree_size == old_n:
+            # device-resident incremental append: ~2b device hashes,
+            # one small dispatch per level; new complete nodes come
+            # back as arrays and are persisted at the identical
+            # (start, height) keys
+            nodes = eng.append_leaf_hashes(
+                np.frombuffer(b"".join(leaf_hashes), dtype=np.uint8)
+                .reshape(-1, 32), return_nodes=True)
+            for height, pos, rows in nodes:
+                if height == 0:
+                    continue  # leaves were written above
+                vals = _array_to_digest_list(rows)
+                for i, v in enumerate(vals):
+                    write_subtree((pos + i) << height, height, v)
+                new_levels[height] = vals
+        else:
+            level_vals = leaf_hashes
+            h = 0
+            while True:
+                o1, c1 = old_n >> (h + 1), new_n >> (h + 1)
+                if c1 == o1:
+                    break
+                children = ([fr[h]] if (old_n >> h) & 1 else []) \
+                    + level_vals
+                parents = self._hash_level_pairs(children[:2 * (c1 - o1)])
+                for i, ph in enumerate(parents):
+                    write_subtree((o1 + i) << (h + 1), h + 1, ph)
+                new_levels[h + 1] = parents
+                level_vals = parents
+                h += 1
+        frontier = []
+        for height in range(new_n.bit_length() - 1, -1, -1):
+            if not (new_n >> height) & 1:
+                continue
+            idx = (new_n >> height) - 1
+            if idx < (old_n >> height):
+                value = fr[height]
+            else:
+                value = new_levels[height][idx - (old_n >> height)]
+            frontier.append((idx << height, height, value))
+        self._frontier = frontier
+        self._size = new_n
+
+    # ------------------------------------------- device proof engine
+
+    def attach_device_engine(self, engine=None, proof_min: int = None,
+                             chunk: int = None, pipeline_depth: int = None,
+                             warm: bool = False):
+        """Route large inclusion-proof batches and large extends
+        through a device-resident tree (ops/merkle.DeviceMerkleTree).
+        Batches below `proof_min` keep the host memo path — it wins
+        below the routing threshold (BENCH_r05); the engine lazily
+        catches up from the hash store, so scalar appends stay O(1).
+        warm=True syncs a non-empty tree now, keeping the one-time
+        build (+ jit compile) off the first serving call."""
+        if engine is None:
+            from plenum_tpu.ops.merkle import DeviceMerkleTree
+            engine = DeviceMerkleTree(self.hasher)
+        self._device_engine = engine
+        if proof_min is not None:
+            self._device_proof_min = proof_min
+        if chunk is not None:
+            self._device_proof_chunk = chunk
+        if pipeline_depth is not None:
+            self._device_pipeline_depth = pipeline_depth
+        if warm and self._size and not isinstance(self.hash_store,
+                                                  NullHashStore):
+            try:
+                self._device_sync()
+            except Exception:
+                logger.warning("device engine warm-up failed; it will "
+                               "retry lazily", exc_info=True)
+        return engine
+
+    def _device_sync(self) -> bool:
+        """Catch the attached engine up to the committed tree by
+        incrementally appending the missing leaf digests from the hash
+        store — complete RFC 6962 nodes are immutable, so catch-up
+        after b scalar appends costs ~2b device hashes, never a
+        rebuild. Bulk builds/extends advance the engine inline, so the
+        delta here is normally just the last few scalar appends."""
+        eng = self._device_engine
+        if eng.tree_size > self._size:
+            eng.reset()  # the host tree was reset/reloaded under us
+        if eng.tree_size < self._size:
+            missing = self.hash_store.read_leaves(eng.tree_size,
+                                                  self._size)
+            if eng.tree_size == 0:
+                eng.build_from_leaf_hashes(missing)
+            else:
+                eng.append_leaf_hashes(missing)
+        return eng.tree_size == self._size
+
+    def _device_proofs_batch(self, ms, n: int) -> Optional[List[List[bytes]]]:
+        """Serve a large proof batch from the device engine, or None to
+        fall back to the host memo path."""
+        if (self._device_engine is None
+                or len(ms) < self._device_proof_min
+                or isinstance(self.hash_store, NullHashStore)
+                or self.hash_store.leaf_count < self._size):
+            return None
+        try:
+            if not self._device_sync():
+                return None
+            from plenum_tpu.ops.merkle import ProofPipeline
+            pipe = ProofPipeline(self._device_engine,
+                                 depth=self._device_pipeline_depth)
+            out = pipe.run(ms, n=n, chunk=self._device_proof_chunk)
+            self._device_fail_count = 0
+            return out
+        except Exception:
+            # circuit breaker: one full-traceback warning, then quiet
+            # retries, then detach — a persistently sick device must
+            # not tax (or log-spam) every serving-path batch
+            self._device_fail_count += 1
+            if self._device_fail_count >= self._DEVICE_MAX_FAILURES:
+                logger.warning("device proof engine failed %d times; "
+                               "detaching it (host memo path serves "
+                               "from now on)", self._device_fail_count)
+                self._device_engine = None
+            elif self._device_fail_count == 1:
+                logger.warning("device proof batch failed; serving from "
+                               "the host memo path", exc_info=True)
+            else:
+                logger.debug("device proof batch failed again (%d)",
+                             self._device_fail_count, exc_info=True)
+            return None
 
     def __copy__(self):
         other = CompactMerkleTree(self.hasher, NullHashStore())
@@ -199,6 +407,9 @@ class CompactMerkleTree:
         if not (0 <= min(ms) and max(ms) < n <= self._size):
             raise IndexError("invalid inclusion proof batch ({}, {}) "
                              "for size {}".format(min(ms), n, self._size))
+        device = self._device_proofs_batch(ms, n)
+        if device is not None:
+            return device
         memo = {}
         hash_children = self.hasher.hash_children
         read_leaf = self.hash_store.read_leaf
@@ -289,6 +500,8 @@ class CompactMerkleTree:
         self._size = 0
         self._frontier = []
         self._root_cache = None  # size alone can't invalidate a shrink
+        if self._device_engine is not None:
+            self._device_engine.reset()
         self.hash_store.reset()
 
     def __repr__(self):

@@ -20,6 +20,13 @@ class HashStore(ABC):
     def read_leaf(self, index: int) -> bytes:
         ...
 
+    def read_leaves(self, start: int, end: int) -> list:
+        """Leaf hashes for indices [start, end) — bulk variant for the
+        device-engine catch-up path; stores with cheap range access
+        override the per-leaf loop."""
+        read = self.read_leaf
+        return [read(i) for i in range(start, end)]
+
     @abstractmethod
     def write_subtree(self, start: int, height: int, node_hash: bytes) -> None:
         ...
@@ -59,6 +66,9 @@ class MemoryHashStore(HashStore):
 
     def read_leaf(self, index):
         return self._leaves[index]
+
+    def read_leaves(self, start, end):
+        return self._leaves[start:end]
 
     def write_subtree(self, start, height, node_hash):
         self._nodes[(start, height)] = node_hash

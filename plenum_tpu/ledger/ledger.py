@@ -127,10 +127,13 @@ class Ledger:
         shadow_append = self.uncommittedTree._append_hash
         blob_append = self._uncommitted_blobs.append
         serialize = self.serialize_for_tree
-        hash_leaf = self.hasher.hash_leaf
-        for txn in txns:
-            serialized = serialize(txn)
-            leaf_hash = hash_leaf(serialized)
+        # ONE seam dispatch hashes the whole staged batch (device-backed
+        # above the TreeHasher threshold); the scalar fallback below it
+        # is unchanged — the shadow frontier merge itself is O(b log n)
+        # cheap host work either way
+        serialized_all = [serialize(txn) for txn in txns]
+        leaf_hashes = self.hasher.hash_leaves(serialized_all)
+        for serialized, leaf_hash in zip(serialized_all, leaf_hashes):
             shadow_append(leaf_hash, want_path=False)
             blob_append((serialized, leaf_hash))
         self.uncommittedTxns.extend(txns)

@@ -9,6 +9,8 @@ threshold, with the C-backed hashlib loop as the scalar floor.
 import hashlib
 from typing import List, Sequence, Tuple
 
+import numpy as np
+
 
 class TreeHasher:
     def __init__(self, hashfunc=hashlib.sha256, batch_backend=None,
@@ -41,6 +43,26 @@ class TreeHasher:
                 and len(pairs) >= self._batch_threshold):
             return self._batch_backend.node_hashes(pairs)
         return [self.hash_children(l, r) for l, r in pairs]
+
+    def hash_node_pairs_array(self, pairs: 'np.ndarray') -> 'np.ndarray':
+        """[m, 64] u8 rows (left||right digest bytes) → [m, 32] u8 node
+        digests: the array sibling of hash_node_pairs for level-wise
+        bulk paths whose output is immediately re-paired — skips the
+        per-pair message objects and the per-digest bytes objects."""
+        pairs = np.ascontiguousarray(pairs, dtype=np.uint8).reshape(-1, 64)
+        m = pairs.shape[0]
+        if (self._batch_backend is not None
+                and m >= self._batch_threshold
+                and hasattr(self._batch_backend, "node_hashes_array")):
+            return self._batch_backend.node_hashes_array(pairs)
+        out = np.empty((m, 32), dtype=np.uint8)
+        hashfunc = self.hashfunc
+        flat = pairs.tobytes()
+        for i in range(m):
+            out[i] = np.frombuffer(
+                hashfunc(b"\x01" + flat[i * 64:(i + 1) * 64]).digest(),
+                dtype=np.uint8)
+        return out
 
     # ---- whole-tree hashing (used by verifier and tests) ----
 

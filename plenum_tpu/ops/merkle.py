@@ -1,29 +1,42 @@
-"""Device-resident merkle tree — the TPU-native bulk path.
+"""Device-resident merkle tree — the TPU-native proof/build engine.
 
 The reference hashes merkle nodes one at a time through OpenSSL
 (ledger/tree_hasher.py:7). The host-side CompactMerkleTree batches leaf
-hashing through ops/sha256, but a large build is transfer-bound: every
-level would round-trip host↔device. This module instead keeps the WHOLE
-tree on device:
+hashing through ops/sha256, but proofs and rebuilds were host work. This
+module keeps the WHOLE tree on device and serves production shapes:
 
  - `build` runs ONE fused jit: leaf SHA-256, then every interior level
    derived on device (node blocks are packed from digest pairs with pure
-   uint32 shifts — no host byte juggling), returning a tuple of
-   device-resident level arrays. Only the root/frontier (a few hashes)
-   ever leave the device.
- - `audit_path_batch` is a gather kernel: sibling indices are
-   (m >> h) ^ 1 per level, so a k-proof batch is k·depth gathers and ONE
-   small download — the BASELINE "1M-leaf audit-path batch" config.
+   uint32 shifts — no host byte juggling).
+ - `append_leaf_hashes` is the incremental path: device-resident level
+   tails grow by ~2b hashes for b appended leaves (one small dispatch
+   per level) instead of a full rebuild — complete RFC 6962 nodes are
+   immutable, so an append only ever writes NEW rows.
+ - `dispatch_proof_batch`/`collect_proof_batch` serve RFC 6962
+   inclusion proofs for ANY tree size (ragged included): an inclusion
+   proof decomposes into the leaf's path inside its full aligned
+   frontier subtree (a plain sibling gather, heights 0..h_j-1) plus one
+   fold of the frontier subtrees to its right and the roots of those to
+   its left — all O(log n) host joins shared across the batch.
+ - the sibling gather is FUSED with big-endian byte packing in one jit,
+   so a proof batch leaves the device as a single dense uint8 buffer —
+   the ~19 MB/s D2H tunnel plus a host-side byteswap was the measured
+   bottleneck (BENCH_r05: 0.66x the host proof floor).
+ - `ProofPipeline` double-buffers dispatch/collect across batches so
+   the next gather overlaps the current download.
 
-Power-of-two sizes are computed exactly; other sizes are padded to the
-next power of two and only full aligned subtrees inside the real range
-are ever read (pad garbage mixes strictly to the right of them), with
-the true root folded from the frontier on host (log n scalar hashes).
+Top levels (few nodes, shared by every proof) are mirrored to host
+LAZILY — first proof batch after a build/growth pays one download; the
+mirror then grows incrementally with each append, so per-batch device
+traffic carries only the huge bottom levels.
 """
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence
+import logging
+import os
+from collections import deque
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -31,7 +44,32 @@ import jax
 import jax.numpy as jnp
 
 from plenum_tpu.ops.sha256 import (
-    _sha256_blocks, digests_to_bytes, pad_messages)
+    _sha256_blocks, digests_to_array, pad_messages)
+
+logger = logging.getLogger(__name__)
+
+_async_copy_noted = False
+
+
+def _start_async_copy(arr):
+    """Begin the D2H copy for `arr` so a later np.asarray doesn't block.
+    Narrow except: only the backend's not-supported signals are
+    swallowed (logged once at debug); anything else is a real error."""
+    global _async_copy_noted
+    try:
+        arr.copy_to_host_async()
+    except (AttributeError, NotImplementedError) as exc:
+        if not _async_copy_noted:
+            _async_copy_noted = True
+            logger.debug("async device->host copy unavailable (%s); "
+                         "proof collects will block on transfer", exc)
+
+
+def _pow2_at_least(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
 
 
 @functools.partial(jax.jit, static_argnames=("msg_len", "nblocks"))
@@ -74,77 +112,168 @@ def _node_blocks(left, right):
     return words.reshape(words.shape[0], 2, 16)
 
 
+def _hash_pairs(cur):
+    """[2m, 8] u32 digests → [m, 8] parent digests (device)."""
+    blocks = _node_blocks(cur[0::2], cur[1::2])
+    nv = jnp.full((blocks.shape[0],), 2, dtype=jnp.int32)
+    return _sha256_blocks(blocks, nv, 2)
+
+
 @functools.partial(jax.jit, static_argnames=("nblocks", "depth"))
 def _build_levels(leaf_words, leaf_nvalid, nblocks: int, depth: int):
-    """leaf_words [P, nblocks, 16] → tuple of P/2, P/4, … 1 digest
+    """leaf_words [P, nblocks, 16] → tuple of P, P/2, … 1 digest
     arrays ([*, 8] u32), all resident on device."""
     cur = _sha256_blocks(leaf_words, leaf_nvalid, nblocks)
     levels = [cur]
-    two = jnp.full((1,), 2, dtype=jnp.int32)
     for _ in range(depth):
-        blocks = _node_blocks(cur[0::2], cur[1::2])
-        nv = jnp.broadcast_to(two, (blocks.shape[0],))
-        cur = _sha256_blocks(blocks, nv, 2)
+        cur = _hash_pairs(cur)
+        levels.append(cur)
+    return tuple(levels)
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _build_levels_from_digest_bytes(arr_u8, depth: int):
+    """[P, 32] u8 big-endian leaf DIGESTS → device level tuple (no leaf
+    hashing — the resync path feeds hash-store contents straight in)."""
+    w = arr_u8.reshape(arr_u8.shape[0], 8, 4).astype(jnp.uint32)
+    cur = (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) \
+        | w[..., 3]
+    levels = [cur]
+    for _ in range(depth):
+        cur = _hash_pairs(cur)
         levels.append(cur)
     return tuple(levels)
 
 
 @jax.jit
-def _gather_paths(levels, indices):
-    """Sibling digests for each index at each level: [k, depth, 8]."""
+def _digest_words(arr_u8):
+    """[B, 32] u8 big-endian digest bytes → [B, 8] u32 words."""
+    w = arr_u8.reshape(arr_u8.shape[0], 8, 4).astype(jnp.uint32)
+    return (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) \
+        | w[..., 3]
+
+
+@jax.jit
+def _place(level, vals, start, count):
+    """Scatter vals[0:count] into level[start:start+count]; rows past
+    `count` are dropped (vals is bucket-padded to bound recompiles)."""
+    ar = jnp.arange(vals.shape[0], dtype=jnp.int32)
+    idx = jnp.where(ar < count, start + ar, level.shape[0])
+    return level.at[idx].set(vals, mode="drop")
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def _append_level_step(child, parent, p0, cnt, bucket: int):
+    """Hash parent nodes [p0, p0+cnt) from consecutive child pairs and
+    scatter them into `parent`. Gathers clamp / scatters drop the
+    bucket-padding rows, so one compile serves every append of up to
+    `bucket` new nodes at this level shape."""
+    ar = jnp.arange(bucket, dtype=jnp.int32)
+    pi = p0 + ar
+    dig = _sha256_blocks(
+        _node_blocks(child[2 * pi], child[2 * pi + 1]),
+        jnp.full((bucket,), 2, dtype=jnp.int32), 2)
+    idx = jnp.where(ar < cnt, pi, parent.shape[0])
+    return parent.at[idx].set(dig, mode="drop"), dig
+
+
+@functools.partial(jax.jit, static_argnames=("rows",))
+def _grown(old, rows: int):
+    pad = jnp.zeros((rows - old.shape[0], 8), dtype=jnp.uint32)
+    return jnp.concatenate([old, pad], axis=0)
+
+
+@jax.jit
+def _gather_pack(levels, indices):
+    """FUSED sibling-gather + big-endian byte packing: for each level h
+    in the tuple, gather digests at (m >> h) ^ 1 and emit ONE dense
+    uint8 buffer [k, len(levels)*32] — the proof batch leaves the
+    device already in wire byte order, so collect is a plain reshape
+    instead of a host-side astype('>u4') byteswap over megabytes."""
     cols = []
-    for h, level in enumerate(levels[:-1]):
+    for h, level in enumerate(levels):
         sib = (indices >> h) ^ 1
         cols.append(level[sib])
-    return jnp.stack(cols, axis=1)
+    g = jnp.stack(cols, axis=1)  # [k, n_low, 8] u32
+    b = jnp.stack([(g >> 24) & 0xff, (g >> 16) & 0xff,
+                   (g >> 8) & 0xff, g & 0xff], axis=-1)
+    return b.astype(jnp.uint8).reshape(g.shape[0], len(levels) * 32)
 
 
-@functools.partial(jax.jit, static_argnames=("n_low",))
-def _gather_low_paths(levels, indices, n_low: int):
-    """Sibling digests for the n_low BOTTOM levels only: [k, n_low, 8].
-    The top levels have fewer nodes than proofs in a batch, so their
-    digests are downloaded once per build and joined host-side — the
-    device->host tunnel is the bottleneck (~20 MB/s measured), and this
-    cuts the per-batch download ~3x for 10k-proof batches."""
-    cols = []
-    for h in range(n_low):
-        sib = (indices >> h) ^ 1
-        cols.append(levels[h][sib])
-    return jnp.stack(cols, axis=1)
+@jax.jit
+def _read_row(level, idx):
+    return jax.lax.dynamic_slice(level, (idx, 0), (1, 8))
 
 
 class DeviceMerkleTree:
-    """An RFC 6962 tree whose node hashes live in device memory."""
+    """An RFC 6962 tree whose node hashes live in device memory.
 
-    # levels at or under this node count are mirrored to host at build
-    # time (~4 MiB total for a 1M-leaf tree — 6% of the tree) so proof
-    # batches never re-download them; only the huge bottom levels are
-    # gathered per batch. The device-to-host tunnel (~19 MB/s measured)
-    # is the extraction bottleneck, so per-batch bytes ARE the rate.
-    _TOP_CACHE = 131072
+    Supports ANY size (ragged included) for builds, incremental appends
+    and inclusion-proof batches. Complete nodes are immutable, so the
+    level arrays only ever grow; capacity doubles like a vector to
+    bound reallocation and recompiles.
+    """
+
+    # levels at or under this node count are mirrored to host (lazily,
+    # on first proof batch; then kept fresh incrementally by appends)
+    # so proof batches never re-download them; only the huge bottom
+    # levels are gathered per batch. The device-to-host tunnel
+    # (~19 MB/s measured) is the extraction bottleneck, so per-batch
+    # bytes ARE the rate.
+    _TOP_CACHE = int(os.environ.get("PLENUM_MERKLE_TOP_CACHE", "262144"))
 
     def __init__(self, hasher=None):
         from plenum_tpu.ledger.tree_hasher import TreeHasher
         self.hasher = hasher or TreeHasher()
-        self._levels = None          # tuple of device arrays, leaves first
+        self._levels: Optional[List] = None  # device arrays, leaves first
         self._size = 0
-        self._padded = 0
+        self._cap = 0
+        self._mirror = {}          # height -> host uint8 [cap>>h, 32]
+        self._mirror_count = {}    # height -> mirrored complete prefix
+        self._froot_cache = {}     # proof size n -> frontier root bytes
+
+    # ------------------------------------------------------------ state
 
     @property
     def tree_size(self) -> int:
         return self._size
 
+    @property
+    def _padded(self) -> int:
+        # kept for introspection/back-compat: capacity == padded size
+        return self._cap if self._size else 0
+
+    def reset(self):
+        self._levels, self._size, self._cap = None, 0, 0
+        self._mirror, self._mirror_count, self._froot_cache = {}, {}, {}
+
+    def _depth(self) -> int:
+        return self._cap.bit_length() - 1 if self._cap else 0
+
+    def _n_low(self) -> int:
+        """First host-mirrored height; heights below it are gathered on
+        device per proof batch."""
+        h = 0
+        while h < self._depth() and (self._cap >> h) > self._TOP_CACHE:
+            h += 1
+        return h
+
+    def _invalidate(self):
+        self._froot_cache = {}
+
+    # ----------------------------------------------------------- builds
+
     def build(self, leaves: Sequence[bytes]) -> bytes:
         """Hash `leaves` and every interior level on device; → root."""
         n = len(leaves)
         if n == 0:
-            self._levels, self._size, self._padded = None, 0, 0
+            self.reset()
             return self.hasher.hash_empty()
-        padded = 1
-        while padded < n:
-            padded *= 2
+        padded = _pow2_at_least(n)
         msgs = [b"\x00" + d for d in leaves]
         if padded > n:
+            # pad garbage only ever mixes into INCOMPLETE nodes, which
+            # no read path touches
             msgs = msgs + [msgs[-1]] * (padded - n)
         depth = padded.bit_length() - 1
         ln0 = len(msgs[0])
@@ -162,119 +291,313 @@ class DeviceMerkleTree:
             host_words, host_nvalid, nblocks = pad_messages(msgs)
             words = jnp.asarray(host_words)
             nvalid = jnp.asarray(host_nvalid)
-        self._levels = _build_levels(words, nvalid, nblocks, depth)
-        self._size, self._padded = n, padded
-        # host cache of every level small enough that a proof batch
-        # would re-download it anyway (<= _TOP_CACHE nodes): one small
-        # transfer now, then per-batch downloads carry only the big
-        # bottom levels
-        self._top_cache = {}
-        for h, level in enumerate(self._levels):
-            if level.shape[0] <= self._TOP_CACHE:
-                self._top_cache[h] = np.asarray(level).astype(">u4", order="C") \
-                    .view(np.uint8).reshape(level.shape[0], 32)
+        self._levels = list(_build_levels(words, nvalid, nblocks, depth))
+        self._size, self._cap = n, padded
+        self._mirror, self._mirror_count, self._froot_cache = {}, {}, {}
         return self.root_hash
+
+    def build_from_leaf_hashes(self, digests) -> bytes:
+        """Build the device levels from precomputed RFC 6962 LEAF
+        DIGESTS (list of 32-byte bytes or uint8 [n, 32]) — the resync
+        path from a hash store: no leaf hashing, one fused dispatch."""
+        arr = self._digest_rows(digests)
+        n = arr.shape[0]
+        if n == 0:
+            self.reset()
+            return self.hasher.hash_empty()
+        padded = _pow2_at_least(n)
+        if padded > n:
+            arr = np.concatenate(
+                [arr, np.zeros((padded - n, 32), dtype=np.uint8)])
+        depth = padded.bit_length() - 1
+        self._levels = list(
+            _build_levels_from_digest_bytes(jnp.asarray(arr), depth))
+        self._size, self._cap = n, padded
+        self._mirror, self._mirror_count, self._froot_cache = {}, {}, {}
+        return self.root_hash
+
+    @staticmethod
+    def _digest_rows(digests) -> np.ndarray:
+        if isinstance(digests, np.ndarray):
+            return np.ascontiguousarray(digests, dtype=np.uint8) \
+                .reshape(-1, 32)
+        return np.frombuffer(b"".join(digests), dtype=np.uint8) \
+            .reshape(-1, 32).copy()
+
+    # ------------------------------------------------ incremental append
+
+    def _ensure_capacity(self, n: int):
+        if self._levels is None:
+            cap = _pow2_at_least(max(n, 1))
+            self._cap = cap
+            self._levels = [jnp.zeros((cap >> h, 8), dtype=jnp.uint32)
+                            for h in range(cap.bit_length())]
+            self._mirror, self._mirror_count = {}, {}
+            return
+        if n <= self._cap:
+            return
+        new_cap = self._cap
+        while new_cap < n:
+            new_cap *= 2
+        levels = [_grown(lv, new_cap >> h)
+                  for h, lv in enumerate(self._levels)]
+        for h in range(len(levels), new_cap.bit_length()):
+            levels.append(jnp.zeros((new_cap >> h, 8), dtype=jnp.uint32))
+        self._levels, self._cap = levels, new_cap
+        # mirror shapes changed: refill lazily on next proof batch
+        self._mirror, self._mirror_count = {}, {}
+
+    def append_leaf_hashes(self, digests, return_nodes: bool = False):
+        """Append leaf DIGESTS incrementally: ~2b device hashes for b
+        leaves, one bucket-padded dispatch per level, no rebuild.
+
+        With return_nodes=True, returns [(height, first_node_index,
+        uint8 [cnt, 32])] for every newly COMPLETE node — exactly the
+        (start, height) entries a CompactMerkleTree hash store persists
+        for the same append."""
+        arr = self._digest_rows(digests)
+        b = arr.shape[0]
+        if b == 0:
+            return [] if return_nodes else None
+        n0 = self._size
+        n1 = n0 + b
+        self._ensure_capacity(n1)
+        bucket0 = _pow2_at_least(b)
+        if bucket0 > b:
+            arr_up = np.zeros((bucket0, 32), dtype=np.uint8)
+            arr_up[:b] = arr
+        else:
+            arr_up = arr
+        self._levels[0] = _place(self._levels[0],
+                                 _digest_words(jnp.asarray(arr_up)), n0, b)
+        news = [(0, n0, b, None)]  # level-0 digests are the host input
+        h = 0
+        while True:
+            p0 = n0 >> (h + 1)
+            cnt = (n1 >> (h + 1)) - p0
+            if cnt == 0:
+                break
+            self._levels[h + 1], dig = _append_level_step(
+                self._levels[h], self._levels[h + 1], p0, cnt,
+                _pow2_at_least(cnt))
+            news.append((h + 1, p0, cnt, dig))
+            h += 1
+        self._size = n1
+        self._invalidate()
+        out = []
+        for height, pos, cnt, dig in news:
+            mirrored = height in self._mirror
+            if not (return_nodes or mirrored):
+                continue
+            rows = arr[:b] if dig is None \
+                else digests_to_array(np.asarray(dig))[:cnt]
+            if mirrored and self._mirror_count.get(height, 0) == pos:
+                self._mirror[height][pos:pos + cnt] = rows
+                self._mirror_count[height] = pos + cnt
+            if return_nodes:
+                out.append((height, pos, rows))
+        return out if return_nodes else None
+
+    # ---------------------------------------------------------- mirrors
+
+    def _ensure_mirrors(self):
+        """Materialize/refresh the host mirror of every top level (node
+        count <= _TOP_CACHE). One full-level download per build/growth;
+        appends keep the mirror fresh incrementally after that."""
+        for h in range(self._n_low(), self._depth() + 1):
+            want = self._size >> h
+            if self._mirror_count.get(h, 0) < want or h not in self._mirror:
+                self._mirror[h] = digests_to_array(
+                    np.asarray(self._levels[h]))
+                self._mirror_count[h] = want
 
     # ------------------------------------------------------------- reads
 
-    def _level_entry(self, height: int, index: int) -> bytes:
-        arr = self._levels[height][index:index + 1]
-        return digests_to_bytes(np.asarray(arr))[0]
+    def _node_bytes(self, height: int, index: int) -> bytes:
+        mc = self._mirror_count.get(height, 0)
+        if index < mc:
+            return self._mirror[height][index].tobytes()
+        row = np.asarray(_read_row(self._levels[height],
+                                   jnp.int32(index)))
+        return digests_to_array(row).tobytes()
+
+    @staticmethod
+    def _frontier_of(n: int) -> List[Tuple[int, int]]:
+        """Full aligned subtrees of a size-n tree: [(height, node_idx)]
+        left to right (descending height)."""
+        return [(h, (n >> h) - 1)
+                for h in range(n.bit_length() - 1, -1, -1)
+                if (n >> h) & 1]
+
+    def _frontier_roots(self, n: int) -> List[bytes]:
+        roots = self._froot_cache.get(n)
+        if roots is None:
+            roots = [self._node_bytes(h, idx)
+                     for h, idx in self._frontier_of(n)]
+            self._froot_cache[n] = roots
+        return roots
 
     @property
     def root_hash(self) -> bytes:
         if self._size == 0:
             return self.hasher.hash_empty()
-        if self._size == self._padded:
-            return self._level_entry(len(self._levels) - 1, 0)
-        # fold the frontier: for each set bit h of n the full aligned
-        # subtree starts at n with bits ≤ h cleared — entirely inside the
-        # real range, so pad garbage never contaminates it
-        accum = None
-        n = self._size
-        for height in range(len(self._levels)):
-            if n & (1 << height):
-                start = (n >> (height + 1)) << (height + 1)
-                entry = self._level_entry(height, start >> height)
-                accum = entry if accum is None else \
-                    self.hasher.hash_children(entry, accum)
+        roots = self._frontier_roots(self._size)
+        accum = roots[-1]
+        for r in reversed(roots[:-1]):
+            accum = self.hasher.hash_children(r, accum)
         return accum
 
-    def _path_levels(self):
-        """(n_low, top_heights): bottom levels gathered on device
-        per batch, top levels joined from the host mirror."""
-        depth = len(self._levels) - 1
-        n_low = 0
-        while n_low < depth and n_low not in self._top_cache:
-            n_low += 1
-        return n_low, list(range(n_low, depth))
+    # ------------------------------------------- proofs (any tree size)
+
+    def dispatch_proof_batch(self, indices: Sequence[int],
+                             n: Optional[int] = None):
+        """Start the device gather for one RFC 6962 inclusion-proof
+        batch against the size-`n` prefix tree (default: current size).
+        Pair with collect_proof_batch; interleaving dispatch/collect
+        across batches overlaps the next gather with the current
+        download (ProofPipeline does this for you)."""
+        n = self._size if n is None else n
+        if not 0 < n <= self._size:
+            raise ValueError("invalid proof-batch size {} for tree of "
+                             "size {}".format(n, self._size))
+        idx_np = np.asarray(list(indices), dtype=np.int32)
+        if idx_np.size and not (0 <= idx_np.min()
+                                and int(idx_np.max()) < n):
+            raise ValueError("proof index out of range for size "
+                             "{}".format(n))
+        if n == 1:
+            return (idx_np, None, n, 0, [], [])
+        self._ensure_mirrors()
+        fr = self._frontier_of(n)
+        roots = self._frontier_roots(n)
+        h0 = fr[0][0]
+        g = min(self._n_low(), h0)
+        low = None
+        if g and idx_np.size:
+            low = _gather_pack(tuple(self._levels[:g]),
+                               jnp.asarray(idx_np))
+            _start_async_copy(low)
+        return (idx_np, low, n, g, fr, roots)
+
+    def collect_proof_batch(self, handle) -> List[List[bytes]]:
+        """Await a dispatch_proof_batch handle → per-leaf RFC 6962
+        audit paths (leaf-sibling first), byte-identical to
+        CompactMerkleTree.inclusion_proofs_batch."""
+        idx_np, low, n, g, fr, roots = handle
+        k = idx_np.shape[0]
+        if n == 1 or k == 0:
+            return [[] for _ in range(k)]
+        low_np = (np.asarray(low).reshape(k, g, 32)
+                  if low is not None else None)
+        r = len(fr)
+        starts = np.asarray([node_idx << h for h, node_idx in fr],
+                            dtype=np.int64)
+        js = np.searchsorted(starts, idx_np.astype(np.int64),
+                             side="right") - 1
+        # MTH of everything right of subtree j, shared across the batch
+        sfx: List[Optional[bytes]] = [None] * r
+        accum = None
+        hash_children = self.hasher.hash_children
+        for j in range(r - 1, 0, -1):
+            accum = roots[j] if accum is None \
+                else hash_children(roots[j], accum)
+            sfx[j - 1] = accum
+        h0 = fr[0][0]
+        # vectorized host joins for the mirrored middle heights
+        mirror_cols = {h: self._mirror[h][(idx_np >> h) ^ 1]
+                       for h in range(g, h0)}
+        out = []
+        for i in range(k):
+            j = int(js[i])
+            hj = fr[j][0]
+            path = []
+            for h in range(hj):
+                if h < g:
+                    path.append(low_np[i, h].tobytes())
+                else:
+                    path.append(mirror_cols[h][i].tobytes())
+            if j < r - 1:
+                path.append(sfx[j])
+            for jj in range(j - 1, -1, -1):
+                path.append(roots[jj])
+            out.append(path)
+        return out
+
+    def inclusion_proofs(self, indices: Sequence[int],
+                         n: Optional[int] = None) -> List[List[bytes]]:
+        """Audit paths for many leaves of the size-n prefix tree, served
+        from device levels — works for ANY n <= tree_size."""
+        return self.collect_proof_batch(
+            self.dispatch_proof_batch(indices, n))
+
+    # ------------------------------ dense power-of-two fast path (bench)
 
     def _check_pow2(self):
-        if self._size != self._padded:
-            raise ValueError("batched audit paths need a power-of-two "
-                             "tree (got size {})".format(self._size))
+        if self._size != self._cap:
+            raise ValueError("dense audit-path batches need a "
+                             "power-of-two tree (got size {}); use "
+                             "inclusion_proofs for ragged sizes"
+                             .format(self._size))
 
     def dispatch_path_batch(self, indices: Sequence[int]):
-        """Start the device gather for one proof batch; returns an
-        opaque handle. Pair with collect_path_batch — interleaving
-        dispatch/collect across batches overlaps the next gather with
-        the current download (the tunnel is the bottleneck)."""
+        """Dense power-of-two variant of dispatch_proof_batch: the
+        collect returns one uint8[k, depth, 32] buffer."""
         self._check_pow2()
         idx_np = np.asarray(list(indices), dtype=np.int32)
-        if len(self._levels) == 1:
+        if self._depth() == 0:
             return (idx_np, None)
-        n_low, _tops = self._path_levels()
+        self._ensure_mirrors()
+        g = min(self._n_low(), self._depth())
         low = None
-        if n_low:
-            low = _gather_low_paths(self._levels, jnp.asarray(idx_np),
-                                    n_low)
-            try:
-                low.copy_to_host_async()
-            except Exception:
-                pass
+        if g:
+            low = _gather_pack(tuple(self._levels[:g]),
+                               jnp.asarray(idx_np))
+            _start_async_copy(low)
         return (idx_np, low)
 
     def collect_path_batch(self, handle) -> np.ndarray:
         """Await a dispatch_path_batch handle -> uint8[k, depth, 32]
-        (leaf-sibling first). Top levels come from the host mirror via
-        vectorized numpy gathers — no device traffic, no per-digest
-        Python objects."""
+        (leaf-sibling first). The device half arrives already packed
+        big-endian (no host byteswap); top levels come from the host
+        mirror via vectorized numpy gathers."""
         idx_np, low = handle
-        depth = len(self._levels) - 1
+        depth = self._depth()
         k = idx_np.shape[0]
         out = np.empty((k, depth, 32), dtype=np.uint8)
-        n_low, tops = self._path_levels()
+        g = min(self._n_low(), depth)
         if low is not None:
-            out[:, :n_low] = np.asarray(low).astype(">u4", order="C") \
-                .view(np.uint8).reshape(k, n_low, 32)
-        for h in tops:
-            out[:, h] = self._top_cache[h][(idx_np >> h) ^ 1]
+            out[:, :g] = np.asarray(low).reshape(k, g, 32)
+        for h in range(g, depth):
+            out[:, h] = self._mirror[h][(idx_np >> h) ^ 1]
         return out
 
     def audit_path_batch_array(self, indices) -> np.ndarray:
         """Audit paths for many leaves -> uint8[k, depth, 32] in one
-        device gather (bottom levels) + host joins (cached top levels).
-        Exact only for power-of-two sizes — the production
-        CompactMerkleTree serves ragged sizes."""
+        device gather (bottom levels) + host joins (mirrored top
+        levels). Power-of-two sizes only (the dense shape); ragged
+        sizes go through inclusion_proofs."""
         return self.collect_path_batch(self.dispatch_path_batch(indices))
 
     def audit_path_batch(self, indices: Sequence[int]) -> List[List[bytes]]:
-        """List-of-lists variant of audit_path_batch_array (per-sibling
-        bytes objects are the compat format; the array form is ~100k
-        Python-object constructions cheaper per 10k proofs)."""
-        if len(self._levels) == 1:
-            self._check_pow2()
-            # single-leaf tree: the audit path of leaf 0 is empty
-            return [[] for _ in indices]
-        arr = self.audit_path_batch_array(indices)
-        k, depth = arr.shape[0], arr.shape[1]
-        flat = arr.reshape(k * depth, 32).tobytes()
-        mv = memoryview(flat)
-        return [[bytes(mv[(i * depth + h) * 32:(i * depth + h + 1) * 32])
-                 for h in range(depth)] for i in range(k)]
+        """List-of-lists audit paths for the CURRENT tree size — ragged
+        sizes included (RFC 6962 frontier decomposition)."""
+        if self._size == self._cap:
+            # dense fast path
+            if self._depth() == 0:
+                return [[] for _ in indices]
+            arr = self.audit_path_batch_array(indices)
+            k, depth = arr.shape[0], arr.shape[1]
+            flat = arr.reshape(k * depth, 32).tobytes()
+            mv = memoryview(flat)
+            return [[bytes(mv[(i * depth + h) * 32:
+                             (i * depth + h + 1) * 32])
+                     for h in range(depth)] for i in range(k)]
+        return self.inclusion_proofs(indices, self._size)
 
     def verify_path(self, leaf: bytes, index: int, path: List[bytes],
                     root: bytes) -> bool:
+        """Power-of-two fold check (kept for the dense bench path; use
+        MerkleVerifier for ragged sizes)."""
         h = self.hasher.hash_leaf(leaf)
         for height, sibling in enumerate(path):
             if (index >> height) & 1:
@@ -282,3 +605,51 @@ class DeviceMerkleTree:
             else:
                 h = self.hasher.hash_children(h, sibling)
         return h == root
+
+
+class ProofPipeline:
+    """Double-buffered proof-batch streamer over a DeviceMerkleTree.
+
+    Generalizes the dispatch/collect interleave into the serving shape
+    used by `Ledger.merkleInfoBatch` routing and the catchup rep
+    seeder: up to `depth` gathers stay in flight, so the device works
+    on batch i+1 while the host drains batch i's download (the D2H
+    tunnel is the bottleneck)."""
+
+    def __init__(self, tree: DeviceMerkleTree, depth: int = 2,
+                 dense: bool = False):
+        self._tree = tree
+        self._depth = max(1, depth)
+        self._dense = dense
+
+    def stream(self, batches, n: Optional[int] = None):
+        """Yield one result per index batch, in order. Results are
+        uint8[k, depth, 32] buffers in dense mode, per-leaf bytes-list
+        paths otherwise."""
+        if self._dense:
+            dispatch = self._tree.dispatch_path_batch
+            collect = self._tree.collect_path_batch
+        else:
+            dispatch = functools.partial(
+                self._tree.dispatch_proof_batch, n=n)
+            collect = self._tree.collect_proof_batch
+        pending = deque()
+        for batch in batches:
+            pending.append(dispatch(batch))
+            if len(pending) >= self._depth:
+                yield collect(pending.popleft())
+        while pending:
+            yield collect(pending.popleft())
+
+    def run(self, indices: Sequence[int], n: Optional[int] = None,
+            chunk: int = 4096) -> List[List[bytes]]:
+        """Split one large proof request into pipelined chunks and
+        return the concatenated per-leaf paths."""
+        idx = list(indices)
+        if not idx:
+            return []
+        batches = [idx[i:i + chunk] for i in range(0, len(idx), chunk)]
+        out: List[List[bytes]] = []
+        for part in self.stream(batches, n=n):
+            out.extend(part)
+        return out

@@ -130,15 +130,30 @@ def pad_messages(msgs: Sequence[bytes], nblocks: int = None
         end = need[0] * 64
         out[:, end - 8:end] = np.frombuffer(
             (ln0 * 8).to_bytes(8, "big"), dtype=np.uint8)
-    else:
-        for i, m in enumerate(msgs):
-            ln = len(m)
-            out[i, :ln] = np.frombuffer(m, dtype=np.uint8)
-            out[i, ln] = 0x80
-            bitlen = ln * 8
-            end = need[i] * 64
-            out[i, end - 8:end] = np.frombuffer(
-                bitlen.to_bytes(8, "big"), dtype=np.uint8)
+    elif msgs:
+        # mixed lengths: one flat vectorized scatter covering every
+        # block-count bucket at once — the per-message Python loop was
+        # the host bottleneck for large mixed batches. The bucket (block
+        # count) only decides where each row's 64-bit length field
+        # lands, and the row-relative scatter handles that per message.
+        lens = np.fromiter((len(m) for m in msgs), dtype=np.int64,
+                           count=len(msgs))
+        width = nblocks * 64
+        flat = out.reshape(-1)
+        starts = np.zeros(len(msgs), dtype=np.int64)
+        np.cumsum(lens[:-1], out=starts[1:])
+        joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+        rows = np.arange(len(msgs), dtype=np.int64)
+        dst = np.repeat(rows * width, lens) \
+            + (np.arange(joined.shape[0], dtype=np.int64)
+               - np.repeat(starts, lens))
+        flat[dst] = joined
+        flat[rows * width + lens] = 0x80
+        ends = np.asarray(need, dtype=np.int64) * 64
+        bits = lens * 8
+        base = rows * width + ends - 8
+        for k in range(8):
+            flat[base + k] = (bits >> (8 * (7 - k))) & 0xff
     words = out.reshape(len(msgs), nblocks, 16, 4)
     words = (words[..., 0].astype(np.uint32) << 24
              | words[..., 1].astype(np.uint32) << 16
@@ -151,6 +166,41 @@ def digests_to_bytes(dig: np.ndarray) -> List[bytes]:
     """[B, 8] u32 → list of 32-byte digests."""
     arr = np.asarray(dig).astype(">u4")
     return [arr[i].tobytes() for i in range(arr.shape[0])]
+
+
+def digests_to_array(dig: np.ndarray) -> np.ndarray:
+    """[B, 8] u32 → [B, 32] u8 big-endian digest bytes: the array
+    sibling of digests_to_bytes for callers that immediately re-consume
+    the digests (level pairing, device upload, dense proof buffers)
+    instead of needing per-digest Python bytes objects."""
+    arr = np.ascontiguousarray(np.asarray(dig).astype(">u4"))
+    return arr.view(np.uint8).reshape(-1, 32)
+
+
+@jax.jit
+def _node_words_from_digest_pairs(pairs_u8):
+    """[m, 64] u8 rows (left||right digest bytes) → [m, 2, 16] u32
+    SHA-padded words for H(0x01 || left || right), entirely on device —
+    no per-pair Python message objects on host."""
+    m = pairs_u8.shape[0]
+    out = jnp.zeros((m, 128), dtype=jnp.uint8)
+    out = out.at[:, 0].set(jnp.uint8(0x01))
+    out = out.at[:, 1:65].set(pairs_u8)
+    out = out.at[:, 65].set(jnp.uint8(0x80))
+    out = out.at[:, 120:128].set(jnp.asarray(
+        np.frombuffer((65 * 8).to_bytes(8, "big"), dtype=np.uint8)))
+    w = out.reshape(m, 2, 16, 4).astype(jnp.uint32)
+    return (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) \
+        | w[..., 3]
+
+
+def sha256_node_pairs_array(pairs: np.ndarray) -> np.ndarray:
+    """[m, 64] u8 rows of left||right digests → [m, 32] u8 node digests
+    H(0x01||l||r). Digest bytes stay in arrays end to end."""
+    pairs = np.ascontiguousarray(pairs, dtype=np.uint8).reshape(-1, 64)
+    words = _node_words_from_digest_pairs(jnp.asarray(pairs))
+    nvalid = jnp.full((pairs.shape[0],), 2, dtype=jnp.int32)
+    return digests_to_array(np.asarray(_sha256_blocks(words, nvalid, 2)))
 
 
 def sha256_many(msgs: Sequence[bytes]) -> List[bytes]:
@@ -170,6 +220,11 @@ class JaxSha256Backend:
 
     def node_hashes(self, pairs: Sequence[Tuple[bytes, bytes]]) -> List[bytes]:
         return sha256_many([b"\x01" + l + r for l, r in pairs])
+
+    def node_hashes_array(self, pairs: np.ndarray) -> np.ndarray:
+        """[m, 64] u8 (left||right) → [m, 32] u8 — the array seam for
+        level-wise bulk tree building (no per-pair Python objects)."""
+        return sha256_node_pairs_array(pairs)
 
 
 _default_backend = None

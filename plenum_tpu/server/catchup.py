@@ -30,6 +30,7 @@ from plenum_tpu.common.messages.node_messages import (
     CatchupRep, CatchupReq, ConsistencyProof, LedgerStatus)
 from plenum_tpu.consensus.quorums import Quorums
 from plenum_tpu.ledger.ledger import Ledger
+from plenum_tpu.ledger.merkle_verifier import MerkleVerifier
 from plenum_tpu.ledger.tree_hasher import TreeHasher
 from plenum_tpu.runtime.timer import RepeatingTimer, TimerService
 
@@ -43,7 +44,8 @@ class SeederService:
     """Answers peers' catchup questions from our committed ledgers."""
 
     def __init__(self, db_manager, network, name: str = "?",
-                 view_source: Callable[[], Tuple[int, int]] = None):
+                 view_source: Callable[[], Tuple[int, int]] = None,
+                 config: Optional[Config] = None):
         """view_source() → (view_no, last_ordered_pp_seq_no): stamped on
         responses so a rejoining node can adopt the POOL's current view —
         the audit ledger alone records only original (pre-view-change)
@@ -52,6 +54,7 @@ class SeederService:
         self._network = network
         self.name = name
         self._view_source = view_source or (lambda: (0, 0))
+        self._config = config or Config()
         network.subscribe(LedgerStatus, self.process_ledger_status)
         network.subscribe(CatchupReq, self.process_catchup_req)
 
@@ -108,6 +111,36 @@ class SeederService:
             oldMerkleRoot=old_root, newMerkleRoot=ledger.root_hash,
             hashes=hashes)
 
+    def _catchup_audit_paths(self, ledger: Ledger, start: int, end: int,
+                             till: int) -> Optional[Dict[str, List[str]]]:
+        """Per-txn inclusion proofs for the served range against the
+        size-`till` prefix tree the leecher agreed on. ONE batched pass:
+        the proofs share a subtree memo on the host path and ride the
+        pipelined device engine above the routing threshold (the
+        catchup rep server is a production proof-batch consumer). A
+        digest→b58 memo collapses the heavily shared upper siblings."""
+        if not (start <= end <= till <= ledger.size and till > 0):
+            return None  # we cannot prove against a tree we don't have
+        try:
+            paths = ledger.tree.inclusion_proofs_batch(
+                list(range(start - 1, end)), till)
+        except Exception:
+            logger.warning("%s cannot build catchup audit paths "
+                           "%s..%s@%s", self.name, start, end, till,
+                           exc_info=True)
+            return None
+        to_str = Ledger.hashToStr
+        memo: Dict[bytes, str] = {}
+
+        def enc(h):
+            s = memo.get(h)
+            if s is None:
+                s = memo[h] = to_str(h)
+            return s
+
+        return {str(seq): [enc(h) for h in path]
+                for seq, path in zip(range(start, end + 1), paths)}
+
     def process_catchup_req(self, req: CatchupReq, frm: str):
         ledger = self._db.get_ledger(req.ledgerId)
         if ledger is None:
@@ -115,14 +148,36 @@ class SeederService:
         end = min(req.seqNoEnd, ledger.size)
         if end < req.seqNoStart:
             return
-        txns = {}
-        for seq in range(req.seqNoStart, end + 1):
-            txn = ledger.getBySeqNo(seq)
-            if txn is None:
-                return
-            txns[str(seq)] = txn
-        self._network.send(CatchupRep(ledgerId=req.ledgerId, txns=txns,
-                                      consProof=[]), [frm])
+        start = req.seqNoStart
+        till = req.catchupTill or end
+        # chunked reps: a large range leaves as several bounded
+        # messages, each independently verifiable from its audit paths.
+        # Proofs are materialized per GROUP (a few chunks — large
+        # enough to engage the device routing, small enough to bound
+        # memory to the group, not the whole requested range).
+        conf = self._config
+        chunk = max(1, getattr(conf, "CATCHUP_REP_CHUNK",
+                               Config.CATCHUP_REP_CHUNK))
+        group = max(chunk, getattr(conf, "MERKLE_DEVICE_PROOF_MIN",
+                                   Config.MERKLE_DEVICE_PROOF_MIN))
+        want_paths = getattr(conf, "CATCHUP_REP_AUDIT_PATHS",
+                             Config.CATCHUP_REP_AUDIT_PATHS)
+        for glo in range(start, end + 1, group):
+            ghi = min(glo + group - 1, end)
+            proofs = self._catchup_audit_paths(ledger, glo, ghi, till) \
+                if want_paths else None
+            for lo in range(glo, ghi + 1, chunk):
+                hi = min(lo + chunk - 1, ghi)
+                txns = {}
+                for seq in range(lo, hi + 1):
+                    txn = ledger.getBySeqNo(seq)
+                    if txn is None:
+                        return
+                    txns[str(seq)] = txn
+                audit = {k: proofs[k] for k in txns} if proofs else None
+                self._network.send(
+                    CatchupRep(ledgerId=req.ledgerId, txns=txns,
+                               consProof=[], auditPaths=audit), [frm])
 
 
 class LeecherState(Enum):
@@ -266,10 +321,46 @@ class LedgerLeecher:
                              catchupTill=self.target_size)
             self._network.send(req, [peer] if peer else None)
 
+    def _verify_rep_proofs(self, rep: CatchupRep, frm: str) -> bool:
+        """Per-rep fast rejection: when the seeder attached audit paths,
+        verify every txn's inclusion against the quorum-agreed
+        (target_size, target_root) BEFORE buffering — a lying chunk is
+        dropped (and re-requested elsewhere) at rep time instead of
+        poisoning the buffer until the whole-range root replay. Leaf
+        hashing batches through the TreeHasher TPU seam. Legacy reps
+        without paths still ride the final root check."""
+        paths = getattr(rep, "auditPaths", None)
+        if not paths or self.target_root is None:
+            return True
+        ledger = self.ledger
+        try:
+            items = []
+            for seq_str, txn in rep.txns.items():
+                seq = int(seq_str)
+                if not ledger.size < seq <= self.target_size:
+                    continue
+                path_strs = paths.get(seq_str)
+                if path_strs is None:
+                    continue  # unproven txn rides the final root check
+                items.append((ledger.serialize_for_tree(txn), seq - 1,
+                              [Ledger.strToHash(s) for s in path_strs]))
+            if items:
+                MerkleVerifier(ledger.hasher).verify_leaf_inclusion_batch(
+                    items, self.target_size,
+                    Ledger.strToHash(self.target_root))
+        except Exception:
+            logger.warning("ledger %s: catchup rep from %s failed audit-"
+                           "path verification — discarding the chunk",
+                           self.lid, frm, exc_info=True)
+            return False
+        return True
+
     def process_catchup_rep(self, rep: CatchupRep, frm: str):
         if self.state != LeecherState.SYNCING or rep.ledgerId != self.lid:
             return
         if self.target_size is None:
+            return
+        if not self._verify_rep_proofs(rep, frm):
             return
         for seq_str, txn in rep.txns.items():
             seq = int(seq_str)

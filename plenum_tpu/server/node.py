@@ -78,6 +78,7 @@ class NodeBootstrap:
     def init_storage(storage_factory=None,
                      config: Optional[Config] = None) -> DatabaseManager:
         make_kv = storage_factory or (lambda name: KeyValueStorageInMemory())
+        conf = config or Config()
         dm = DatabaseManager()
         for lid, name in ((POOL_LEDGER_ID, "pool"),
                           (DOMAIN_LEDGER_ID, "domain"),
@@ -86,6 +87,15 @@ class NodeBootstrap:
             ledger = Ledger(txn_store=make_kv(name + "_ledger"),
                             tree_hasher=NodeBootstrap.make_tree_hasher(
                                 config))
+            if conf.MERKLE_DEVICE_PROOFS and conf.SHA256_BACKEND == "jax":
+                # large reply/catchup proof batches route to the
+                # device-resident tree; below MERKLE_DEVICE_PROOF_MIN
+                # the host memo path keeps winning and nothing changes
+                ledger.tree.attach_device_engine(
+                    proof_min=conf.MERKLE_DEVICE_PROOF_MIN,
+                    chunk=conf.MERKLE_DEVICE_PROOF_CHUNK,
+                    pipeline_depth=conf.MERKLE_DEVICE_PIPELINE_DEPTH,
+                    warm=True)  # recovered ledgers sync off the hot path
             state = None
             if lid != AUDIT_LEDGER_ID:
                 state = PruningState(make_kv(name + "_state"))
@@ -415,7 +425,8 @@ class Node:
         self.seeder = SeederService(
             self.db_manager, network, name=name,
             view_source=lambda: (self.replica.view_no,
-                                 self.replica.data.last_ordered_3pc[1]))
+                                 self.replica.data.last_ordered_3pc[1]),
+            config=self.config)
         self.leecher = NodeLeecherService(
             self.db_manager, network, timer,
             quorums_source=lambda: self.replica.data.quorums,

@@ -11,6 +11,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-second/large-memory tests excluded from the tier-1 "
+        "run (-m 'not slow')")
+
+
 @pytest.fixture
 def mock_timer():
     from plenum_tpu.testing.mock_timer import MockTimer

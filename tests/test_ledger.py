@@ -384,12 +384,18 @@ def test_device_merkle_audit_path_batch():
     assert not dev.verify_path(leaves[idx[0]], idx[0], bad, root)
 
 
-def test_device_merkle_ragged_rejects_path_batch():
+def test_device_merkle_ragged_path_batch():
+    """Ragged sizes are served via the frontier decomposition; only the
+    DENSE array API (fixed [k, depth, 32] shape) stays pow2-only."""
     from plenum_tpu.ops.merkle import DeviceMerkleTree
+    leaves = [b"a", b"b", b"c"]
     dev = DeviceMerkleTree()
-    dev.build([b"a", b"b", b"c"])
+    root = dev.build(leaves)
+    paths = dev.audit_path_batch([0, 1, 2])
+    for m in range(3):
+        assert V.verify_leaf_inclusion(leaves[m], m, paths[m], 3, root), m
     with pytest.raises(ValueError):
-        dev.audit_path_batch([0])
+        dev.audit_path_batch_array([0])
 
 
 def test_device_merkle_single_leaf_paths():

@@ -1,0 +1,393 @@
+"""Merkle proof engine v2: ragged-size device proofs, incremental
+device append, fused pack+gather, ProofPipeline, catchup rep proofs.
+
+Acceptance (ISSUE 2): device proofs byte-equal MerkleVerifier-checked
+host proofs at randomized ragged sizes; incremental device append
+reproduces the host CompactMerkleTree root AND hash-store contents
+across interleaved append/extend/discard sequences.
+"""
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
+from plenum_tpu.ledger.hash_store import MemoryHashStore
+from plenum_tpu.ledger.merkle_verifier import MerkleVerifier
+from plenum_tpu.ledger.tree_hasher import TreeHasher
+
+H = TreeHasher()
+V = MerkleVerifier(H)
+
+
+def host_tree(leaves):
+    t = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    for leaf in leaves:
+        t.append(leaf)
+    return t
+
+
+# ------------------------------------------------- ragged device proofs
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 15, 16, 17,
+                               31, 33, 63, 65, 100, 127, 129, 255, 257])
+def test_ragged_device_proofs_match_host_and_verify(n):
+    leaves = [b"leaf-%d" % i for i in range(n)]
+    host = host_tree(leaves)
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    dev = DeviceMerkleTree()
+    root = dev.build(leaves)
+    assert root == host.root_hash
+    idx = list(range(n))
+    paths = dev.audit_path_batch(idx)
+    assert paths == host.inclusion_proofs_batch(idx, n)
+    for m in idx:
+        assert V.verify_leaf_inclusion(leaves[m], m, paths[m], n, root), m
+
+
+def test_ragged_device_proofs_randomized_sizes():
+    rng = random.Random(1234)
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    sizes = [rng.randrange(1, 3000) for _ in range(6)]
+    sizes += [1023, 1025, 2047]  # 2^k +- 1
+    for n in sizes:
+        leaves = [b"r-%d-%d" % (n, i) for i in range(n)]
+        host = host_tree(leaves)
+        dev = DeviceMerkleTree()
+        root = dev.build(leaves)
+        assert root == host.root_hash, n
+        idx = sorted(rng.sample(range(n), min(n, 64)))
+        paths = dev.inclusion_proofs(idx, n)
+        assert paths == host.inclusion_proofs_batch(idx, n), n
+        for m, path in zip(idx, paths):
+            assert V.verify_leaf_inclusion(leaves[m], m, path, n, root)
+        # prefix-tree proofs (n' < current size) come off the same levels
+        np_ = max(1, n // 2)
+        idx2 = sorted(rng.sample(range(np_), min(np_, 16)))
+        assert dev.inclusion_proofs(idx2, np_) == \
+            host.inclusion_proofs_batch(idx2, np_), n
+
+
+@pytest.mark.slow
+def test_ragged_device_proofs_large():
+    """>1M ragged tree: device proofs verify against MerkleVerifier."""
+    from plenum_tpu.ops.merkle import DeviceMerkleTree, ProofPipeline
+    n = (1 << 20) + 12345
+    leaves = [b"txn-%020d" % i for i in range(n)]
+    dev = DeviceMerkleTree()
+    root = dev.build(leaves)
+    rng = random.Random(9)
+    idx = sorted(rng.sample(range(n), 2000))
+    paths = ProofPipeline(dev, depth=2).run(idx, n=n, chunk=512)
+    for m, path in zip(idx, paths):
+        assert V.verify_leaf_inclusion(leaves[m], m, path, n, root)
+
+
+# -------------------------------------------- incremental device append
+
+def test_incremental_append_equivalence_interleaved():
+    """Device incremental append == host tree (root + returned node
+    digests == hash-store contents) across randomized batch sizes,
+    with proof batches interleaved so the lazy host mirror is
+    exercised both fresh and mid-growth."""
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    rng = random.Random(77)
+    host = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    dev = DeviceMerkleTree()
+    total = 0
+    for step in range(20):
+        b = rng.choice([1, 2, 3, 7, 16, 33, 100, 250])
+        hashes = [H.hash_leaf(b"i-%d-%d" % (step, i)) for i in range(b)]
+        for h in hashes:
+            host._append_hash(h, want_path=False)
+        nodes = dev.append_leaf_hashes(hashes, return_nodes=True)
+        total += b
+        assert dev.tree_size == host.tree_size == total
+        assert dev.root_hash == host.root_hash, step
+        for height, pos, rows in nodes:
+            for i in range(rows.shape[0]):
+                node = rows[i].tobytes()
+                if height == 0:
+                    assert node == host.hash_store.read_leaf(pos + i)
+                else:
+                    assert node == host.hash_store.read_subtree(
+                        (pos + i) << height, height), (step, height)
+        if step % 3 == 0:
+            idx = rng.sample(range(total), min(total, 40))
+            assert dev.inclusion_proofs(idx, total) == \
+                host.inclusion_proofs_batch(idx, total), step
+
+
+def test_device_backed_ledger_staging_equivalence():
+    """A device-engine-attached ledger stays bit-identical to a plain
+    one (roots, store contents, proofs) across randomized
+    appendTxns/commitTxns/discardTxns/add sequences — the executor's
+    uncommitted_root_hash path."""
+    from plenum_tpu.ledger.ledger import Ledger
+    rng = random.Random(5)
+    plain = Ledger()
+    backed = Ledger()
+    backed.tree.BULK_MIN = 8
+    backed.tree.attach_device_engine(proof_min=1, chunk=16,
+                                     pipeline_depth=2)
+
+    def txn(i):
+        return {"txn": {"type": "1", "data": {"i": i}}, "txnMetadata": {}}
+
+    i = 0
+    for step in range(30):
+        op = rng.choice(["stage", "stage", "commit", "discard", "add"])
+        if op == "stage":
+            b = rng.choice([1, 3, 12])
+            txns = [txn(i + j) for j in range(b)]
+            plain.appendTxns([dict(t) for t in txns])
+            backed.appendTxns([dict(t) for t in txns])
+            i += b
+        elif op == "commit" and plain.uncommittedTxns:
+            c = rng.randrange(1, len(plain.uncommittedTxns) + 1)
+            plain.commitTxns(c)
+            backed.commitTxns(c)
+        elif op == "discard" and plain.uncommittedTxns:
+            c = rng.randrange(1, len(plain.uncommittedTxns) + 1)
+            plain.discardTxns(c)
+            backed.discardTxns(c)
+        else:
+            plain.add(txn(i))
+            backed.add(txn(i))
+            i += 1
+        assert backed.uncommitted_root_hash == plain.uncommitted_root_hash
+        assert backed.root_hash_raw == plain.root_hash_raw
+        if plain.size:
+            seqs = rng.sample(range(1, plain.size + 1),
+                              min(plain.size, 10))
+            assert backed.merkleInfoBatch(seqs) == \
+                plain.merkleInfoBatch(seqs), step
+    assert backed.tree.hash_store._leaves == plain.tree.hash_store._leaves
+    assert backed.tree.hash_store._nodes == plain.tree.hash_store._nodes
+
+
+def test_bulk_extend_nonempty_matches_scalar():
+    """extend() onto a NON-empty tree goes level-wise (satellite 2) and
+    reproduces the scalar tree exactly: root, frontier, store contents,
+    proofs."""
+    rng = random.Random(3)
+    scalar = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    bulk = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    bulk.BULK_MIN = 4
+    n = 0
+    for step in range(12):
+        b = rng.choice([1, 2, 4, 5, 9, 33, 100])
+        leaves = [b"b-%d-%d" % (step, i) for i in range(b)]
+        for leaf in leaves:
+            scalar.append(leaf)
+        bulk.extend(leaves)
+        n += b
+        assert bulk.root_hash == scalar.root_hash, step
+        assert bulk._frontier == scalar._frontier, step
+        assert bulk.hash_store._leaves == scalar.hash_store._leaves
+        assert bulk.hash_store._nodes == scalar.hash_store._nodes
+    idx = rng.sample(range(n), min(n, 30))
+    assert bulk.inclusion_proofs_batch(idx, n) == \
+        scalar.inclusion_proofs_batch(idx, n)
+    for first in rng.sample(range(1, n + 1), 10):
+        assert bulk.consistency_proof(first, n) == \
+            scalar.consistency_proof(first, n)
+
+
+# ------------------------------------------------------- proof pipeline
+
+def test_proof_pipeline_matches_one_shot():
+    from plenum_tpu.ops.merkle import DeviceMerkleTree, ProofPipeline
+    n = 777
+    leaves = [b"p-%d" % i for i in range(n)]
+    host = host_tree(leaves)
+    dev = DeviceMerkleTree()
+    dev.build(leaves)
+    idx = list(range(n))
+    exp = host.inclusion_proofs_batch(idx, n)
+    for depth in (1, 2, 3):
+        pipe = ProofPipeline(dev, depth=depth)
+        assert pipe.run(idx, n=n, chunk=100) == exp, depth
+    # dense mode over a pow2 tree streams uint8 buffers
+    dev2 = DeviceMerkleTree()
+    dev2.build(leaves[:512])
+    pipe = ProofPipeline(dev2, depth=2, dense=True)
+    batches = [list(range(0, 256)), list(range(256, 512))]
+    parts = list(pipe.stream(batches))
+    assert [p.shape for p in parts] == [(256, 9, 32), (256, 9, 32)]
+    host2 = host_tree(leaves[:512])
+    got = [[parts[0][i, h].tobytes() for h in range(9)] for i in (0, 255)]
+    assert got[0] == host2.inclusion_proof(0, 512)
+    assert got[1] == host2.inclusion_proof(255, 512)
+
+
+# ---------------------------------------------- sha256 satellite paths
+
+def test_pad_messages_mixed_lengths_vectorized():
+    from plenum_tpu.ops.sha256 import sha256_many
+    rng = random.Random(8)
+    msgs = [bytes([rng.randrange(256)]) * rng.randrange(0, 300)
+            for _ in range(257)]
+    msgs += [b"", b"x" * 55, b"y" * 56, b"z" * 64, b"w" * 119, b"v" * 120]
+    assert sha256_many(msgs) == [hashlib.sha256(m).digest() for m in msgs]
+
+
+def test_node_pairs_array_matches_scalar():
+    rng = random.Random(21)
+    pairs = np.frombuffer(bytes(rng.randrange(256)
+                                for _ in range(64 * 37)),
+                          dtype=np.uint8).reshape(37, 64)
+    expected = [hashlib.sha256(b"\x01" + pairs[i].tobytes()).digest()
+                for i in range(37)]
+    # hashlib fallback (below threshold)
+    got = TreeHasher().hash_node_pairs_array(pairs)
+    assert [got[i].tobytes() for i in range(37)] == expected
+    # jax backend array seam
+    from plenum_tpu.ops.sha256 import get_default_backend
+    jh = TreeHasher(batch_backend=get_default_backend(), batch_threshold=1)
+    got = jh.hash_node_pairs_array(pairs)
+    assert [got[i].tobytes() for i in range(37)] == expected
+
+
+# ---------------------------------------------------- catchup rep proofs
+
+class _FakeNet:
+    def __init__(self):
+        self.sent = []
+        self.connecteds = set()
+
+    def subscribe(self, *_a, **_k):
+        pass
+
+    def send(self, msg, dests=None):
+        self.sent.append((msg, dests))
+
+
+class _FakeDb:
+    def __init__(self, ledger):
+        self._ledger = ledger
+
+    def get_ledger(self, lid):
+        return self._ledger if lid == 1 else None
+
+
+def _make_seeder_ledger(n):
+    from plenum_tpu.ledger.ledger import Ledger
+    ledger = Ledger()
+    for i in range(n):
+        ledger.add({"txn": {"type": "1", "data": {"i": i}},
+                    "txnMetadata": {}})
+    return ledger
+
+
+def test_seeder_chunks_reps_with_verified_audit_paths():
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.common.messages.node_messages import CatchupReq
+    from plenum_tpu.ledger.ledger import Ledger
+    from plenum_tpu.server.catchup import SeederService
+    ledger = _make_seeder_ledger(25)
+    net = _FakeNet()
+    seeder = SeederService(_FakeDb(ledger), net, name="S",
+                           config=Config(CATCHUP_REP_CHUNK=10))
+    seeder.process_catchup_req(
+        CatchupReq(ledgerId=1, seqNoStart=1, seqNoEnd=25, catchupTill=25),
+        "peer")
+    reps = [m for m, _ in net.sent]
+    assert [sorted(int(s) for s in r.txns) for r in reps] == [
+        list(range(1, 11)), list(range(11, 21)), list(range(21, 26))]
+    root = ledger.root_hash_raw
+    verifier = MerkleVerifier(ledger.hasher)
+    for rep in reps:
+        assert rep.auditPaths is not None
+        for seq_str, txn in rep.txns.items():
+            path = [Ledger.strToHash(s)
+                    for s in rep.auditPaths[seq_str]]
+            assert verifier.verify_leaf_inclusion(
+                ledger.serialize_for_tree(txn), int(seq_str) - 1,
+                path, 25, root)
+
+
+def test_device_engine_circuit_breaker_detaches_after_failures():
+    """A persistently failing engine falls back to the host memo path
+    every time and is detached after _DEVICE_MAX_FAILURES — proofs
+    stay correct throughout."""
+    tree = CompactMerkleTree(TreeHasher(), MemoryHashStore())
+    for i in range(40):
+        tree.append(b"cb-%d" % i)
+    exp = tree.inclusion_proofs_batch(list(range(40)), 40)
+
+    class Broken:
+        tree_size = 0
+
+        def reset(self):
+            pass
+
+        def build_from_leaf_hashes(self, _):
+            raise RuntimeError("device is sick")
+
+    tree.attach_device_engine(engine=Broken(), proof_min=1)
+    for _ in range(tree._DEVICE_MAX_FAILURES):
+        assert tree._device_engine is not None
+        assert tree.inclusion_proofs_batch(list(range(40)), 40) == exp
+    assert tree._device_engine is None  # detached, host path serves
+    assert tree.inclusion_proofs_batch(list(range(40)), 40) == exp
+
+
+def test_seeder_audit_paths_config_off():
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.common.messages.node_messages import CatchupReq
+    from plenum_tpu.server.catchup import SeederService
+    ledger = _make_seeder_ledger(7)
+    net = _FakeNet()
+    seeder = SeederService(_FakeDb(ledger), net, name="S",
+                           config=Config(CATCHUP_REP_CHUNK=10,
+                                         CATCHUP_REP_AUDIT_PATHS=False))
+    seeder.process_catchup_req(
+        CatchupReq(ledgerId=1, seqNoStart=1, seqNoEnd=7, catchupTill=7),
+        "peer")
+    (rep, _), = net.sent
+    assert rep.auditPaths is None and len(rep.txns) == 7
+
+
+def test_leecher_rejects_poisoned_rep_at_rep_time():
+    """A chunk with valid-looking txns but forged content fails its
+    audit paths and never enters the buffer; the honest chunk with
+    correct paths is accepted."""
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.common.messages.node_messages import (
+        CatchupRep, CatchupReq)
+    from plenum_tpu.ledger.ledger import Ledger
+    from plenum_tpu.server.catchup import (
+        LedgerLeecher, LeecherState, SeederService)
+    from plenum_tpu.testing.mock_timer import MockTimer
+
+    src = _make_seeder_ledger(9)
+    net = _FakeNet()
+    seeder = SeederService(_FakeDb(src), net, name="S",
+                           config=Config(CATCHUP_REP_CHUNK=100))
+    seeder.process_catchup_req(
+        CatchupReq(ledgerId=1, seqNoStart=1, seqNoEnd=9, catchupTill=9),
+        "peer")
+    honest_rep = net.sent[0][0]
+
+    dst = Ledger()
+    applied = []
+    leecher = LedgerLeecher(
+        1, _FakeDb(dst), _FakeNet(), MockTimer(),
+        quorums_source=lambda: None,
+        on_txn=lambda lid, t: applied.append(t),
+        on_done=lambda lid: None, config=Config())
+    leecher.state = LeecherState.SYNCING
+    leecher.target_size = 9
+    leecher.target_root = src.root_hash
+
+    poisoned_txns = {s: {"txn": {"type": "1", "data": {"evil": s}},
+                         "txnMetadata": {"seqNo": int(s)}}
+                     for s in honest_rep.txns}
+    poisoned = CatchupRep(ledgerId=1, txns=poisoned_txns, consProof=[],
+                          auditPaths=honest_rep.auditPaths)
+    leecher.process_catchup_rep(poisoned, "evil-peer")
+    assert leecher._buffer == {} and applied == []
+    leecher.process_catchup_rep(honest_rep, "peer")
+    assert len(applied) == 9  # verified, applied, and the range is done
