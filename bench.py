@@ -96,10 +96,12 @@ def make_requests(n, signer):
     return reqs
 
 
-def make_sim_pool(names, verifier_name, seed=7, batch=None):
+def make_sim_pool(names, verifier_name, seed=7, batch=None,
+                  tracing=False):
     """Build an n-node sim pool with the given verification provider
     (shared scaffolding for the 4-node headline and 25-node backlog
-    configs — one drain/hub wiring to maintain)."""
+    configs — one drain/hub wiring to maintain). tracing=True turns on
+    the flight recorder (observability/) for the overhead config."""
     from plenum_tpu.common.config import Config
     from plenum_tpu.crypto.batch_verifier import create_verifier
     from plenum_tpu.runtime.sim_random import DefaultSimRandom
@@ -113,7 +115,8 @@ def make_sim_pool(names, verifier_name, seed=7, batch=None):
                      max_latency=0.005)
     conf = Config(Max3PCBatchSize=batch or CLIENT_BATCH,
                   Max3PCBatchWait=0.05,
-                  CHK_FREQ=10, LOG_SIZE=30, HEARTBEAT_FREQ=10 ** 6)
+                  CHK_FREQ=10, LOG_SIZE=30, HEARTBEAT_FREQ=10 ** 6,
+                  TRACING_ENABLED=tracing)
     nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
              for name in names]
     if verifier_name == "tpu_hub":
@@ -121,6 +124,10 @@ def make_sim_pool(names, verifier_name, seed=7, batch=None):
         # dispatches of each chunk fuse into ONE latency-bound kernel
         # launch (see CoalescingVerifierHub)
         hub = create_verifier("tpu_hub")
+        if tracing:
+            # a post-ctor shared hub bypasses Node's tracer attach —
+            # record its fused launches into the first node's buffer
+            hub.tracer = nodes[0].tracer
         for n in nodes:
             n.authnr._verifier = hub
     else:
@@ -431,7 +438,7 @@ def _drive_mp_client(base_dir, reqs, procs):
     return asyncio.run(drive())
 
 
-def run_pool(reqs, verifier_name):
+def run_pool(reqs, verifier_name, tracing=False):
     """→ (elapsed_wall_seconds, ordered_count) for ordering all reqs.
 
     Chunk intake is PIPELINED: chunk i+1's verification is dispatched
@@ -440,7 +447,7 @@ def run_pool(reqs, verifier_name):
     consensus work instead of serializing with it — the same
     dispatch/conclude split the Node's intake API exposes for the
     production prod loop."""
-    nodes, timer = make_sim_pool(NAMES, verifier_name)
+    nodes, timer = make_sim_pool(NAMES, verifier_name, tracing=tracing)
 
     target = len(reqs)
     t0 = time.perf_counter()
@@ -458,6 +465,36 @@ def run_pool(reqs, verifier_name):
     elapsed = time.perf_counter() - t0
     ordered = min(nd.domain_ledger.size for nd in nodes)
     return elapsed, ordered
+
+
+def tracing_overhead():
+    """Flight-recorder overhead gate (observability/): the IDENTICAL
+    4-node sim pool + ordering workload with tracing enabled vs
+    disabled. CPU verifier on both sides so shared-device variance
+    cannot mask (or fake) the tracer's cost; interleaved best-of-2 like
+    every other pool comparison. The enabled cost must stay in low
+    single-digit percent — that is what makes it safe to flip on in
+    production when a pool misbehaves."""
+    from plenum_tpu.crypto.signer import SimpleSigner
+
+    n = int(os.environ.get("BENCH_TRACE_REQS", str(min(POOL_REQS, 2000))))
+    reqs = make_requests(n, SimpleSigner(seed=b"\x52" * 32))
+    off_runs, on_runs = [], []
+    for _ in range(2):
+        off_runs.append(run_pool(reqs, "cpu", tracing=False))
+        on_runs.append(run_pool(reqs, "cpu", tracing=True))
+    off_elapsed, off_ordered = best_of_runs(off_runs, n, "trace-off")
+    on_elapsed, on_ordered = best_of_runs(on_runs, n, "trace-on")
+    off_rate = off_ordered / off_elapsed
+    on_rate = on_ordered / on_elapsed
+    return {
+        "reqs": n,
+        "traced_req_per_s": round(on_rate, 1),
+        "untraced_req_per_s": round(off_rate, 1),
+        # positive = tracing costs throughput; can come out slightly
+        # negative on a noisy box (within run-to-run jitter)
+        "overhead_pct": round(100.0 * (1.0 - on_rate / off_rate), 2),
+    }
 
 
 def micro_ed25519():
@@ -1001,6 +1038,8 @@ def main():
     tpu_rate = tpu_ordered / tpu_elapsed
     cpu_rate = cpu_ordered / cpu_elapsed
 
+    tracing = tracing_overhead()
+
     (device_rate, device_rate_median, ed_single_shot, ed_single_shot_med,
      openssl_rate, python_rate, ed_sweep) = micro_ed25519()
     mk = micro_merkle()
@@ -1047,6 +1086,7 @@ def main():
             "merkle": mk,
             "bls": bls_results,
             "pool25_backlog": p25,
+            "tracing_overhead": tracing,
         },
     }))
     # compact one-line summary LAST: the driver records only a bounded
@@ -1067,6 +1107,7 @@ def main():
                                    .get("aggregate_per_s")),
             "pool25_mixed_req_per_s": p25.get("mixed_req_per_s")
             if isinstance(p25, dict) else None,
+            "tracing_overhead_pct": tracing["overhead_pct"],
         }
     }, separators=(",", ":")))
 

@@ -157,6 +157,14 @@ class Config:
     # ---- metrics
     METRICS_COLLECTOR_TYPE = None
 
+    # ---- flight recorder (observability/): per-node span tracing of
+    # the batch lifecycle + device-dispatch seams, exportable as a
+    # Perfetto timeline (scripts/trace_view). Off by default; enabled
+    # cost is bench-gated to low single-digit percent on the ordering
+    # hot path (bench.py tracing_overhead).
+    TRACING_ENABLED = False
+    TRACING_BUFFER_SPANS = 1 << 16   # ring slots per node; newest kept
+
     # ---- plugins (reference plenum/config.py:164
     # notifierEventTriggeringConfig + SpikeEventsEnabled; plugin dirs
     # from plenum/server/plugin_loader.py usage)

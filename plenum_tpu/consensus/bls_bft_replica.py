@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
+from plenum_tpu.observability.tracing import CAT_BLS, NullTracer
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 from plenum_tpu.crypto.bls import (
     BlsCryptoSigner, BlsCryptoVerifier, MultiSignature, MultiSignatureValue)
@@ -89,6 +90,7 @@ class BlsBftReplica:
         # SUSTAIN proof suppression, then retry the fast path
         self._strict_until_seq = -1
         self.metrics = NullMetricsCollector()  # node injects the real one
+        self.tracer = NullTracer()             # node injects the real one
         self._signer = bls_signer
         self._verifier = bls_verifier
         self._keys = key_register
@@ -192,7 +194,9 @@ class BlsBftReplica:
 
     def process_order(self, key, commits: Dict[str, "Commit"], pp,
                       quorums=None):
-        with self.metrics.measure_time(MetricsName.BLS_AGGREGATE_TIME):
+        with self.metrics.measure_time(MetricsName.BLS_AGGREGATE_TIME), \
+                self.tracer.span("bls_aggregate", CAT_BLS,
+                                 key="%d:%d" % key, shares=len(commits)):
             return self._process_order(key, commits, pp, quorums)
 
     def _process_order(self, key, commits: Dict[str, "Commit"], pp,

@@ -22,6 +22,7 @@ from collections import OrderedDict, defaultdict
 from typing import Dict, List, Optional, Set, Tuple
 
 from plenum_tpu.common.config import Config
+from plenum_tpu.observability.tracing import CAT_3PC, NullTracer
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 from plenum_tpu.common.constants import AUDIT_LEDGER_ID, DOMAIN_LEDGER_ID
 from plenum_tpu.common.messages.internal_messages import (
@@ -156,6 +157,7 @@ class OrderingService:
         self._executor = executor
         self._config = config or Config()
         self.metrics = NullMetricsCollector()  # node injects the real one
+        self.tracer = NullTracer()             # node injects the real one
         # a PRE-PREPARE carries ~72 wire bytes per request digest; a
         # batch big enough to push it past the transport frame limit
         # would be dropped by the stack and wedge ordering at the first
@@ -311,6 +313,13 @@ class OrderingService:
         self._send_batch_of(ledger_id, digests)
 
     def _send_batch_of(self, ledger_id: int, digests: List[str]):
+        with self.tracer.span(
+                "pp_create", CAT_3PC,
+                key="%d:%d" % (self.view_no, self.lastPrePrepareSeqNo + 1),
+                batch_size=len(digests), ledger_id=ledger_id):
+            self._send_batch_of_inner(ledger_id, digests)
+
+    def _send_batch_of_inner(self, ledger_id: int, digests: List[str]):
         self.metrics.add_event(MetricsName.THREE_PC_BATCH_SIZE,
                                len(digests))
         pp_seq_no = self.lastPrePrepareSeqNo + 1
@@ -365,7 +374,10 @@ class OrderingService:
     # ====================================================== PRE-PREPARE
 
     def process_preprepare(self, pp: PrePrepare, frm: str):
-        with self.metrics.measure_time(MetricsName.PP_PROCESS_TIME):
+        with self.metrics.measure_time(MetricsName.PP_PROCESS_TIME), \
+                self.tracer.span("pp_process", CAT_3PC,
+                                 key="%d:%d" % (pp.viewNo, pp.ppSeqNo),
+                                 batch_size=len(pp.reqIdr), frm=frm):
             return self._process_preprepare(pp, frm)
 
     def _process_preprepare(self, pp: PrePrepare, frm: str):
@@ -503,7 +515,11 @@ class OrderingService:
     # ========================================================== PREPARE
 
     def process_prepare(self, prepare: Prepare, frm: str):
-        with self.metrics.measure_time(MetricsName.PREPARE_PROCESS_TIME):
+        with self.metrics.measure_time(MetricsName.PREPARE_PROCESS_TIME), \
+                self.tracer.span(
+                    "prepare_process", CAT_3PC,
+                    key="%d:%d" % (prepare.viewNo, prepare.ppSeqNo),
+                    frm=frm):
             return self._process_prepare(prepare, frm)
 
     def _process_prepare(self, prepare: Prepare, frm: str):
@@ -545,6 +561,9 @@ class OrderingService:
         if bid not in self._data.prepared:
             self._data.add_prepared(bid)
             self._data.last_batch_prepared = bid
+            # quorum marker: PREPARE certificate reached on this node
+            self.tracer.instant("prepared", CAT_3PC, key="%d:%d" % key,
+                                votes=len(self.prepares[key]))
             self._send_commit(pp)
         self._try_order(pp)
 
@@ -561,7 +580,11 @@ class OrderingService:
     # =========================================================== COMMIT
 
     def process_commit(self, commit: Commit, frm: str):
-        with self.metrics.measure_time(MetricsName.COMMIT_PROCESS_TIME):
+        with self.metrics.measure_time(MetricsName.COMMIT_PROCESS_TIME), \
+                self.tracer.span(
+                    "commit_process", CAT_3PC,
+                    key="%d:%d" % (commit.viewNo, commit.ppSeqNo),
+                    frm=frm):
             return self._process_commit(commit, frm)
 
     def _process_commit(self, commit: Commit, frm: str):
@@ -624,7 +647,12 @@ class OrderingService:
                 self._queue_entry_time.pop(digest, None)
 
     def _order(self, pp: PrePrepare):
-        with self.metrics.measure_time(MetricsName.ORDER_TIME):
+        with self.metrics.measure_time(MetricsName.ORDER_TIME), \
+                self.tracer.span("order", CAT_3PC,
+                                 key="%d:%d" % (pp.viewNo, pp.ppSeqNo),
+                                 batch_size=len(pp.reqIdr),
+                                 commits=len(self.commits[
+                                     (pp.viewNo, pp.ppSeqNo)])):
             return self._order_inner(pp)
 
     def _order_inner(self, pp: PrePrepare):

@@ -23,6 +23,7 @@ from plenum_tpu.consensus.primary_selector import (
 from plenum_tpu.consensus.view_change_service import ViewChangeService
 from plenum_tpu.consensus.view_change_trigger_service import (
     ViewChangeTriggerService)
+from plenum_tpu.observability.tracing import CAT_3PC, NullTracer
 from plenum_tpu.runtime.bus import InternalBus
 from plenum_tpu.runtime.stashing_router import StashingRouter
 from plenum_tpu.runtime.timer import TimerService
@@ -44,6 +45,7 @@ class ReplicaService:
         self.network = network
         self.timer = timer
         self.executor = executor or SimExecutor()
+        self.tracer = NullTracer()   # node injects the real one
 
         self._data = ConsensusSharedData(
             name, validators, inst_id, is_master,
@@ -150,6 +152,12 @@ class ReplicaService:
     # ------------------------------------------------------------- hooks
 
     def _on_ordered(self, ordered: Ordered):
+        # the Ordered emission itself: separates "3PC decided" from the
+        # executor's durable-commit span that follows on this timeline
+        self.tracer.instant("ordered", CAT_3PC,
+                            key="%d:%d" % (ordered.viewNo,
+                                           ordered.ppSeqNo),
+                            batch_size=len(ordered.valid_reqIdr))
         self.ordered_log.append(ordered)
         self.executor.commit_batch(ordered)
 

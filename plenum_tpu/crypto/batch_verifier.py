@@ -21,6 +21,8 @@ from __future__ import annotations
 
 from typing import List, Sequence, Tuple
 
+from plenum_tpu.observability.tracing import CAT_DEVICE, NullTracer
+
 VerifyItem = Tuple[bytes, bytes, bytes]  # (message, signature64, verkey32)
 
 
@@ -158,8 +160,13 @@ class _HubPending:
         return bool(r()) if r is not None else True
 
     def collect(self) -> List[bool]:
-        self._hub._flush(self._gen)
-        return self._gen.results()[self._lo:self._hi]
+        hub = self._hub
+        # the harvest: when results are not yet materialized this span
+        # IS the host-visible device round trip for this slice
+        with hub.tracer.span("hub_collect", CAT_DEVICE,
+                             n=self._hi - self._lo):
+            hub._flush(self._gen)
+            return self._gen.results()[self._lo:self._hi]
 
 
 def dedup_items(items: Sequence[VerifyItem]
@@ -223,11 +230,15 @@ class CoalescingVerifierHub:
         self._scalar = scalar or OpenSSLVerifier()
         self.threshold = threshold
         self._gen = _HubGeneration()
+        self.tracer = NullTracer()   # node/bench attaches a recorder
 
     def dispatch(self, items: Sequence[VerifyItem]) -> _HubPending:
         gen = self._gen
         lo = len(gen.items)
         gen.items.extend(items)
+        # queue-depth counter: how deep the open generation is when each
+        # co-resident consumer lands — the coalescing evidence
+        self.tracer.counter("hub_queue_depth", len(gen.items))
         return _HubPending(self, gen, lo, len(gen.items))
 
     def flush(self) -> None:
@@ -247,15 +258,18 @@ class CoalescingVerifierHub:
         # co-resident consumer
         if gen is self._gen:
             self._gen = _HubGeneration()
-        launch_items = gen.dedup()
-        if not launch_items:
-            gen.pending = _Ready([])
-        elif len(launch_items) < self.threshold:
-            # quiet pool: a lone small generation takes the CPU floor
-            # rather than paying a full device launch
-            gen.pending = self._scalar.dispatch(launch_items)
-        else:
-            gen.pending = self._batch.dispatch(launch_items)
+        with self.tracer.span("hub_flush", CAT_DEVICE,
+                              items=len(gen.items)) as _sp:
+            launch_items = gen.dedup()
+            _sp.add(unique=len(launch_items))
+            if not launch_items:
+                gen.pending = _Ready([])
+            elif len(launch_items) < self.threshold:
+                # quiet pool: a lone small generation takes the CPU floor
+                # rather than paying a full device launch
+                gen.pending = self._scalar.dispatch(launch_items)
+            else:
+                gen.pending = self._batch.dispatch(launch_items)
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
         return self.dispatch(items).collect()

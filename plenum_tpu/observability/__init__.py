@@ -1,0 +1,11 @@
+"""Consensus flight recorder — span tracing + Perfetto export.
+
+`tracing` owns the per-node ring-buffer Tracer (and the free NullTracer
+the rest of the codebase holds by default); `export` turns any set of
+tracers into one Chrome trace-event (Perfetto-loadable) timeline with a
+"pid" row per node and a track per span category. docs/observability.md
+explains the span model and how to read the merged timeline.
+"""
+from plenum_tpu.observability.tracing import (  # noqa: F401
+    CAT_3PC, CAT_BLS, CAT_DEVICE, CAT_EXECUTE, CAT_INTAKE, CAT_PROPAGATE,
+    CAT_REPLY, NullTracer, Tracer)

@@ -1,0 +1,133 @@
+"""Chrome trace-event export — Perfetto-loadable pool timelines.
+
+Converts any set of Tracer ring buffers (one per node, plus standalone
+tracers like the verify daemon's) into the Trace Event Format that
+chrome://tracing and https://ui.perfetto.dev load directly:
+
+* one "pid" row per tracer (the node name, via process_name metadata),
+* one "tid" track per span category within a node (thread_name
+  metadata) — intake / propagate / 3pc / execute / device / bls /
+  reply render as parallel lanes per node,
+* complete events ("X") for spans, instants ("i") for quorum markers,
+  counter events ("C") for queue depths and batch sizes,
+* every event's args carry its correlation key ("key": request digest
+  or "viewNo:ppSeqNo"), so Perfetto's search/flow UI groups one batch's
+  whole lifecycle across all nodes.
+
+Timestamps are the tracers' shared perf_counter clock in microseconds;
+within one process (the sim pool, the e2e harness) that makes the
+merged timeline causally consistent with no alignment step. Output is
+deterministic for a given set of buffers: pids follow tracer order,
+tids follow first-appearance order, and the timeline is sorted by
+(ts, pid, tid, name).
+"""
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+
+def trace_events(tracers: Iterable) -> List[dict]:
+    """→ Trace Event Format event list (metadata first, then the
+    time-sorted merged timeline)."""
+    meta: List[dict] = []
+    timeline: List[dict] = []
+    pid_of: dict = {}
+    for tracer in tracers:
+        if tracer is None:
+            continue
+        recs = tracer.spans()
+        if not recs:
+            continue
+        pname = tracer.name or "node"
+        pid = pid_of.get(pname)
+        if pid is None:
+            pid = pid_of[pname] = len(pid_of) + 1
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "tid": 0, "args": {"name": pname}})
+        tids: dict = {}
+        for kind, name, cat, t0, t1, key, args in recs:
+            track = cat or "main"
+            tid = tids.get(track)
+            if tid is None:
+                tid = tids[track] = len(tids) + 1
+                meta.append({"name": "thread_name", "ph": "M",
+                             "pid": pid, "tid": tid,
+                             "args": {"name": track}})
+            ts = int(round(t0 * 1e6))
+            payload = dict(args) if args else {}
+            if key is not None:
+                payload["key"] = key
+            if kind == "X":
+                timeline.append({
+                    "name": name, "cat": track, "ph": "X", "pid": pid,
+                    "tid": tid, "ts": ts,
+                    "dur": max(0, int(round((t1 - t0) * 1e6))),
+                    "args": payload})
+            elif kind == "i":
+                timeline.append({
+                    "name": name, "cat": track, "ph": "i", "pid": pid,
+                    "tid": tid, "ts": ts, "s": "t", "args": payload})
+            else:  # "C"
+                timeline.append({
+                    "name": name, "ph": "C", "pid": pid, "tid": tid,
+                    "ts": ts, "args": payload})
+    timeline.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"]))
+    return meta + timeline
+
+
+def chrome_trace(tracers: Iterable) -> dict:
+    """→ the full JSON-object trace document."""
+    return {"traceEvents": trace_events(tracers),
+            "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(tracers: Iterable, path: str) -> str:
+    """Write the merged timeline to `path`; → path."""
+    doc = chrome_trace(tracers)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def pool_tracers(nodes: Iterable) -> List:
+    """Collect every node's tracer (skipping nodes without one) — the
+    merge set for a pool-wide timeline."""
+    out = []
+    for node in nodes:
+        tracer = getattr(node, "tracer", None)
+        if tracer is not None:
+            out.append(tracer)
+    return out
+
+
+def summarize(doc: dict) -> dict:
+    """Compact summary of a trace document (the `trace_view` CLI's
+    validation/reporting half): event counts per phase kind, span-name
+    histogram per node, wall span of the timeline."""
+    events = doc.get("traceEvents", [])
+    pid_names = {e["pid"]: e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+    by_ph: dict = {}
+    by_node: dict = {}
+    t_min: Optional[int] = None
+    t_max: Optional[int] = None
+    for e in events:
+        ph = e.get("ph")
+        by_ph[ph] = by_ph.get(ph, 0) + 1
+        if ph == "M":
+            continue
+        ts = e.get("ts", 0)
+        end = ts + e.get("dur", 0)
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = end if t_max is None else max(t_max, end)
+        node = pid_names.get(e["pid"], str(e["pid"]))
+        names = by_node.setdefault(node, {})
+        names[e["name"]] = names.get(e["name"], 0) + 1
+    return {
+        "events": len(events),
+        "by_ph": by_ph,
+        "nodes": sorted(by_node),
+        "span_counts": by_node,
+        "wall_us": (t_max - t_min) if t_min is not None else 0,
+    }

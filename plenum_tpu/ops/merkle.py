@@ -617,10 +617,12 @@ class ProofPipeline:
     tunnel is the bottleneck)."""
 
     def __init__(self, tree: DeviceMerkleTree, depth: int = 2,
-                 dense: bool = False):
+                 dense: bool = False, tracer=None):
+        from plenum_tpu.observability.tracing import NullTracer
         self._tree = tree
         self._depth = max(1, depth)
         self._dense = dense
+        self._tracer = tracer or NullTracer()
 
     def stream(self, batches, n: Optional[int] = None):
         """Yield one result per index batch, in order. Results are
@@ -633,13 +635,24 @@ class ProofPipeline:
             dispatch = functools.partial(
                 self._tree.dispatch_proof_batch, n=n)
             collect = self._tree.collect_proof_batch
+        from plenum_tpu.observability.tracing import CAT_DEVICE
+        tracer = self._tracer
         pending = deque()
         for batch in batches:
-            pending.append(dispatch(batch))
+            # dispatch span = host-side launch cost; the in-flight
+            # counter shows whether the double-buffering actually keeps
+            # the device busy between collects
+            with tracer.span("proof_dispatch", CAT_DEVICE, n=len(batch)):
+                pending.append(dispatch(batch))
+            tracer.counter("proof_inflight", len(pending))
             if len(pending) >= self._depth:
-                yield collect(pending.popleft())
+                with tracer.span("proof_collect", CAT_DEVICE):
+                    out = collect(pending.popleft())
+                yield out
         while pending:
-            yield collect(pending.popleft())
+            with tracer.span("proof_collect", CAT_DEVICE):
+                out = collect(pending.popleft())
+            yield out
 
     def run(self, indices: Sequence[int], n: Optional[int] = None,
             chunk: int = 4096) -> List[List[bytes]]:

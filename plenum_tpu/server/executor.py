@@ -14,6 +14,7 @@ from plenum_tpu.common.constants import AUDIT_LEDGER_ID
 from plenum_tpu.common.messages.node_messages import Ordered
 from plenum_tpu.common.request import Request
 from plenum_tpu.consensus.ordering_service import BatchExecutor
+from plenum_tpu.observability.tracing import CAT_EXECUTE, NullTracer
 from plenum_tpu.server.three_pc_batch import ThreePcBatch
 from plenum_tpu.server.write_request_manager import WriteRequestManager
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
@@ -41,6 +42,7 @@ class NodeBatchExecutor(BatchExecutor):
         self.write_manager = write_manager
         self._requests_source = requests_source
         self.metrics = NullMetricsCollector()  # node injects the real one
+        self.tracer = NullTracer()             # node injects the real one
         self._get_view_no = get_view_no or (lambda: 0)
         self._primaries_for_view = primaries_for_view or (lambda v: [])
         self._get_pp_seq_no = get_pp_seq_no
@@ -60,7 +62,11 @@ class NodeBatchExecutor(BatchExecutor):
     def apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
                     pp_time: int, pp_digest: str = "",
                     original_view_no: int = None) -> Tuple[str, str, str]:
-        with self.metrics.measure_time(MetricsName.BATCH_APPLY_TIME):
+        with self.metrics.measure_time(MetricsName.BATCH_APPLY_TIME), \
+                self.tracer.span("batch_apply", CAT_EXECUTE,
+                                 key=pp_digest or None,
+                                 batch_size=len(pre_prepare_digests),
+                                 ledger_id=ledger_id):
             return self._apply_batch(pre_prepare_digests, ledger_id,
                                      pp_time, pp_digest, original_view_no)
 
@@ -151,7 +157,11 @@ class NodeBatchExecutor(BatchExecutor):
     # ------------------------------------------------------------- commit
 
     def commit_batch(self, ordered: Ordered):
-        with self.metrics.measure_time(MetricsName.BATCH_COMMIT_TIME):
+        with self.metrics.measure_time(MetricsName.BATCH_COMMIT_TIME), \
+                self.tracer.span(
+                    "batch_commit", CAT_EXECUTE,
+                    key="%d:%d" % (ordered.viewNo, ordered.ppSeqNo),
+                    batch_size=len(ordered.valid_reqIdr)):
             return self._commit_batch(ordered)
 
     def _commit_batch(self, ordered: Ordered):

@@ -52,6 +52,8 @@ from plenum_tpu.native import try_load_ext
 from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
 
 _fp = try_load_ext("fastpath")
+from plenum_tpu.observability.tracing import (
+    CAT_DEVICE, CAT_INTAKE, CAT_REPLY, NullTracer, Tracer)
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
@@ -146,13 +148,22 @@ class Node:
                  bls_bft_replica=None, bls_signer=None,
                  genesis_txns: Optional[List[dict]] = None,
                  on_membership_change: Callable[[List[str]], None] = None,
-                 metrics=None):
+                 metrics=None, tracer=None):
         """network: ExternalBus to peers; client_reply_handler(client_id,
         msg) delivers Acks/Nacks/Replies back to clients."""
         from plenum_tpu.server.observer import Observable
         self.name = name
         self.config = config or Config()
         self.metrics = metrics or NullMetricsCollector()
+        # flight recorder (observability/): one ring-buffer tracer per
+        # node, injected into every instrumented stage below so a 3PC
+        # batch's whole lifecycle lands in one per-node buffer that the
+        # sim pool / trace_view merges into a pool-wide timeline
+        if tracer is None:
+            tracer = Tracer(name=name,
+                            capacity=self.config.TRACING_BUFFER_SPANS) \
+                if self.config.TRACING_ENABLED else NullTracer(name)
+        self.tracer = tracer
         # GC pause/throughput feed (reference gc_trackers.py): one
         # process-wide hook, weakly attached — only worth the callback
         # when a real collector will persist it
@@ -373,6 +384,18 @@ class Node:
             if _staged is not None:
                 _staged.metrics = self.metrics
         self.db_manager.metrics = self.metrics
+        # same single-injection-point pattern for the flight recorder:
+        # every traced seam records into THIS node's ring buffer
+        for _traced in (self.propagator, self.executor, self.replica,
+                        self.replica.ordering, bls_bft_replica):
+            if _traced is not None:
+                _traced.tracer = self.tracer
+        if verifier is not None and hasattr(verifier, "tracer"):
+            # device-dispatch profiling inside the CoalescingVerifierHub
+            # (a hub shared across co-resident nodes keeps whichever
+            # tracer was attached last — one buffer still sees every
+            # fused launch)
+            verifier.tracer = self.tracer
         self.primary_connection_monitor = PrimaryConnectionMonitorService(
             self.replica.data, timer, self.replica.internal_bus, network,
             config=self.config)
@@ -747,8 +770,13 @@ class Node:
         signature. The caller overlaps other work (other nodes\' batches,
         consensus ticks) before conclude_client_batch harvests — this
         hides the device round-trip latency entirely (SURVEY.md §7)."""
-        with self.metrics.measure_time(MetricsName.DEVICE_DISPATCH_TIME):
-            return self._dispatch_client_batch(msgs)
+        with self.metrics.measure_time(MetricsName.DEVICE_DISPATCH_TIME), \
+                self.tracer.span("auth_dispatch", CAT_DEVICE,
+                                 n=len(msgs)) as _sp:
+            pending = self._dispatch_client_batch(msgs)
+            if pending is not None:
+                _sp.add(dispatched=len(pending[0]))
+            return pending
 
     def _dispatch_client_batch(self, msgs: List[Tuple[dict, str]]):
         from plenum_tpu.common.constants import CURRENT_PROTOCOL_VERSION
@@ -785,6 +813,7 @@ class Node:
             return None
         self.metrics.add_event(MetricsName.CLIENT_AUTH_BATCH_SIZE,
                                len(parsed))
+        self.tracer.counter("auth_batch_size", len(parsed))
         handle = self.authnr.dispatch_batch([r for r, _ in parsed])
         return (parsed, handle)
 
@@ -801,7 +830,9 @@ class Node:
         if pending is None:
             return
         parsed, handle = pending
-        with self.metrics.measure_time(MetricsName.CLIENT_AUTH_TIME):
+        with self.metrics.measure_time(MetricsName.CLIENT_AUTH_TIME), \
+                self.tracer.span("auth_conclude", CAT_DEVICE,
+                                 n=len(parsed)):
             results = self.authnr.conclude_batch(handle)
         for (request, client_id), idrs in zip(parsed, results):
             if idrs is None:
@@ -853,6 +884,9 @@ class Node:
                 return
         self._request_spike_accum += 1
         key = request.key
+        # lifecycle root: everything downstream (propagate quorum, 3PC,
+        # reply) correlates back to this digest on the merged timeline
+        self.tracer.instant("request_accepted", CAT_INTAKE, key=key)
         self._req_clients[key] = client_id
         if self._clients_attached:
             # building the Ack (schema-validated message object) only
@@ -955,7 +989,11 @@ class Node:
 
     def _on_batch_committed(self, ordered: Ordered, committed_txns):
         """Send Replies with audit paths; update dedup index; free reqs."""
-        with self.metrics.measure_time(MetricsName.REPLY_TIME):
+        with self.metrics.measure_time(MetricsName.REPLY_TIME), \
+                self.tracer.span(
+                    "reply", CAT_REPLY,
+                    key="%d:%d" % (ordered.viewNo, ordered.ppSeqNo),
+                    txns=len(committed_txns or [])):
             self._on_batch_committed_inner(ordered, committed_txns)
 
     def _on_batch_committed_inner(self, ordered: Ordered, committed_txns):

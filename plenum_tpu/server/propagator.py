@@ -16,6 +16,7 @@ from plenum_tpu.common.messages.node_messages import (
     Propagate, PropagateBatch)
 from plenum_tpu.common.request import Request
 from plenum_tpu.consensus.quorums import Quorums
+from plenum_tpu.observability.tracing import CAT_PROPAGATE, NullTracer
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
@@ -184,6 +185,7 @@ class Propagator:
         self._authenticator = authenticator
         self.requests = Requests()
         self.metrics = NullMetricsCollector()   # node injects the real one
+        self.tracer = NullTracer()              # node injects the real one
         # queued outgoing propagates, flushed as PROPAGATE_BATCH once
         # per tick: at n validators every request is otherwise its own
         # message n-1 times per node — batching is what lets wide pools
@@ -216,7 +218,9 @@ class Propagator:
         queued count."""
         if not self._out:
             return 0
-        with self.metrics.measure_time(MetricsName.PROPAGATE_FLUSH_TIME):
+        with self.metrics.measure_time(MetricsName.PROPAGATE_FLUSH_TIME), \
+                self.tracer.span("propagate_flush", CAT_PROPAGATE,
+                                 n=len(self._out)):
             return self._flush()
 
     def _flush(self) -> int:
@@ -306,15 +310,22 @@ class Propagator:
             self._queue_out(payload, sender_client)
         if not state.forwarded and \
                 self.quorums.propagate.is_reached(len(propagates)):
-            state.finalised = True
-            state.forwarded = True
-            self._forward(state.request)
+            self._finalise(state)
 
     def _try_finalise(self, req_key: str):
         state = self.requests.get(req_key)
         if state is None or state.forwarded:
             return
         if self.quorums.propagate.is_reached(len(state.propagates)):
-            state.finalised = True
-            state.forwarded = True
-            self._forward(state.request)
+            self._finalise(state)
+
+    def _finalise(self, state: ReqState):
+        """Quorum reached: mark, record the lifecycle marker, forward
+        exactly once. The digest access is free here — forwarding hands
+        request.key to the ordering queues anyway."""
+        state.finalised = True
+        state.forwarded = True
+        self.tracer.instant("propagate_quorum", CAT_PROPAGATE,
+                            key=state.request.key,
+                            votes=len(state.propagates))
+        self._forward(state.request)

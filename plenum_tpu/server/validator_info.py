@@ -66,6 +66,7 @@ class ValidatorNodeInfoTool:
             "Memory_info": self._memory_info(),
             "Latencies": self._latencies(),
             "Extractions": self._extractions(),
+            "Tracing": self._tracing_info(),
             "Metrics": (self._metrics.summary()
                         if self._metrics is not None
                         and hasattr(self._metrics, "summary") else {}),
@@ -145,6 +146,15 @@ class ValidatorNodeInfoTool:
         if reqs is not None:
             out["In_flight_requests"] = len(reqs)
         return out
+
+    def _tracing_info(self) -> dict:
+        """Flight-recorder state (observability/): whether tracing is
+        on, ring capacity, records ever written and how many of those
+        wrapped out of the buffer — the numbers that say if a dumped
+        timeline still covers the window you care about."""
+        tracer = getattr(self._node, "tracer", None)
+        stats = getattr(tracer, "stats", None)
+        return stats() if stats is not None else {}
 
     def _hardware_info(self) -> dict:
         out = {}

@@ -33,6 +33,8 @@ from typing import List, Tuple
 
 import msgpack
 
+from plenum_tpu.observability.tracing import CAT_DEVICE, NullTracer
+
 logger = logging.getLogger(__name__)
 
 LEN = struct.Struct("<I")
@@ -69,6 +71,11 @@ class VerifyDaemon:
         self._writers = set()
         self.served = 0
         self.launches = 0
+        # flight recorder: the daemon runs in its own process, so it
+        # gets its own tracer (attach a real one + trace_file to dump
+        # Perfetto timelines of coalescing vs device round trips)
+        self.tracer = NullTracer("verify-daemon")
+        self.trace_file = None
 
     async def start(self):
         self._server = await asyncio.start_server(
@@ -89,6 +96,17 @@ class VerifyDaemon:
                     pass
             await self._server.wait_closed()
         self._pool.shutdown(wait=False)
+        self._dump_trace()
+
+    def _dump_trace(self):
+        if self.trace_file is None or not getattr(
+                self.tracer, "enabled", False):
+            return
+        try:
+            from plenum_tpu.observability.export import export_chrome_trace
+            export_chrome_trace([self.tracer], self.trace_file)
+        except Exception:
+            logger.warning("trace dump failed", exc_info=True)
 
     # ------------------------------------------------------------ conns
 
@@ -150,16 +168,19 @@ class VerifyDaemon:
             # event-driven coalescing: sleep exactly until the next frame
             # or the window deadline — a polling loop would burn the one
             # CPU core the node processes need
-            deadline = loop.time() + self._window
-            while True:
-                remaining = deadline - loop.time()
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(await asyncio.wait_for(
-                        self._queue.get(), remaining))
-                except asyncio.TimeoutError:
-                    break
+            with self.tracer.span("coalesce", CAT_DEVICE) as _csp:
+                deadline = loop.time() + self._window
+                while True:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(await asyncio.wait_for(
+                            self._queue.get(), remaining))
+                    except asyncio.TimeoutError:
+                        break
+                _csp.add(requests=len(batch))
+            self.tracer.counter("verify_queue_depth", self._queue.qsize())
             all_items: List[Tuple[bytes, bytes, bytes]] = []
             spans = []
             for _, _, items in batch:
@@ -186,8 +207,16 @@ class VerifyDaemon:
             logger.debug("batch: %d items (%d unique) from %d requests",
                         len(all_items), len(order), len(batch))
             try:
-                uniq_results = await loop.run_in_executor(
-                    self._pool, self._verify_bucketed, order)
+                # this span IS the device round trip as the loop sees it
+                # (the worker thread serializes launches, so a deep span
+                # here means the NEXT batch coalesced under it — exactly
+                # the pipelining the timeline should show)
+                with self.tracer.span("device_verify", CAT_DEVICE,
+                                      items=len(all_items),
+                                      unique=len(order),
+                                      requests=len(batch)):
+                    uniq_results = await loop.run_in_executor(
+                        self._pool, self._verify_bucketed, order)
                 results = [uniq_results[i] for i in index]
             except Exception:
                 logger.warning("verify batch failed", exc_info=True)
@@ -220,13 +249,25 @@ class VerifyDaemon:
                         writer.transport.abort()
                 except Exception:
                     pass
+            if self.trace_file is not None and self.launches % 25 == 0:
+                # periodic (SIGTERM skips stop()), AFTER the replies are
+                # written and on a side thread: serializing 64k ring
+                # records must neither hold back computed results nor
+                # stall the event loop's frame reads — either would
+                # distort the very latencies being traced
+                await loop.run_in_executor(None, self._dump_trace)
 
 
 async def run_daemon(host="127.0.0.1", port=0, backend="adaptive",
                      ready_file=None, window: float = 0.002,
-                     bucket: int = 4096, cpu_floor: int = 512):
+                     bucket: int = 4096, cpu_floor: int = 512,
+                     trace_file=None):
     daemon = VerifyDaemon(host, port, backend, window=window,
                           bucket=bucket, cpu_floor=cpu_floor)
+    if trace_file:
+        from plenum_tpu.observability.tracing import Tracer
+        daemon.tracer = Tracer("verify-daemon")
+        daemon.trace_file = trace_file
     await daemon.start()
     if ready_file:
         with open(ready_file, "w") as f:
@@ -246,6 +287,10 @@ def main():  # pragma: no cover - exercised via subprocess in bench
     ap.add_argument("--cpu-floor", type=int, default=512)
     ap.add_argument("--ready-file", default=None,
                     help="write the bound port here once listening")
+    ap.add_argument("--trace-file", default=None,
+                    help="record coalesce/device spans and dump a "
+                         "Chrome trace-event JSON here (periodically "
+                         "and on clean stop)")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
     if args.backend != "cpu":
@@ -256,7 +301,7 @@ def main():  # pragma: no cover - exercised via subprocess in bench
         enable_persistent_compilation_cache()
     asyncio.run(run_daemon(args.host, args.port, args.backend,
                            args.ready_file, args.window, args.bucket,
-                           args.cpu_floor))
+                           args.cpu_floor, trace_file=args.trace_file))
 
 
 if __name__ == "__main__":  # pragma: no cover

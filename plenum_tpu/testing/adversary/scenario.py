@@ -1,11 +1,26 @@
 """Scenario runner: drives a sim pool tick by tick, evaluating the
 safety invariant checkers after EVERY tick, with bounded-window
-liveness assertions for the recovery phase of a fault plan."""
+liveness assertions for the recovery phase of a fault plan.
+
+When a safety invariant fails and the pool is traced (Config
+TRACING_ENABLED), the runner automatically dumps the merged pool
+flight-recorder timeline (observability/) next to the failure — the
+ring buffers hold exactly the window leading up to the violation.
+Override the directory with PLENUM_TPU_TRACE_DIR."""
 from __future__ import annotations
 
+import logging
+import os
+import tempfile
 from typing import Callable, List, Optional
 
 from plenum_tpu.testing.adversary.invariants import InvariantChecker
+
+logger = logging.getLogger(__name__)
+
+# process-wide dump counter: two failing scenarios in one process (e.g.
+# one pytest run) must not overwrite each other's timelines
+_dump_seq = [0]
 
 
 class LivenessViolation(AssertionError):
@@ -60,7 +75,42 @@ class Scenario:
         for node in self.nodes:
             node.service()
         self.timer.run_for(self.step)
-        self.checker.check()
+        try:
+            self.checker.check()
+        except AssertionError as e:
+            path = self.dump_trace()
+            if path:
+                logger.error("safety invariant failed — flight-recorder "
+                             "timeline dumped to %s (load in "
+                             "ui.perfetto.dev)", path)
+                if e.args and isinstance(e.args[0], str):
+                    e.args = ("%s [flight recorder: %s]"
+                              % (e.args[0], path),) + e.args[1:]
+            raise
+
+    def dump_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Merge every traced node's ring buffer into one pool-wide
+        Chrome trace-event file. → path, or None when no node has
+        tracing enabled."""
+        from plenum_tpu.observability.export import (
+            export_chrome_trace, pool_tracers)
+        tracers = [t for t in pool_tracers(self.nodes)
+                   if getattr(t, "enabled", False)]
+        if not tracers:
+            return None
+        if path is None:
+            out_dir = os.environ.get("PLENUM_TPU_TRACE_DIR") \
+                or tempfile.gettempdir()
+            _dump_seq[0] += 1
+            path = os.path.join(
+                out_dir, "invariant_failure_trace_%d_%d.json"
+                % (os.getpid(), _dump_seq[0]))
+        try:
+            return export_chrome_trace(tracers, path)
+        except OSError:
+            logger.warning("could not write flight-recorder trace to %s",
+                           path, exc_info=True)
+            return None
 
     # ------------------------------------------------- liveness helpers
 

@@ -12,9 +12,10 @@ from __future__ import annotations
 import struct
 import time
 from abc import ABC, abstractmethod
+from collections import deque
 from contextlib import contextmanager
 from enum import IntEnum
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Deque, Dict, Iterator, Optional, Tuple
 
 
 class MetricsName(IntEnum):
@@ -163,17 +164,26 @@ class KvStoreMetricsCollector(MetricsCollector):
     value = packed (ts, name, count, sum, min, max)."""
 
     def __init__(self, storage, get_time=time.time,
-                 max_records: int = 100_000):
+                 max_records: Optional[int] = 100_000):
+        """max_records=None disables retention entirely — the mode for
+        READ-ONLY consumers (scripts/metrics_stats): a reporting tool
+        must never trim a live node's history on open."""
         super().__init__(get_time)
         self._storage = storage
         self._seq = 0
         self._max_records = max_records
-        self._record_keys = []          # insertion order, for retention
+        # insertion order (keys sort by flush timestamp), for retention
+        self._record_keys: Deque[bytes] = deque()
         # running per-metric totals so summary() is O(metrics), not
-        # O(stored history); seeded from whatever is already on disk
+        # O(stored history); BOTH the totals and the retention index are
+        # seeded from whatever is already on disk — an unseeded index
+        # would make the max_records cap count only this run's records,
+        # letting prior-run history survive every restart untrimmed
         self._totals: Dict[int, ValueAccumulator] = {}
-        for _ts, name, acc in self.events():
+        for key, _ts, name, acc in self._iter_records():
             self._totals.setdefault(name, ValueAccumulator()).merge(acc)
+            self._record_keys.append(key)
+        self._trim()   # cap may have shrunk since the records landed
 
     def _store(self, ts: float, name: int, acc: ValueAccumulator):
         key = struct.pack(">QI", int(ts * 1e6), self._seq)
@@ -186,21 +196,36 @@ class KvStoreMetricsCollector(MetricsCollector):
         # retention: drop oldest records past the cap (totals keep the
         # all-time aggregate; only the per-flush history is trimmed)
         self._record_keys.append(key)
+        self._trim()
+
+    def _trim(self):
+        if self._max_records is None:
+            return
         while len(self._record_keys) > self._max_records:
-            old = self._record_keys.pop(0)
+            old = self._record_keys.popleft()
             try:
                 self._storage.remove(old)
             except Exception:
+                # a store that refuses removal keeps the record AND its
+                # index entry — retrying next flush beats losing track
+                self._record_keys.appendleft(old)
                 break
 
-    def events(self) -> Iterator[Tuple[float, int, ValueAccumulator]]:
-        for _key, value in self._storage.iterator():
+    def _iter_records(self) -> Iterator[
+            Tuple[bytes, float, int, ValueAccumulator]]:
+        """Decode every stored record — the ONE place that understands
+        the on-disk format (restart seeding and events() both ride it)."""
+        for key, value in self._storage.iterator():
             if len(value) != _RECORD.size:
                 continue
             ts, name, count, total, mn, mx = _RECORD.unpack(value)
             acc = ValueAccumulator()
             acc.count, acc.sum = count, total
             acc.min, acc.max = mn, mx
+            yield bytes(key), ts, name, acc
+
+    def events(self) -> Iterator[Tuple[float, int, ValueAccumulator]]:
+        for _key, ts, name, acc in self._iter_records():
             yield ts, name, acc
 
     def summary(self) -> Dict[str, dict]:

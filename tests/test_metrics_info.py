@@ -82,6 +82,64 @@ def test_kv_collector_reload_seeds_totals():
     assert c2.summary()["ORDERED_BATCH_COMMITTED"]["sum"] == 7
 
 
+def test_kv_collector_reload_seeds_retention_index():
+    """A restarted collector must count PRIOR-RUN records against
+    max_records: without reseeding the key index, old history would
+    survive every restart untrimmed."""
+    storage = KeyValueStorageInMemory()
+    ts = [1000.0]
+    c1 = KvStoreMetricsCollector(storage, get_time=lambda: ts[0],
+                                 max_records=10)
+    for _ in range(8):
+        ts[0] += 1
+        c1.add_event(MetricsName.NODE_PROD_TIME, 1.0)
+        c1.flush_accumulated()
+    assert len(list(c1.events())) == 8
+    c2 = KvStoreMetricsCollector(storage, get_time=lambda: ts[0],
+                                 max_records=10)   # restart
+    for _ in range(5):
+        ts[0] += 1
+        c2.add_event(MetricsName.NODE_PROD_TIME, 1.0)
+        c2.flush_accumulated()
+    # 8 old + 5 new, cap 10: the 3 oldest prior-run records are gone
+    events = list(c2.events())
+    assert len(events) == 10
+    assert min(ts for ts, _, _ in events) == 1004.0
+    # the all-time totals still cover everything ever recorded
+    assert c2.summary()["NODE_PROD_TIME"]["count"] == 13
+    # a restart under a SMALLER cap trims down immediately
+    c3 = KvStoreMetricsCollector(storage, get_time=lambda: ts[0],
+                                 max_records=4)
+    assert len(list(c3.events())) == 4
+
+
+def test_every_metrics_name_is_referenced_in_source():
+    """Dead-name check: every MetricsName member must be referenced
+    somewhere under plenum_tpu/ (grep-based), so the enum cannot drift
+    from the instrumentation. GC_GEN1/GEN2_TIME are reached
+    arithmetically (gc_tracker.py: GC_GEN0_TIME + generation) — for
+    those the test pins the consecutive-value layout they rely on."""
+    import pathlib
+    import re
+
+    import plenum_tpu
+
+    pkg = pathlib.Path(plenum_tpu.__file__).parent
+    enum_file = pkg / "utils" / "metrics.py"
+    blob = "\n".join(p.read_text() for p in sorted(pkg.rglob("*.py"))
+                     if p != enum_file)
+    arithmetic = {"GC_GEN1_TIME", "GC_GEN2_TIME"}
+    assert MetricsName.GC_GEN1_TIME == MetricsName.GC_GEN0_TIME + 1
+    assert MetricsName.GC_GEN2_TIME == MetricsName.GC_GEN0_TIME + 2
+    assert re.search(r"\bGC_GEN0_TIME\b", blob)
+    missing = [m.name for m in MetricsName
+               if m.name not in arithmetic
+               and not re.search(r"\b%s\b" % m.name, blob)]
+    assert not missing, \
+        "MetricsName members never referenced under plenum_tpu/ " \
+        "(instrument them or delete them): %s" % missing
+
+
 def test_null_collector_is_free():
     collector = NullMetricsCollector()
     collector.add_event(MetricsName.NODE_PROD_TIME, 1.0)

@@ -1,0 +1,280 @@
+"""Consensus flight recorder (observability/): ring tracer, Perfetto
+export, pool-wide merged timeline, invariant-failure dumps."""
+import json
+import os
+
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.observability.export import (
+    chrome_trace, export_chrome_trace, pool_tracers, summarize,
+    trace_events)
+from plenum_tpu.observability.tracing import (
+    CAT_3PC, CAT_DEVICE, NullTracer, Tracer)
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.sim_network import SimNetwork
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+
+
+# ------------------------------------------------------------- tracer
+
+
+def _ticking_clock(step=0.001):
+    t = [0.0]
+
+    def clock():
+        t[0] += step
+        return t[0]
+    return clock
+
+
+def test_ring_buffer_wraparound_keeps_newest():
+    tracer = Tracer("n1", capacity=8, clock=_ticking_clock())
+    for i in range(20):
+        tracer.instant("e%d" % i)
+    recs = tracer.spans()
+    assert len(recs) == 8
+    # flight-recorder semantics: the NEWEST records survive, in order
+    assert [r[1] for r in recs] == ["e%d" % i for i in range(12, 20)]
+    stats = tracer.stats()
+    assert stats["recorded"] == 20
+    assert stats["buffered"] == 8
+    assert stats["dropped"] == 12
+
+
+def test_span_context_manager_records_payload_and_times():
+    tracer = Tracer("n1", capacity=4, clock=_ticking_clock())
+    with tracer.span("work", CAT_3PC, key="0:1", batch=3) as sp:
+        sp.add(extra=7)
+    (kind, name, cat, t0, t1, key, args), = tracer.spans()
+    assert (kind, name, cat, key) == ("X", "work", CAT_3PC, "0:1")
+    assert t1 > t0
+    assert args == {"batch": 3, "extra": 7}
+
+
+def test_counter_and_instant_records():
+    tracer = Tracer("n1", capacity=4, clock=_ticking_clock())
+    tracer.counter("depth", 5)
+    tracer.instant("mark", CAT_DEVICE, key="d1", hits=1)
+    counter, instant = tracer.spans()
+    assert counter[0] == "C" and counter[6] == {"depth": 5}
+    assert instant[0] == "i" and instant[5] == "d1"
+
+
+def test_tracer_clear_resets_stats():
+    tracer = Tracer("n1", capacity=4, clock=_ticking_clock())
+    tracer.instant("a")
+    tracer.clear()
+    assert tracer.spans() == []
+    assert tracer.stats()["recorded"] == 0
+
+
+def test_null_tracer_emits_nothing_and_is_reusable():
+    tracer = NullTracer("n")
+    with tracer.span("x", CAT_3PC, key="k", a=1) as sp:
+        sp.add(b=2)   # the shared null ctx must absorb payload calls
+    tracer.instant("i")
+    tracer.counter("c", 1)
+    assert tracer.spans() == []
+    assert tracer.stats()["enabled"] is False
+    assert tracer.enabled is False
+
+
+# ------------------------------------------------------------ exporter
+
+
+def _fixed_trace():
+    tracer = Tracer("Alpha", capacity=16, clock=_ticking_clock())
+    with tracer.span("pp_process", CAT_3PC, key="0:1", batch_size=2):
+        pass
+    tracer.counter("auth_batch_size", 3)
+    tracer.instant("prepared", CAT_3PC, key="0:1")
+    with tracer.span("auth_dispatch", CAT_DEVICE, n=3):
+        pass
+    return tracer
+
+
+def test_exporter_deterministic_under_fixed_clock():
+    a = chrome_trace([_fixed_trace()])
+    b = chrome_trace([_fixed_trace()])
+    assert a == b
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_exporter_event_shapes():
+    events = trace_events([_fixed_trace()])
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+    # process_name + one thread_name per category
+    meta_names = {e["name"] for e in by_ph["M"]}
+    assert meta_names == {"process_name", "thread_name"}
+    x = next(e for e in by_ph["X"] if e["name"] == "pp_process")
+    assert x["ts"] >= 0 and x["dur"] > 0
+    assert x["args"]["key"] == "0:1" and x["args"]["batch_size"] == 2
+    c, = by_ph["C"]
+    assert c["args"] == {"auth_batch_size": 3}
+    i, = by_ph["i"]
+    assert i["s"] == "t" and i["args"]["key"] == "0:1"
+    # categories become distinct tracks within the node's pid
+    pid = x["pid"]
+    device = next(e for e in by_ph["X"] if e["name"] == "auth_dispatch")
+    assert device["pid"] == pid and device["tid"] != x["tid"]
+
+
+def test_exporter_skips_empty_and_null_tracers():
+    doc = chrome_trace([NullTracer("a"), Tracer("b", capacity=4)])
+    assert doc["traceEvents"] == []
+
+
+# ---------------------------------------------------------- pool merge
+
+
+@pytest.fixture
+def traced_pool(mock_timer):
+    mock_timer.set_time(1600000000)
+    net = SimNetwork(mock_timer, DefaultSimRandom(11))
+    conf = Config(TRACING_ENABLED=True, Max3PCBatchSize=10,
+                  Max3PCBatchWait=0.2, CHK_FREQ=5, LOG_SIZE=15)
+    nodes = [Node(n, NAMES, mock_timer, net.create_peer(n), config=conf,
+                  client_reply_handler=lambda c, m: None)
+             for n in NAMES]
+    return nodes, mock_timer
+
+
+def _order_one_batched(nodes, timer):
+    client = SimpleSigner(seed=b"\x55" * 32)
+    req = {"identifier": client.identifier, "reqId": 1,
+           "protocolVersion": 2,
+           "operation": {"type": NYM, TARGET_NYM: client.identifier,
+                         VERKEY: client.verkey}}
+    req["signature"] = client.sign(dict(req))
+    for n in nodes:
+        n.process_client_batch([(dict(req), "c1")])
+    end = timer.get_current_time() + 8.0
+    while timer.get_current_time() < end:
+        for n in nodes:
+            n.service()
+        timer.run_for(0.05)
+        if all(n.domain_ledger.size >= 1 for n in nodes):
+            break
+
+
+def test_sim_pool_merged_timeline_has_every_3pc_phase(traced_pool, tdir):
+    nodes, timer = traced_pool
+    _order_one_batched(nodes, timer)
+    assert all(n.domain_ledger.size >= 1 for n in nodes)
+    doc = chrome_trace(pool_tracers(nodes))
+    summary = summarize(doc)
+    assert sorted(summary["nodes"]) == sorted(NAMES)
+    for name in NAMES:
+        spans = summary["span_counts"][name]
+        # the batch lifecycle, per node: intake -> propagate quorum ->
+        # PP -> prepare -> commit -> order -> apply -> commit -> reply
+        assert spans.get("request_accepted", 0) >= 1, (name, spans)
+        assert spans.get("propagate_quorum", 0) >= 1, (name, spans)
+        assert spans.get("pp_create", 0) + spans.get("pp_process", 0) \
+            >= 1, (name, spans)
+        assert spans.get("prepare_process", 0) >= 1, (name, spans)
+        assert spans.get("prepared", 0) >= 1, (name, spans)
+        assert spans.get("commit_process", 0) >= 1, (name, spans)
+        assert spans.get("order", 0) >= 1, (name, spans)
+        assert spans.get("batch_apply", 0) >= 1, (name, spans)
+        assert spans.get("batch_commit", 0) >= 1, (name, spans)
+        assert spans.get("reply", 0) >= 1, (name, spans)
+        # device-dispatch seam + its queue-depth counter
+        assert spans.get("auth_dispatch", 0) >= 1, (name, spans)
+        assert spans.get("auth_conclude", 0) >= 1, (name, spans)
+        assert spans.get("auth_batch_size", 0) >= 1, (name, spans)
+    # exactly one primary created the batch; all correlate by 3PC key
+    assert sum(summary["span_counts"][n].get("pp_create", 0)
+               for n in NAMES) >= 1
+    keys = {e["args"]["key"] for e in doc["traceEvents"]
+            if e.get("name") == "order"}
+    assert len(keys) >= 1
+    # the file round-trips as valid JSON
+    path = export_chrome_trace(pool_tracers(nodes),
+                               os.path.join(tdir, "trace.json"))
+    with open(path) as f:
+        assert json.load(f)["traceEvents"]
+
+
+def test_tracing_disabled_pool_records_nothing(mock_timer):
+    mock_timer.set_time(1600000000)
+    net = SimNetwork(mock_timer, DefaultSimRandom(12))
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15)   # TRACING_ENABLED defaults off
+    nodes = [Node(n, NAMES, mock_timer, net.create_peer(n), config=conf,
+                  client_reply_handler=lambda c, m: None)
+             for n in NAMES]
+    _order_one_batched(nodes, mock_timer)
+    assert all(not t.enabled and t.spans() == []
+               for t in pool_tracers(nodes))
+    assert chrome_trace(pool_tracers(nodes))["traceEvents"] == []
+
+
+def test_validator_info_reports_tracing_stats(traced_pool):
+    from plenum_tpu.server.validator_info import ValidatorNodeInfoTool
+    nodes, timer = traced_pool
+    _order_one_batched(nodes, timer)
+    info = ValidatorNodeInfoTool(nodes[0]).info
+    tr = info["Tracing"]
+    assert tr["enabled"] is True
+    assert tr["recorded"] >= 1
+    assert tr["capacity"] == nodes[0].config.TRACING_BUFFER_SPANS
+
+
+# ------------------------------------------------- invariant-dump hook
+
+
+class _Boom:
+    def __init__(self):
+        self.calls = 0
+
+    def check(self):
+        self.calls += 1
+        if self.calls >= 2:
+            raise AssertionError("agreement violated (test)")
+
+
+class _StubNode:
+    def __init__(self, name, tracer):
+        self.name = name
+        self.tracer = tracer
+
+    def service(self):
+        self.tracer.instant("tick", CAT_3PC)
+
+
+def test_scenario_dumps_flight_recorder_on_invariant_failure(
+        mock_timer, tdir, monkeypatch):
+    from plenum_tpu.testing.adversary.scenario import Scenario
+    monkeypatch.setenv("PLENUM_TPU_TRACE_DIR", tdir)
+    nodes = [_StubNode("A", Tracer("A", capacity=16)),
+             _StubNode("B", Tracer("B", capacity=16))]
+    scenario = Scenario(mock_timer, nodes, honest=["A", "B"],
+                        checker=_Boom())
+    with pytest.raises(AssertionError) as exc:
+        scenario.run(5.0)
+    assert "flight recorder" in str(exc.value)
+    dumps = [f for f in os.listdir(tdir)
+             if f.startswith("invariant_failure_trace")]
+    assert len(dumps) == 1
+    with open(os.path.join(tdir, dumps[0])) as f:
+        doc = json.load(f)
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"A", "B"}
+
+
+def test_scenario_without_tracing_raises_plain(mock_timer):
+    from plenum_tpu.testing.adversary.scenario import Scenario
+    nodes = [_StubNode("A", NullTracer("A"))]
+    scenario = Scenario(mock_timer, nodes, honest=["A"], checker=_Boom())
+    with pytest.raises(AssertionError) as exc:
+        scenario.run(5.0)
+    assert "flight recorder" not in str(exc.value)
