@@ -97,11 +97,13 @@ def make_requests(n, signer):
 
 
 def make_sim_pool(names, verifier_name, seed=7, batch=None,
-                  tracing=False):
+                  tracing=False, mesh=True):
     """Build an n-node sim pool with the given verification provider
     (shared scaffolding for the 4-node headline and 25-node backlog
     configs — one drain/hub wiring to maintain). tracing=True turns on
-    the flight recorder (observability/) for the overhead config."""
+    the flight recorder (observability/) for the overhead config;
+    mesh=False pins the device-mesh dispatcher off (Node bootstrap
+    applies MESH_* to the process-wide mesh) for the on/off configs."""
     from plenum_tpu.common.config import Config
     from plenum_tpu.crypto.batch_verifier import create_verifier
     from plenum_tpu.runtime.sim_random import DefaultSimRandom
@@ -116,7 +118,7 @@ def make_sim_pool(names, verifier_name, seed=7, batch=None,
     conf = Config(Max3PCBatchSize=batch or CLIENT_BATCH,
                   Max3PCBatchWait=0.05,
                   CHK_FREQ=10, LOG_SIZE=30, HEARTBEAT_FREQ=10 ** 6,
-                  TRACING_ENABLED=tracing)
+                  TRACING_ENABLED=tracing, MESH_ENABLED=mesh)
     nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
              for name in names]
     if verifier_name == "tpu_hub":
@@ -766,7 +768,7 @@ def micro_merkle(n_leaves=None):
     }
 
 
-def pool25_backlog(provider=None):
+def pool25_backlog(provider=None, mesh=True):
     """BASELINE config 5: 25-node simulated pool, mixed read/write
     against a 50k-request backlog. Default provider is the shared TPU
     coalescing hub; provider="cpu" runs the IDENTICAL config on the
@@ -790,7 +792,8 @@ def pool25_backlog(provider=None):
     # no client_reply_handler: the headline config skips Reply-payload
     # construction too, keeping the two pools comparable
     provider = provider or "tpu_hub"
-    nodes, timer = make_sim_pool(names, provider, seed=25, batch=batch)
+    nodes, timer = make_sim_pool(names, provider, seed=25, batch=batch,
+                                 mesh=mesh)
     reads_served = [0]
 
     signer = SimpleSigner(seed=b"\x26" * 32)
@@ -851,8 +854,19 @@ def pool25_backlog(provider=None):
 def pool25_both():
     """TPU hub vs CPU verify on the identical 25-node config; the CPU
     side gets the same wall budget, so not-drained shows up as a lower
-    sustained rate rather than a disqualified run."""
+    sustained rate rather than a disqualified run. On a multi-chip host
+    the hub config also runs mesh-off so the mesh's contribution to the
+    fused-launch rate is measured, not assumed (one chip: on/off are
+    the same passthrough path, so the off run is skipped)."""
+    from plenum_tpu.ops import mesh as mesh_mod
     tpu = pool25_backlog("tpu_hub")
+    mesh = mesh_mod.get_mesh()
+    tpu["mesh_devices"] = mesh.n_devices
+    if mesh.n_devices > 1:
+        off = pool25_backlog("tpu_hub", mesh=False)
+        tpu["mesh_off_write_req_per_s"] = off["write_req_per_s"]
+        tpu["mesh_speedup"] = round(
+            tpu["write_req_per_s"] / max(1e-9, off["write_req_per_s"]), 2)
     cpu = pool25_backlog("cpu")
     tpu["cpu_write_req_per_s"] = cpu["write_req_per_s"]
     tpu["cpu_mixed_req_per_s"] = cpu["mixed_req_per_s"]
@@ -860,6 +874,96 @@ def pool25_both():
     tpu["vs_cpu"] = round(
         tpu["write_req_per_s"] / max(1e-9, cpu["write_req_per_s"]), 2)
     return tpu
+
+
+def micro_mesh():
+    """Device-mesh dispatch layer (ops/mesh.py): the single-device
+    overhead gate, plus a per-device-count weak-scaling sweep through
+    the REAL dispatcher when this host has more than one chip (the
+    8-virtual-device CPU sweep lives in the MULTICHIP harness,
+    __graft_entry__.dryrun_multichip).
+
+    The overhead gate compares the production verify path with the mesh
+    consulted-and-passing-through against the mesh disabled outright —
+    the wiring a single-chip host pays on every dispatch. Must stay
+    under 5% (it is one predicate + a counter bump; anything more means
+    the seam regressed)."""
+    import numpy as np
+    from plenum_tpu.crypto.fixtures import make_signed_batch
+    from plenum_tpu.ops import ed25519_jax as edj
+    from plenum_tpu.ops import mesh as mesh_mod
+
+    m = mesh_mod.get_mesh()
+    out = {"devices": m.n_devices,
+           "platform": mesh_mod.probe_platform(),
+           "shard_min": m.shard_min}
+    batch = min(MICRO_BATCH, 8192)
+    msgs, sigs, vks = make_signed_batch(batch, seed=11, unique=256,
+                                        msg_prefix=b"mesh")
+    prior = (m.enabled, m.shard_min, m.max_devices)
+    try:
+        # passthrough (mesh consulted, gate declines) vs mesh disabled:
+        # interleaved best-of so box-load drift hits both sides
+        mesh_mod.configure(enabled=True, shard_min=batch + 1)
+        edj.verify_batch(msgs, sigs, vks)  # warm/compile
+        on_times, off_times = [], []
+        for _ in range(3):
+            mesh_mod.configure(enabled=True)
+            t0 = time.perf_counter()
+            edj.verify_batch(msgs, sigs, vks)
+            on_times.append(time.perf_counter() - t0)
+            mesh_mod.configure(enabled=False)
+            t0 = time.perf_counter()
+            edj.verify_batch(msgs, sigs, vks)
+            off_times.append(time.perf_counter() - t0)
+        overhead = 100.0 * (min(on_times) / min(off_times) - 1.0)
+        out["single_device_overhead_pct"] = round(overhead, 2)
+        out["overhead_gate_pct"] = 5.0
+        out["within_gate"] = overhead < 5.0
+
+        if m.n_devices > 1:
+            # weak scaling through verify_batch_async (per-device batch
+            # constant): efficiency(d) = rate(d) / (d * rate(1)). Its
+            # own fixture batch — per_dev * n_devices can exceed the
+            # overhead batch, and a short slice would silently shrink
+            # the launch while n still claimed the full size
+            n_dev_all = m.n_devices
+            per_dev = max(512, batch // n_dev_all)
+            wm, ws, wv = make_signed_batch(per_dev * n_dev_all, seed=11,
+                                           unique=256, msg_prefix=b"mesh")
+            sweep = {}
+            d = 1
+            while d <= n_dev_all:
+                mesh_mod.configure(enabled=True, max_devices=d,
+                                   shard_min=1)
+                m.reset_devices()
+                n = per_dev * d
+                sm, ss, sv = wm[:n], ws[:n], wv[:n]
+                edj.verify_batch(sm, ss, sv)  # warm/compile
+
+                def run(sm=sm, ss=ss, sv=sv):
+                    pend = []
+                    for _ in range(4):
+                        pend.append(edj.verify_batch_async(sm, ss, sv))
+                        if len(pend) > 2:
+                            np.asarray(pend.pop(0)[0])
+                    for h in pend:
+                        np.asarray(h[0])
+
+                t = best_time(run, runs=3)
+                sweep[str(d)] = {"batch": n,
+                                 "verify_per_s": round(4 * n / t, 1)}
+                d *= 2
+            r1 = sweep["1"]["verify_per_s"]
+            for d_str, entry in sweep.items():
+                entry["scaling_efficiency_vs_1"] = round(
+                    entry["verify_per_s"] / (int(d_str) * r1), 3)
+            out["weak_scaling"] = sweep
+    finally:
+        mesh_mod.configure(enabled=prior[0], shard_min=prior[1],
+                           max_devices=prior[2])
+        m.reset_devices()
+    return out
 
 
 def micro_bls():
@@ -1043,6 +1147,7 @@ def main():
     (device_rate, device_rate_median, ed_single_shot, ed_single_shot_med,
      openssl_rate, python_rate, ed_sweep) = micro_ed25519()
     mk = micro_merkle()
+    mesh_res = micro_mesh()
     bls_results = micro_bls()
     p25 = pool25_both()
 
@@ -1084,6 +1189,7 @@ def main():
             },
             "vs_openssl_core": round(device_rate / openssl_rate, 2),
             "merkle": mk,
+            "mesh": mesh_res,
             "bls": bls_results,
             "pool25_backlog": p25,
             "tracing_overhead": tracing,
@@ -1108,6 +1214,9 @@ def main():
             "pool25_mixed_req_per_s": p25.get("mixed_req_per_s")
             if isinstance(p25, dict) else None,
             "tracing_overhead_pct": tracing["overhead_pct"],
+            "mesh_devices": mesh_res["devices"],
+            "mesh_overhead_pct": mesh_res.get(
+                "single_device_overhead_pct"),
         }
     }, separators=(",", ":")))
 

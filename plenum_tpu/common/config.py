@@ -154,6 +154,20 @@ class Config:
     SHA256_TPU_MIN_BATCH = 256
     BLS_PROVIDER = "cpu"
 
+    # batch size at which AdaptiveVerifier / CoalescingVerifierHub leave
+    # the scalar CPU floor for a device launch (single-sourced here,
+    # like the MERKLE_DEVICE_* knobs)
+    VERIFIER_BATCH_THRESHOLD = 32
+
+    # ---- device-mesh crypto dispatch (ops/mesh.py): shard verify /
+    # BLS-aggregate / merkle batches over every available chip on the
+    # batch axis (zero collectives — the kernels are row-wise pure).
+    # Single-device hosts and batches below MESH_SHARD_MIN take the
+    # passthrough path (bench-gated <5% overhead).
+    MESH_ENABLED = True
+    MESH_MAX_DEVICES = 0         # 0 = all devices (rounded down to 2^k)
+    MESH_SHARD_MIN = 2048        # below this one chip wins on latency
+
     # ---- metrics
     METRICS_COLLECTOR_TYPE = None
 

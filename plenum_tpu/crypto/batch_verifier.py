@@ -122,13 +122,24 @@ class JaxBatchVerifier:
         return _PendingDevice(ok_dev, valid, n)
 
 
+def _default_threshold(threshold):
+    """Single-source the scalar-vs-device batch threshold from
+    Config.VERIFIER_BATCH_THRESHOLD (like the MERKLE_DEVICE_* knobs);
+    an explicit ctor argument still wins."""
+    if threshold is not None:
+        return threshold
+    from plenum_tpu.common.config import Config
+    return Config.VERIFIER_BATCH_THRESHOLD
+
+
 class AdaptiveVerifier:
-    """Scalar floor below `threshold` items, device batch above."""
+    """Scalar floor below `threshold` items, device batch above
+    (default: Config.VERIFIER_BATCH_THRESHOLD)."""
 
     name = "adaptive"
 
-    def __init__(self, threshold: int = 32, scalar=None, batch=None):
-        self.threshold = threshold
+    def __init__(self, threshold: int = None, scalar=None, batch=None):
+        self.threshold = _default_threshold(threshold)
         self._scalar = scalar or OpenSSLVerifier()
         self._batch = batch or JaxBatchVerifier()
 
@@ -225,10 +236,10 @@ class CoalescingVerifierHub:
 
     name = "tpu_hub"
 
-    def __init__(self, batch=None, scalar=None, threshold: int = 32):
+    def __init__(self, batch=None, scalar=None, threshold: int = None):
         self._batch = batch or JaxBatchVerifier()
         self._scalar = scalar or OpenSSLVerifier()
-        self.threshold = threshold
+        self.threshold = _default_threshold(threshold)
         self._gen = _HubGeneration()
         self.tracer = NullTracer()   # node/bench attaches a recorder
 

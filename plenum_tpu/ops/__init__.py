@@ -8,6 +8,15 @@ behind pluggable provider seams (SURVEY.md §2.9).
 import os
 
 
+def pow2_at_least(n: int) -> int:
+    """Smallest power of two >= n — the shared bucket-rounding rule for
+    batch padding (ops/mesh.py) and tree capacity (ops/merkle.py)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
 def enable_persistent_compilation_cache(path: str = None) -> str:
     """Point XLA's persistent compilation cache at `path` (default:
     <repo>/.jax_cache). The big verify buckets take 30-110s to compile;

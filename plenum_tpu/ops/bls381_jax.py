@@ -26,8 +26,11 @@ generic 381-bit prime):
    flow, exactly what XLA wants. (E(Fq) has odd order, so the formulas
    are complete on the whole curve.)
  - Aggregation is a log2(n) tree reduction over the share axis; the
-   batch axis is embarrassingly parallel, so `jax.sharding` over jobs
-   scales across a device mesh with zero collectives.
+   batch axis is embarrassingly parallel, so `aggregate_dispatch`
+   shards the job axis across the device mesh through the production
+   dispatcher (ops/mesh.py) with zero collectives — job batches at or
+   above `Config.MESH_SHARD_MIN` on a multi-chip host are identity-
+   padded per device and launched as one SPMD program.
 
 The scalar/native paths stay authoritative for single aggregates (a
 device dispatch costs more than one 100-share aggregate on CPU). This
@@ -433,20 +436,33 @@ def aggregate_dispatch(jobs, n: int):
     """Device-async building block for pipelined benchmarking and the
     verify-hub path: returns the un-awaited device arrays for a batch
     of jobs padded to a common (static) width n. Short jobs are padded
-    with compressed-infinity shares (identity under addition)."""
+    with compressed-infinity shares (identity under addition).
+
+    Job batches clearing the mesh gate (ops/mesh.py) shard the job
+    axis over every chip: padding JOBS are all-infinity share sets
+    (decode valid, aggregate to the identity) and their rows are
+    sliced off lazily, so collect sees exactly B results."""
     B = len(jobs)
-    raw = np.zeros((B, n, 48), dtype=np.uint8)
+    from plenum_tpu.ops import mesh as mesh_mod
+    m = mesh_mod.get_mesh()
+    sharded = m.should_shard(B)
+    Bp = m.padded_size(B, min_per_device=1) if sharded else B
+    raw = np.zeros((Bp, n, 48), dtype=np.uint8)
     raw[:, :, 0] = 0xC0
     for i, job in enumerate(jobs):
         for j, s in enumerate(job):
             raw[i, j] = np.frombuffer(s, dtype=np.uint8)
     limbs, sign_big, is_inf, valid = pack_compressed(
-        raw.reshape(B * n, 48))
-    return _aggregate_kernel(
-        jnp.asarray(limbs.reshape(B, n, NLIMB)),
-        jnp.asarray(sign_big.reshape(B, n)),
-        jnp.asarray(is_inf.reshape(B, n)),
-        jnp.asarray(valid.reshape(B, n)))
+        raw.reshape(Bp * n, 48))
+    arrays = (limbs.reshape(Bp, n, NLIMB), sign_big.reshape(Bp, n),
+              is_inf.reshape(Bp, n), valid.reshape(Bp, n))
+    if sharded:
+        outs = m.dispatch(_aggregate_kernel, arrays, n=B)
+        if Bp != B:
+            outs = tuple(o[:B] for o in outs)
+        return outs
+    m.note_passthrough(B)
+    return _aggregate_kernel(*(jnp.asarray(a) for a in arrays))
 
 
 def aggregate_collect(handles) -> Tuple[List[Optional[Tuple[int, int]]],

@@ -21,8 +21,13 @@ TPU-first design:
    [S]B + [k](-A) vs decompressed R, batched over the whole array.
 
 Layout: an element is [..., 20] int32; batch ops are elementwise over the
-leading axes, so `jax.sharding` over the batch axis scales this across a
-device mesh with zero collectives (embarrassingly parallel).
+leading axes, so the batch axis shards across a device mesh with zero
+collectives (embarrassingly parallel). `verify_batch_async` routes
+batches through the production mesh dispatcher (`ops/mesh.DeviceMesh`):
+on a multi-chip host, batches at or above `Config.MESH_SHARD_MIN` are
+bucket-padded per device and launched as ONE SPMD program over every
+chip; single-device hosts and small batches take the unchanged
+passthrough path.
 """
 from __future__ import annotations
 
@@ -650,11 +655,27 @@ def verify_batch_async(msgs: Sequence[bytes], sigs: Sequence[bytes],
     """Non-blocking batched verify: enqueues the device computation and
     returns (ok_device_array, valid_host_bools, n) immediately — JAX
     dispatch is async, so the caller overlaps host work with the device
-    round trip and materializes later (np.asarray(ok)[:n] & valid)."""
+    round trip and materializes later (np.asarray(ok)[:n] & valid).
+
+    Multi-chip: batches clearing the mesh gate (ops/mesh.py) are
+    bucket-padded per device and launched as one batch-axis-sharded
+    SPMD program over every chip (zero collectives); otherwise the
+    single-device path below is unchanged."""
     n = len(msgs)
     if n == 0:
         return None, np.zeros(0, dtype=bool), 0
     arrays, valid = host_pack(msgs, sigs, verkeys)
+    from plenum_tpu.ops import mesh as mesh_mod
+    m = mesh_mod.get_mesh()
+    if m.should_shard(n):
+        # the mesh path runs the XLA kernel: it SPMD-partitions over the
+        # batch axis with no code change, whereas the Pallas kernel is a
+        # per-chip program (its per-device halves still run the winning
+        # tile grid when each shard fills a block)
+        arrays = mesh_mod.pad_rows(arrays, m.padded_size(n))
+        ok = m.dispatch(_verify_kernel, arrays, n=n)
+        return ok, valid, n
+    m.note_passthrough(n)
     # pad the batch axis to the next power of two (min 8) by repeating
     # row 0 so every size in [1, 2^k] shares one compiled kernel —
     # variable pool queue depths must not trigger XLA recompiles
@@ -684,11 +705,11 @@ def _pallas_available() -> bool:
         if os.environ.get("PLENUM_TPU_ED25519_BACKEND") == "xla":
             state = False
         else:
-            try:
-                import jax
-                state = jax.devices()[0].platform not in ("cpu",)
-            except Exception:
-                state = False
+            # ONE lazy, exception-guarded capability probe for the whole
+            # package (ops/mesh.py) — probing jax.devices()[0] here
+            # would force backend init and assume device 0
+            from plenum_tpu.ops import mesh as mesh_mod
+            state = mesh_mod.is_accelerator()
         _PALLAS_STATE["enabled"] = state
     return state
 

@@ -29,6 +29,15 @@ Top levels (few nodes, shared by every proof) are mirrored to host
 LAZILY — first proof batch after a build/growth pays one download; the
 mirror then grows incrementally with each append, so per-batch device
 traffic carries only the huge bottom levels.
+
+Multi-chip (ops/mesh.py): builds clearing the mesh gate hash their
+leaves and interior levels as ONE batch-axis-sharded SPMD program over
+every chip (the leaf level dominates the hash count), then land the
+level arrays back on the default device so the incremental append and
+mirror paths are unchanged. Proof gathers shard the INDEX axis — each
+proof row is an independent sibling gather — against bottom levels
+replicated across the mesh (memoized per level array, invalidated by
+appends; serving is read-heavy, so replication amortizes over batches).
 """
 from __future__ import annotations
 
@@ -43,6 +52,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from plenum_tpu.ops import pow2_at_least as _pow2_at_least
 from plenum_tpu.ops.sha256 import (
     _sha256_blocks, digests_to_array, pad_messages)
 
@@ -65,11 +75,20 @@ def _start_async_copy(arr):
                          "proof collects will block on transfer", exc)
 
 
-def _pow2_at_least(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+def _get_mesh():
+    from plenum_tpu.ops import mesh as mesh_mod
+    return mesh_mod.get_mesh()
+
+
+def _to_default_device(levels):
+    """Land (possibly mesh-sharded) level arrays on the default device:
+    the append/mirror/read paths dispatch single-device programs, and
+    jit rejects operands committed to different device sets — one
+    device-to-device copy after a sharded build keeps every downstream
+    path byte-identical and oblivious."""
+    import jax
+    dev = jax.devices()[0]
+    return [jax.device_put(lv, dev) for lv in levels]
 
 
 @functools.partial(jax.jit, static_argnames=("msg_len", "nblocks"))
@@ -231,6 +250,7 @@ class DeviceMerkleTree:
         self._mirror = {}          # height -> host uint8 [cap>>h, 32]
         self._mirror_count = {}    # height -> mirrored complete prefix
         self._froot_cache = {}     # proof size n -> frontier root bytes
+        self._repl_cache = {}      # height -> (level array, mesh replica)
 
     # ------------------------------------------------------------ state
 
@@ -246,6 +266,7 @@ class DeviceMerkleTree:
     def reset(self):
         self._levels, self._size, self._cap = None, 0, 0
         self._mirror, self._mirror_count, self._froot_cache = {}, {}, {}
+        self._repl_cache = {}
 
     def _depth(self) -> int:
         return self._cap.bit_length() - 1 if self._cap else 0
@@ -277,6 +298,13 @@ class DeviceMerkleTree:
             msgs = msgs + [msgs[-1]] * (padded - n)
         depth = padded.bit_length() - 1
         ln0 = len(msgs[0])
+        dm = _get_mesh()
+        # builds shard the tree's power-of-two capacity as-is (no extra
+        # row padding), so the capacity must divide over the mesh —
+        # with a sub-device-count MESH_SHARD_MIN the gate can pass on a
+        # tree smaller than the device count, where device_put would
+        # reject the sharding
+        shard = dm.should_shard(padded) and padded % dm.n_devices == 0
         if all(len(m) == ln0 for m in msgs):
             # uniform leaves: upload raw bytes, pad/pack on device
             nblocks = 1
@@ -284,16 +312,31 @@ class DeviceMerkleTree:
                 nblocks *= 2
             raw = np.frombuffer(b"".join(msgs), dtype=np.uint8) \
                 .reshape(padded, ln0)
-            words = _pack_uniform(jnp.asarray(raw), ln0, nblocks)
-            nvalid = jnp.full((padded,), (ln0 + 9 + 63) // 64,
-                              dtype=jnp.int32)
+            nv_host = np.full((padded,), (ln0 + 9 + 63) // 64,
+                              dtype=np.int32)
+            if shard:
+                raw_dev, nvalid = dm.put_sharded([raw, nv_host])
+            else:
+                raw_dev, nvalid = jnp.asarray(raw), jnp.asarray(nv_host)
+            words = _pack_uniform(raw_dev, ln0, nblocks)
         else:
             host_words, host_nvalid, nblocks = pad_messages(msgs)
-            words = jnp.asarray(host_words)
-            nvalid = jnp.asarray(host_nvalid)
-        self._levels = list(_build_levels(words, nvalid, nblocks, depth))
+            if shard:
+                words, nvalid = dm.put_sharded([host_words, host_nvalid])
+            else:
+                words = jnp.asarray(host_words)
+                nvalid = jnp.asarray(host_nvalid)
+        if shard:
+            levels = _to_default_device(dm.dispatch(
+                lambda w, nv: _build_levels(w, nv, nblocks, depth),
+                [words, nvalid], n=padded))
+        else:
+            dm.note_passthrough(padded)
+            levels = _build_levels(words, nvalid, nblocks, depth)
+        self._levels = list(levels)
         self._size, self._cap = n, padded
         self._mirror, self._mirror_count, self._froot_cache = {}, {}, {}
+        self._repl_cache = {}
         return self.root_hash
 
     def build_from_leaf_hashes(self, digests) -> bytes:
@@ -310,10 +353,19 @@ class DeviceMerkleTree:
             arr = np.concatenate(
                 [arr, np.zeros((padded - n, 32), dtype=np.uint8)])
         depth = padded.bit_length() - 1
-        self._levels = list(
-            _build_levels_from_digest_bytes(jnp.asarray(arr), depth))
+        dm = _get_mesh()
+        if dm.should_shard(padded) and padded % dm.n_devices == 0:
+            levels = _to_default_device(dm.dispatch(
+                lambda a: _build_levels_from_digest_bytes(a, depth),
+                [arr], n=padded))
+        else:
+            dm.note_passthrough(padded)
+            levels = _build_levels_from_digest_bytes(
+                jnp.asarray(arr), depth)
+        self._levels = list(levels)
         self._size, self._cap = n, padded
         self._mirror, self._mirror_count, self._froot_cache = {}, {}, {}
+        self._repl_cache = {}
         return self.root_hash
 
     @staticmethod
@@ -333,6 +385,7 @@ class DeviceMerkleTree:
             self._levels = [jnp.zeros((cap >> h, 8), dtype=jnp.uint32)
                             for h in range(cap.bit_length())]
             self._mirror, self._mirror_count = {}, {}
+            self._repl_cache = {}
             return
         if n <= self._cap:
             return
@@ -346,6 +399,7 @@ class DeviceMerkleTree:
         self._levels, self._cap = levels, new_cap
         # mirror shapes changed: refill lazily on next proof batch
         self._mirror, self._mirror_count = {}, {}
+        self._repl_cache = {}
 
     def append_leaf_hashes(self, digests, return_nodes: bool = False):
         """Append leaf DIGESTS incrementally: ~2b device hashes for b
@@ -449,6 +503,45 @@ class DeviceMerkleTree:
 
     # ------------------------------------------- proofs (any tree size)
 
+    def _replicated_level(self, h: int, dm):
+        """Mesh-replicated copy of level h, memoized by (level array,
+        mesh sharding) identity: appends/growth swap the arrays and a
+        mesh reconfiguration rebuilds the sharding object, so the memo
+        self-invalidates on either — a stale replica committed to the
+        OLD device set would make the jitted gather raise an
+        incompatible-devices error. Repeated proof batches between
+        appends reuse the replica (the replication broadcast is the
+        sharded gather's only cross-device traffic)."""
+        import jax
+        lv = self._levels[h]
+        sh = dm.replicated()
+        cached = self._repl_cache.get(h)
+        if cached is not None and cached[0] is lv and cached[2] is sh:
+            return cached[1]
+        repl = jax.device_put(lv, sh)
+        self._repl_cache[h] = (lv, repl, sh)
+        return repl
+
+    def _gather_low(self, idx_np: np.ndarray, g: int):
+        """Fused sibling-gather+pack of the bottom g levels for one
+        proof batch. Batches clearing the mesh gate (ops/mesh.py) shard
+        the INDEX axis over every chip — each proof row is an
+        independent gather — against replicated level operands; smaller
+        batches keep the single-device dispatch."""
+        dm = _get_mesh()
+        k = int(idx_np.shape[0])
+        if dm.should_shard(k):
+            levels = tuple(self._replicated_level(h, dm)
+                           for h in range(g))
+            kp = dm.padded_size(k, min_per_device=1)
+            idx_p = idx_np if kp == k else np.concatenate(
+                [idx_np, np.repeat(idx_np[:1], kp - k)])
+            low = dm.dispatch(lambda ix: _gather_pack(levels, ix),
+                              [idx_p], n=k)
+            return low[:k] if kp != k else low
+        dm.note_passthrough(k)
+        return _gather_pack(tuple(self._levels[:g]), jnp.asarray(idx_np))
+
     def dispatch_proof_batch(self, indices: Sequence[int],
                              n: Optional[int] = None):
         """Start the device gather for one RFC 6962 inclusion-proof
@@ -474,8 +567,7 @@ class DeviceMerkleTree:
         g = min(self._n_low(), h0)
         low = None
         if g and idx_np.size:
-            low = _gather_pack(tuple(self._levels[:g]),
-                               jnp.asarray(idx_np))
+            low = self._gather_low(idx_np, g)
             _start_async_copy(low)
         return (idx_np, low, n, g, fr, roots)
 
@@ -550,8 +642,7 @@ class DeviceMerkleTree:
         g = min(self._n_low(), self._depth())
         low = None
         if g:
-            low = _gather_pack(tuple(self._levels[:g]),
-                               jnp.asarray(idx_np))
+            low = self._gather_low(idx_np, g)
             _start_async_copy(low)
         return (idx_np, low)
 

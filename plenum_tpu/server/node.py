@@ -220,7 +220,15 @@ class Node:
             if provider == "remote":
                 kwargs["addr"] = (self.config.VERIFIER_DAEMON_HOST,
                                   self.config.VERIFIER_DAEMON_PORT)
+            elif provider in ("adaptive", "tpu_hub"):
+                kwargs["threshold"] = getattr(
+                    self.config, "VERIFIER_BATCH_THRESHOLD", None)
             verifier = create_verifier(provider, **kwargs)
+        # apply this node's MESH_* knobs to the process-wide device-mesh
+        # dispatcher (ops/mesh.py) that the verify/BLS/merkle seams
+        # consult — import never initializes a backend
+        from plenum_tpu.ops import mesh as _mesh_mod
+        _mesh_mod.configure_from(self.config)
         self.authnr = CoreAuthNr(
             verkey_provider=self._verkey_from_domain_state,
             verifier=verifier)
@@ -396,6 +404,11 @@ class Node:
             # tracer was attached last — one buffer still sees every
             # fused launch)
             verifier.tracer = self.tracer
+        if getattr(self.tracer, "enabled", False):
+            # mesh_dispatch spans + per-device counters land in the same
+            # buffer (process-wide mesh: last tracer attached wins, like
+            # the shared hub above)
+            _mesh_mod.get_mesh().tracer = self.tracer
         self.primary_connection_monitor = PrimaryConnectionMonitorService(
             self.replica.data, timer, self.replica.internal_bus, network,
             config=self.config)

@@ -67,6 +67,7 @@ class ValidatorNodeInfoTool:
             "Latencies": self._latencies(),
             "Extractions": self._extractions(),
             "Tracing": self._tracing_info(),
+            "Device_mesh": self._device_mesh_info(),
             "Metrics": (self._metrics.summary()
                         if self._metrics is not None
                         and hasattr(self._metrics, "summary") else {}),
@@ -155,6 +156,18 @@ class ValidatorNodeInfoTool:
         tracer = getattr(self._node, "tracer", None)
         stats = getattr(tracer, "stats", None)
         return stats() if stats is not None else {}
+
+    def _device_mesh_info(self) -> dict:
+        """Device-mesh dispatcher stats (ops/mesh.py): enabled/gate
+        knobs, sharded-vs-passthrough dispatch counts, last per-device
+        batch. mesh_stats never initializes a backend, so this dump
+        stays safe inside an ordering tick (same rule as _dep_version:
+        no jax import side effects)."""
+        try:
+            from plenum_tpu.ops.mesh import mesh_stats
+            return mesh_stats()
+        except Exception:
+            return {}
 
     def _hardware_info(self) -> dict:
         out = {}

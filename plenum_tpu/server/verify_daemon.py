@@ -113,11 +113,22 @@ class VerifyDaemon:
     def _verify_bucketed(self, items):
         """Fixed-shape device launches: chunk to `bucket` items (pad the
         tail by repetition), dispatch every chunk async FIRST so the
-        launches pipeline through the device queue, then collect."""
+        launches pipeline through the device queue, then collect.
+
+        Multi-chip: the bucket scales by the mesh's device count so one
+        fused launch spans every chip (the mesh dispatcher re-buckets
+        per device, so the per-device compiled shape is unchanged)."""
         if self._backend_name == "cpu" or self._bucket <= 0 \
                 or len(items) < self._cpu_floor:
             return self._verifier.verify_batch(items)
         b = self._bucket
+        from plenum_tpu.ops.mesh import get_mesh
+        mesh = get_mesh()
+        if mesh.should_shard(b * mesh.n_devices):
+            # only when the scaled launch actually clears the shard
+            # gate — otherwise it would take the passthrough path at a
+            # brand-new (uncompiled) shape for zero mesh benefit
+            b *= mesh.n_devices
         chunks = [items[i:i + b] for i in range(0, len(items), b)]
         if len(chunks[-1]) < b:
             pad = chunks[-1][0]
@@ -266,8 +277,12 @@ async def run_daemon(host="127.0.0.1", port=0, backend="adaptive",
                           bucket=bucket, cpu_floor=cpu_floor)
     if trace_file:
         from plenum_tpu.observability.tracing import Tracer
+        from plenum_tpu.ops import mesh as mesh_mod
         daemon.tracer = Tracer("verify-daemon")
         daemon.trace_file = trace_file
+        # mesh_dispatch spans + per-device counters from the daemon's
+        # device launches land in the same timeline
+        mesh_mod.get_mesh().tracer = daemon.tracer
     await daemon.start()
     if ready_file:
         with open(ready_file, "w") as f:

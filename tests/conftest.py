@@ -16,6 +16,13 @@ def pytest_configure(config):
         "markers",
         "slow: multi-second/large-memory tests excluded from the tier-1 "
         "run (-m 'not slow')")
+    # persistent XLA compile cache (same seam bench.py and the verify
+    # daemon use): the mesh-sharded kernel variants added alongside the
+    # single-device ones push total test compile time past the tier-1
+    # budget when every run recompiles from scratch; with the cache the
+    # first run pays once and every later run loads in milliseconds
+    from plenum_tpu.ops import enable_persistent_compilation_cache
+    enable_persistent_compilation_cache()
 
 
 @pytest.fixture

@@ -1,15 +1,36 @@
-"""The driver-side multi-chip dryrun must pass on the virtual 8-device CPU
-mesh (conftest sets JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8),
-validating the batch-axis sharding + cross-device reduce without TPU hardware."""
+"""The driver-side multi-chip harness must pass on the virtual 8-device CPU
+mesh (conftest sets JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8):
+it routes verify/BLS/merkle through the REAL mesh dispatcher (ops/mesh.py)
+and records per-device-count throughput JSON, headline one-liner last."""
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def test_dryrun_multichip_8():
+def test_dryrun_multichip_8(capsys):
     import __graft_entry__ as g
+    from plenum_tpu.ops import mesh as mesh_mod
+    m = mesh_mod.get_mesh()
+    prior = (m.enabled, m.max_devices, m.shard_min)
     g.dryrun_multichip(8)
+    # the harness must leave no process-global mesh pinning behind
+    assert (m.enabled, m.max_devices, m.shard_min) == prior
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    record = json.loads(lines[0])["multichip"]
+    assert record["n_devices"] == 8
+    counts = record["device_counts"]
+    assert "1" in counts and "8" in counts
+    for entry in counts.values():
+        assert entry["verify_per_s"] > 0
+    assert counts["8"]["scaling_efficiency_vs_1"] > 0
+    assert record["bls_aggregate"]["jobs_per_s"] > 0
+    assert record["merkle"]["proofs_per_s"] > 0
+    # headline one-liner LAST (driver records a bounded stdout tail)
+    headline = json.loads(lines[-1])["headline"]
+    assert headline["ok"] is True
+    assert headline["value"] == counts["8"]["verify_per_s"]
 
 
 def test_entry_compiles_and_runs():
