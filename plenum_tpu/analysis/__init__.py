@@ -1,0 +1,48 @@
+"""plenum-lint — AST-based consensus-safety and device-hygiene analyzer.
+
+Rules encode this repo's shipped-and-fixed bug classes (PT001–PT006;
+see docs/static_analysis.md). Pure stdlib ``ast`` — importing or
+running the analyzer never initializes JAX or any native extension,
+which is what lets tests/test_lint_clean.py gate tier-1 in-process.
+
+Programmatic entry point::
+
+    from plenum_tpu.analysis import run_analysis
+    new, baselined, findings = run_analysis(paths, root=repo_root)
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from plenum_tpu.analysis.baseline import Baseline
+from plenum_tpu.analysis.core import Analyzer, Finding, Rule
+from plenum_tpu.analysis.rules import RULE_CLASSES, build_rules
+
+__all__ = ["Analyzer", "Baseline", "Finding", "Rule", "RULE_CLASSES",
+           "build_rules", "repo_root", "run_analysis"]
+
+
+def repo_root() -> str:
+    """The checkout root (three levels above this package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_analysis(paths: Sequence[str], root: str = None,
+                 baseline_path: Optional[str] = None,
+                 disable: Sequence[str] = (),
+                 select: Sequence[str] = (),
+                 severities=None,
+                 ) -> Tuple[List[Finding], List[Finding], Baseline]:
+    """Run the full registry over `paths` → (new, baselined, baseline).
+    `baseline_path=None` means no baseline (everything is new)."""
+    root = root or repo_root()
+    analyzer = Analyzer(
+        build_rules(disable=disable, select=select,
+                    severities=severities, root=root), root)
+    findings = analyzer.run_paths(paths)
+    baseline = (Baseline.load(baseline_path) if baseline_path
+                else Baseline([]))
+    new, baselined = baseline.match(findings)
+    return new, baselined, baseline

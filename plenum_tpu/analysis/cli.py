@@ -1,0 +1,223 @@
+"""plenum-lint CLI — text/JSON reporting, --changed mode, baselines.
+
+    plenum_lint plenum_tpu/                # full tree vs the baseline
+    plenum_lint --changed                  # pre-commit: git-diff files only
+    plenum_lint --json plenum_tpu/ops/     # machine-readable findings
+    plenum_lint --write-baseline           # (re)grandfather current findings
+
+Exit codes: 0 clean (or warnings only), 1 non-baselined error findings,
+2 usage errors. ``--changed`` with an empty diff prints a clean message
+and exits 0 (the scripts/metrics_stats empty-store convention).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List
+
+from plenum_tpu.analysis import repo_root, run_analysis
+from plenum_tpu.analysis.baseline import Baseline
+from plenum_tpu.analysis.core import Analyzer, Finding
+from plenum_tpu.analysis.rules import RULE_CLASSES, build_rules
+
+JSON_SCHEMA_VERSION = 1
+
+
+def changed_py_files(root: str) -> List[str]:
+    """Tracked-modified + untracked .py files, repo-relative. A failing
+    git (not a repo, binary missing, hang) raises RuntimeError — the
+    pre-commit gate must fail CLOSED, not read as an empty diff."""
+    out: List[str] = []
+    for args in (["git", "diff", "--name-only", "HEAD", "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(args, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError("cannot run git for --changed: %s" % e)
+        if res.returncode != 0:
+            raise RuntimeError(
+                "git failed for --changed (%s): %s" % (
+                    " ".join(args), res.stderr.strip() or res.returncode))
+        out.extend(line.strip() for line in res.stdout.splitlines()
+                   if line.strip())
+    seen, files = set(), []
+    for rel in out:
+        if rel.endswith(".py") and rel not in seen:
+            seen.add(rel)
+            path = os.path.join(root, rel)
+            if os.path.isfile(path):
+                files.append(path)
+    return files
+
+
+def _parse_severities(specs: List[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for spec in specs:
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            code, _, sev = item.partition("=")
+            if not sev:
+                raise ValueError(
+                    "--severity takes CODE=LEVEL, got %r" % item)
+            out[code.strip().upper()] = sev.strip().lower()
+    return out
+
+
+def _to_json(findings: List[Finding], baselined: set,
+             files_scanned: int) -> dict:
+    items = []
+    for f in findings:
+        items.append({
+            "rule": f.rule, "severity": f.severity, "path": f.path,
+            "line": f.line, "col": f.col, "message": f.message,
+            "symbol": f.symbol, "baselined": f in baselined})
+    new = [f for f in findings if f not in baselined]
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "plenum-lint",
+        "findings": items,
+        "summary": {
+            "files": files_scanned,
+            "findings": len(findings),
+            "new": len(new),
+            "baselined": len(findings) - len(new),
+            "errors": sum(1 for f in new if f.severity == "error"),
+            "warnings": sum(1 for f in new if f.severity == "warning"),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="plenum_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: plenum_tpu/)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only .py files in the git diff "
+                         "(tracked-modified + untracked)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: autodetected from the "
+                         "package location)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: <root>/"
+                         "lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current "
+                         "findings (justifications default to TODO)")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated rule codes to skip")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule codes to run exclusively")
+    ap.add_argument("--severity", action="append", default=[],
+                    metavar="CODE=LEVEL",
+                    help="override a rule's severity (error|warning); "
+                         "warnings never affect the exit code")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in RULE_CLASSES:
+            print("%s %-32s %s" % (cls.code, cls.name, cls.severity))
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else repo_root()
+    try:
+        severities = _parse_severities(args.severity)
+        rules = build_rules(
+            disable=[c for c in args.disable.split(",") if c],
+            select=[c for c in args.select.split(",") if c],
+            severities=severities, root=root)
+    except ValueError as e:
+        print("plenum_lint: %s" % e, file=sys.stderr)
+        return 2
+
+    if args.changed:
+        try:
+            files = changed_py_files(root)
+        except RuntimeError as e:
+            print("plenum_lint: %s" % e, file=sys.stderr)
+            return 2
+        if args.paths:
+            scopes = [os.path.abspath(p) for p in args.paths]
+            files = [f for f in files
+                     if any(os.path.abspath(f) == s
+                            or os.path.abspath(f).startswith(s + os.sep)
+                            for s in scopes)]
+        if not files:
+            print("plenum_lint: no changed Python files — nothing "
+                  "to lint")
+            return 0
+    else:
+        paths = args.paths or [os.path.join(root, "plenum_tpu")]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            # a typo'd path must not read as a permanently-clean lint
+            print("plenum_lint: no such path(s): %s"
+                  % ", ".join(missing), file=sys.stderr)
+            return 2
+        files = Analyzer(rules, root).collect_files(paths)
+
+    analyzer = Analyzer(rules, root)
+    findings = analyzer.run_files(files)
+
+    baseline_path = args.baseline or os.path.join(root,
+                                                  "lint_baseline.json")
+    if args.write_baseline:
+        # merge, don't clobber: entries for files outside this run's
+        # scope (or rules not run) were not re-checked — a scoped
+        # rewrite must never delete their justifications
+        scanned = {analyzer._rel(p) for p in files}
+        active = {r.code for r in rules}
+        kept = [e for e in Baseline.load(baseline_path).entries
+                if e["path"] not in scanned or e["rule"] not in active]
+        fresh = Baseline.from_findings(findings).entries
+        Baseline(kept + fresh).save(baseline_path)
+        print("plenum_lint: wrote %d baseline entr%s (+%d out-of-scope "
+              "kept) to %s — fill in the justifications before "
+              "committing" % (len(fresh),
+                              "y" if len(fresh) == 1 else "ies",
+                              len(kept), baseline_path))
+        return 0
+
+    baseline = (Baseline([]) if args.no_baseline
+                else Baseline.load(baseline_path))
+    new, old = baseline.match(findings)
+    baselined = set(old)
+
+    if args.as_json:
+        print(json.dumps(_to_json(findings, baselined, len(files)),
+                         indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        scanned = {analyzer._rel(p) for p in files}
+        active = {r.code for r in rules}
+        # an entry for a file outside this run's scope (or a rule not
+        # run) is not stale — it just wasn't checked
+        stale = [k for k in baseline.stale()
+                 if k[1] in scanned and k[0] in active]
+        if stale:
+            print("plenum_lint: %d stale baseline entr%s (fixed code? "
+                  "prune lint_baseline.json):" % (
+                      len(stale), "y" if len(stale) == 1 else "ies"))
+            for rule, path, symbol, _ in stale:
+                print("  %s %s [%s]" % (rule, path, symbol))
+        print("plenum_lint: %d file(s), %d finding(s) — %d new, %d "
+              "baselined" % (len(files), len(findings), len(new),
+                             len(old)))
+    return 1 if any(f.severity == "error" for f in new) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""plenum-lint core — findings, rule protocol, pragmas, the driver.
+
+The analyzer is pure stdlib-`ast`: it never imports the modules it
+checks, so it can run under any interpreter state (no JAX init, no
+native extensions) and is safe as a tier-1 gate. Each rule encodes one
+bug class this repo has actually shipped and fixed by hand (see
+docs/static_analysis.md for the catalog and the historical incident
+behind every rule).
+
+Suppression layers, weakest to strongest:
+
+* inline pragma  — ``# plenum-lint: disable=PT006`` on the finding's
+  line (or ``disable=all``); a pragma comment alone on one of the first
+  five lines of a file disables the codes for the whole file.
+* baseline      — ``lint_baseline.json`` grandfathers known findings by
+  (rule, path, symbol, message) so the gate only fails on NEW findings
+  (see baseline.py).
+* rule disable  — ``--disable PT005`` / per-rule severity overrides at
+  the CLI / Analyzer level.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+SEVERITIES = ("error", "warning")
+
+PRAGMA_RE = re.compile(
+    r"#\s*plenum-lint:\s*disable=([A-Za-z0-9_, ]+|all)")
+# pragma-only lines near the top of a file disable codes file-wide
+FILE_PRAGMA_HEAD_LINES = 5
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str        # "PT001"
+    severity: str    # "error" | "warning"
+    path: str        # repo-relative posix path
+    line: int
+    col: int
+    message: str     # line-number-free (stable across drift)
+    symbol: str      # dotted enclosing scope, e.g. "VerifyDaemon._batcher"
+
+    def location(self) -> str:
+        return "%s:%d:%d" % (self.path, self.line, self.col)
+
+    def render(self) -> str:
+        loc = " [%s]" % self.symbol if self.symbol else ""
+        return "%s: %s %s: %s%s" % (self.location(), self.rule,
+                                    self.severity, self.message, loc)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain; None for anything dynamic
+    (subscripts, call results) — rules treat dynamic receivers as
+    unmatchable rather than guessing."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def attr_parts(node: ast.AST) -> List[str]:
+    """Every attribute/name component of a chain (dynamic roots allowed:
+    ``self._engine[0].x`` still yields ["x"])."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts
+
+
+def walk_skipping_nested_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested function /
+    class definitions (which get their own analysis context)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class ModuleContext:
+    """One parsed file handed to every rule: tree + source lines +
+    pragma map + enclosing-symbol resolution."""
+
+    def __init__(self, rel_path: str, source: str, tree: ast.Module):
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.line_pragmas: Dict[int, Set[str]] = {}
+        self.file_pragmas: Set[str] = set()
+        self._scan_pragmas()
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+
+    # ------------------------------------------------------------ pragmas
+
+    def _scan_pragmas(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if not m:
+                continue
+            codes = {c.strip().upper() for c in m.group(1).split(",")
+                     if c.strip()}
+            self.line_pragmas.setdefault(i, set()).update(codes)
+            if i <= FILE_PRAGMA_HEAD_LINES and line.strip().startswith("#"):
+                self.file_pragmas.update(codes)
+
+    def suppressed(self, code: str, line: int) -> bool:
+        for codes in (self.file_pragmas, self.line_pragmas.get(line, ())):
+            if "ALL" in codes or code.upper() in codes:
+                return True
+        return False
+
+    # ------------------------------------------------------------ symbols
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def symbol_for(self, node: ast.AST) -> str:
+        """Dotted class/function scope enclosing `node` ("" at module
+        level) — the stable coordinate baselines key on."""
+        names: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(cur.name)
+            cur = self.parent(cur)
+        return ".".join(reversed(names))
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str,
+                symbol: str = None) -> Finding:
+        return Finding(
+            rule=rule.code, severity=rule.severity, path=self.rel_path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            symbol=self.symbol_for(node) if symbol is None else symbol)
+
+
+class Rule:
+    """One named check. Subclasses set `code`/`name`/`severity` and
+    implement check(ctx); `applies` gives cheap path scoping so rules
+    only parse-walk the layers their bug class lives in."""
+
+    code = "PT000"
+    name = "abstract"
+    severity = "error"
+
+    def applies(self, rel_path: str) -> bool:
+        return True
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class ParseErrorRule(Rule):
+    """Synthetic rule code for unparseable files — a syntax error in the
+    scanned tree must fail the gate, not be skipped silently."""
+    code = "PT000"
+    name = "parse-error"
+
+
+_PARSE_ERROR = ParseErrorRule()
+
+
+class Analyzer:
+    def __init__(self, rules: Sequence[Rule], root: str):
+        """root: repository root; finding paths are relative to it."""
+        self.rules = list(rules)
+        self.root = os.path.abspath(root)
+
+    # --------------------------------------------------------- file walk
+
+    def collect_files(self, paths: Sequence[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            p = os.path.abspath(p)
+            if os.path.isfile(p):
+                if p.endswith(".py"):
+                    out.append(p)
+                continue
+            for base, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(base, f))
+        # stable order, no duplicates
+        seen, uniq = set(), []
+        for f in out:
+            if f not in seen:
+                seen.add(f)
+                uniq.append(f)
+        return uniq
+
+    def _rel(self, path: str) -> str:
+        rel = os.path.relpath(os.path.abspath(path), self.root)
+        return rel.replace(os.sep, "/")
+
+    # ----------------------------------------------------------- analyze
+
+    def run_files(self, files: Sequence[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in files:
+            findings.extend(self.run_one(path))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings
+
+    def run_one(self, path: str) -> List[Finding]:
+        rel = self._rel(path)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, ValueError, OSError) as exc:
+            return [Finding(
+                rule=_PARSE_ERROR.code, severity="error", path=rel,
+                line=getattr(exc, "lineno", None) or 1, col=0,
+                message="cannot parse file: %s" % exc, symbol="")]
+        ctx = ModuleContext(rel, source, tree)
+        out: List[Finding] = []
+        for rule in self.rules:
+            if not rule.applies(rel):
+                continue
+            for finding in rule.check(ctx):
+                if not ctx.suppressed(finding.rule, finding.line):
+                    out.append(finding)
+        return out
+
+    def run_paths(self, paths: Sequence[str]) -> List[Finding]:
+        return self.run_files(self.collect_files(paths))

@@ -1,0 +1,61 @@
+"""Rule registry — one module per rule, one instance per analysis run.
+
+Adding a rule: create ``ptNNN_<slug>.py`` with a ``Rule`` subclass,
+import it here, append the class to ``RULE_CLASSES``, document it in
+``docs/static_analysis.md`` and give it fixtures in
+``tests/test_plenum_lint.py``. Codes are PTnnn; PT000 is reserved for
+parse errors.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from plenum_tpu.analysis.core import Rule, SEVERITIES
+from plenum_tpu.analysis.rules.pt001_blocking import BlockingCallRule
+from plenum_tpu.analysis.rules.pt002_host_sync import HostSyncInDispatchRule
+from plenum_tpu.analysis.rules.pt003_quorum_auth import QuorumBeforeAuthRule
+from plenum_tpu.analysis.rules.pt004_threads import CrossThreadSharedStateRule
+from plenum_tpu.analysis.rules.pt005_config_drift import (
+    ConfigLiteralDriftRule)
+from plenum_tpu.analysis.rules.pt006_broad_except import (
+    BroadExceptOnDevicePathRule)
+
+RULE_CLASSES = (
+    BlockingCallRule,
+    HostSyncInDispatchRule,
+    QuorumBeforeAuthRule,
+    CrossThreadSharedStateRule,
+    ConfigLiteralDriftRule,
+    BroadExceptOnDevicePathRule,
+)
+
+
+def build_rules(disable: Sequence[str] = (),
+                select: Sequence[str] = (),
+                severities: Optional[Dict[str, str]] = None,
+                root: str = None) -> List[Rule]:
+    """Instantiate the registry with per-rule enable/severity applied.
+    `select` (when non-empty) wins over `disable`; unknown codes raise
+    so a typo'd suppression cannot silently disable nothing."""
+    known = {cls.code for cls in RULE_CLASSES}
+    for code in list(disable) + list(select) + sorted(severities or {}):
+        if code.upper() not in known:
+            raise ValueError("unknown rule code %r (known: %s)"
+                             % (code, ", ".join(sorted(known))))
+    disabled = {c.upper() for c in disable}
+    selected = {c.upper() for c in select}
+    rules: List[Rule] = []
+    for cls in RULE_CLASSES:
+        if selected and cls.code not in selected:
+            continue
+        if cls.code in disabled:
+            continue
+        rule = cls(root=root) if cls is ConfigLiteralDriftRule else cls()
+        sev = (severities or {}).get(cls.code)
+        if sev is not None:
+            if sev not in SEVERITIES:
+                raise ValueError("unknown severity %r for %s (one of %s)"
+                                 % (sev, cls.code, "/".join(SEVERITIES)))
+            rule.severity = sev
+        rules.append(rule)
+    return rules

@@ -1,0 +1,132 @@
+"""PT002 host-sync-in-dispatch.
+
+Historical bug class: the ops/ seams are split into a *dispatch* half
+(enqueue the device program, return un-awaited arrays) and a *collect*
+half (materialize). The whole pipelining design — ProofPipeline,
+MeshPipeline, the hub's flush/collect split — depends on dispatch
+halves never forcing a host sync: one stray ``np.asarray`` /
+``block_until_ready`` there serializes every overlapped launch. PR 4
+also killed an eager ``jax.devices()[0]`` probe in ed25519_jax that
+force-initialized the backend at import scope and would have disabled
+Pallas process-wide when it raced the platform env; ``ops/mesh.py``
+(probe_platform) is now the ONE sanctioned enumeration point.
+
+Two checks:
+
+* anywhere in the package except ``ops/mesh.py``: calls to
+  ``jax.devices`` / ``jax.local_devices`` / ``jax.device_count`` —
+  route through ``mesh.probe_platform`` / ``mesh.default_device``.
+* in ``ops/`` dispatch-half functions ("dispatch" in the name or a
+  ``*_async`` suffix, and not a collect): ``.block_until_ready()``,
+  ``jax.device_get``, and ``np.asarray`` / ``float`` / ``int`` applied
+  to a device-tainted expression (result of a ``jax.*`` / ``jnp.*``
+  call, propagated through local assignments).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from plenum_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, dotted, walk_skipping_nested_defs)
+
+EAGER_PROBES = {"jax.devices", "jax.local_devices", "jax.device_count",
+                "jax.local_device_count"}
+DEVICE_GET = {"jax.device_get"}
+HOST_CONVERTERS = {"np.asarray", "numpy.asarray", "float", "int"}
+
+
+def _is_dispatch_half(name: str) -> bool:
+    low = name.lower()
+    return ("collect" not in low
+            and ("dispatch" in low or low.endswith("_async")))
+
+
+def _device_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    if not name:
+        return False
+    root = name.split(".", 1)[0]
+    return root in ("jnp", "jax") and name not in EAGER_PROBES
+
+
+class HostSyncInDispatchRule(Rule):
+    code = "PT002"
+    name = "host-sync-in-dispatch"
+
+    def applies(self, rel_path: str) -> bool:
+        return (rel_path.startswith("plenum_tpu/")
+                and rel_path != "plenum_tpu/ops/mesh.py")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in EAGER_PROBES:
+                    out.append(ctx.finding(
+                        self, node,
+                        "eager %s() initializes the JAX backend — route "
+                        "device/platform questions through ops/mesh.py "
+                        "(probe_platform / default_device)" % name))
+        if ctx.rel_path.startswith("plenum_tpu/ops/"):
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and _is_dispatch_half(node.name):
+                    out.extend(self._check_dispatch(ctx, node))
+        return out
+
+    def _check_dispatch(self, ctx: ModuleContext,
+                        fn: ast.AST) -> List[Finding]:
+        out: List[Finding] = []
+        tainted: Set[str] = set()
+
+        def expr_tainted(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if _device_call(n):
+                    return True
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    return True
+            return False
+
+        def note(node, what):
+            out.append(ctx.finding(
+                self, node,
+                "%s in dispatch-half %s() forces a host sync — the "
+                "dispatch/collect overlap (and every pipelined launch "
+                "behind it) serializes here" % (what, fn.name)))
+
+        # flow-insensitive taint over this function's OWN assignments
+        # (nested defs excluded — their locals are a different scope),
+        # iterated to a fixpoint so a->b->c chains resolve regardless
+        # of the walk's visit order
+        assigns = [sub for sub in walk_skipping_nested_defs(fn)
+                   if isinstance(sub, ast.Assign)]
+        changed = True
+        while changed:
+            changed = False
+            for sub in assigns:
+                if not expr_tainted(sub.value):
+                    continue
+                for tgt in sub.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name) \
+                                and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+        for sub in walk_skipping_nested_defs(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = dotted(sub.func)
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "block_until_ready":
+                note(sub, "block_until_ready()")
+            elif name in DEVICE_GET:
+                note(sub, "%s()" % name)
+            elif name in HOST_CONVERTERS and sub.args \
+                    and expr_tainted(sub.args[0]):
+                note(sub, "%s() on a device array" % name)
+        return out

@@ -1,0 +1,172 @@
+"""PT004 cross-thread-shared-state.
+
+Historical context: the verify daemon runs device launches on a
+dedicated worker thread while its asyncio loop keeps coalescing, and
+the flight recorder (observability/tracing.py) is written from both.
+The sanctioned shapes are (a) hold a lock around every cross-thread
+attribute write, or (b) the Tracer fixed-slot pattern — writes go into
+preallocated ring slots (``self._buf[i] = rec``, a subscript store, not
+an attribute rebind) under a tiny critical section.
+
+Encoding, per class: find thread entry points — methods passed as
+``threading.Thread(target=self.X)``, ``pool.submit(self.X, ...)`` or
+``loop.run_in_executor(pool, self.X, ...)`` — and take their same-class
+transitive call closure as the worker side. Any ``self.attr`` written
+both by the worker side and by other methods (``__init__`` excluded:
+construction happens before the thread exists), where either write is
+outside a ``with <something lock-ish>`` block, is flagged. Subscript
+stores (the fixed-slot pattern) are not attribute writes and pass.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from plenum_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, attr_parts, dotted)
+
+LOCKISH = ("lock", "mutex", "cond", "sem")
+
+
+def _lockish_expr(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        name = n.attr if isinstance(n, ast.Attribute) else (
+            n.id if isinstance(n, ast.Name) else None)
+        if name and any(m in name.lower() for m in LOCKISH):
+            return True
+    return False
+
+
+def _self_attr(node: ast.AST):
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _entry_points(cls: ast.ClassDef) -> Set[str]:
+    """Method names handed to another thread within this class."""
+    out: Set[str] = set()
+
+    def method_ref(node) -> str:
+        attr = _self_attr(node)
+        return attr if attr else None
+
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        parts = attr_parts(node.func)
+        if name.endswith("Thread") or (parts and parts[0] == "Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    ref = method_ref(kw.value)
+                    if ref:
+                        out.add(ref)
+        elif parts and parts[0] == "submit" and node.args:
+            ref = method_ref(node.args[0])
+            if ref:
+                out.add(ref)
+        elif parts and parts[0] == "run_in_executor" \
+                and len(node.args) >= 2:
+            ref = method_ref(node.args[1])
+            if ref:
+                out.add(ref)
+    return out
+
+
+class CrossThreadSharedStateRule(Rule):
+    code = "PT004"
+    name = "cross-thread-shared-state"
+
+    def applies(self, rel_path: str) -> bool:
+        return rel_path.startswith("plenum_tpu/")
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        out: List[Finding] = []
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._check_class(ctx, cls))
+        return out
+
+    def _check_class(self, ctx: ModuleContext,
+                     cls: ast.ClassDef) -> List[Finding]:
+        methods: Dict[str, ast.AST] = {
+            m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        entries = _entry_points(cls) & set(methods)
+        if not entries:
+            return []
+        # worker side: entry points + same-class transitive callees
+        worker: Set[str] = set()
+        frontier = list(entries)
+        while frontier:
+            name = frontier.pop()
+            if name in worker:
+                continue
+            worker.add(name)
+            for node in ast.walk(methods[name]):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee in methods and callee not in worker:
+                        frontier.append(callee)
+
+        # writes: attr -> list of (method, node, locked)
+        def writes(method) -> List[Tuple[str, ast.AST, bool]]:
+            found: List[Tuple[str, ast.AST, bool]] = []
+
+            def visit(node, locked):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not method:
+                    return
+                if isinstance(node, ast.With):
+                    inner = locked or any(
+                        _lockish_expr(item.context_expr)
+                        for item in node.items)
+                    for child in ast.iter_child_nodes(node):
+                        visit(child, inner)
+                    return
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr:
+                        found.append((attr, node, locked))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, locked)
+
+            visit(method, False)
+            return found
+
+        worker_writes: Dict[str, List] = {}
+        loop_writes: Dict[str, List] = {}
+        for name, node in methods.items():
+            if name == "__init__":
+                continue
+            bucket = worker_writes if name in worker else loop_writes
+            for attr, site, locked in writes(node):
+                bucket.setdefault(attr, []).append((name, site, locked))
+
+        out: List[Finding] = []
+        for attr in sorted(set(worker_writes) & set(loop_writes)):
+            w_sites = worker_writes[attr]
+            l_sites = loop_writes[attr]
+            unlocked = [s for s in w_sites + l_sites if not s[2]]
+            if not unlocked:
+                continue
+            name, site, _ = unlocked[0]
+            out.append(ctx.finding(
+                self, site,
+                "self.%s is written from both the worker-thread path "
+                "(%s) and loop code (%s) without a lock — use a lock or "
+                "the Tracer fixed-slot pattern" % (
+                    attr,
+                    "/".join(sorted({s[0] for s in w_sites})),
+                    "/".join(sorted({s[0] for s in l_sites}))),
+                symbol="%s.%s" % (cls.name, name)))
+        return out

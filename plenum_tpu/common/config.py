@@ -89,6 +89,13 @@ class Config:
     VERIFIER_PROVIDER = "adaptive"
     VERIFIER_DAEMON_HOST = "127.0.0.1"
     VERIFIER_DAEMON_PORT = 9988
+    # verify-daemon coalescing (server/verify_daemon.py): window seconds
+    # a first frame waits for co-resident nodes' frames; device launches
+    # are chunked to exactly BUCKET items (one compiled shape); fused
+    # batches below CPU_FLOOR take the OpenSSL path
+    VERIFY_DAEMON_WINDOW = 0.002
+    VERIFY_DAEMON_BUCKET = 4096
+    VERIFY_DAEMON_CPU_FLOOR = 512
     # seconds a dispatched client-auth batch may stay in flight before
     # the prod loop harvests it blocking (wedged daemon/device fallback)
     CLIENT_AUTH_TIMEOUT = 10.0
@@ -126,6 +133,11 @@ class Config:
     # OFF by default, matching the reference (node.py:2883 "TODO:
     # Consider blacklisting nodes again"); suspicions are always logged
     BLACKLIST_ON_SUSPICION = False
+
+    # ---- request-handler caches (server/request_handlers.py): NYM
+    # record lookups memoized per uncommitted view; bounded because
+    # identifiers are client-chosen (attacker-controlled allocation)
+    NYM_CACHE_MAX = 4096
 
     # ---- storage
     domainStateStorage = "memory"

@@ -14,10 +14,14 @@ Rust ursa for exactly these operations.
 from __future__ import annotations
 
 import ctypes
+import logging
+import subprocess
 from typing import List, Optional, Sequence, Tuple
 
 from plenum_tpu.crypto.bls12_381 import (
     Fq2, G1Point, G2Point, Q, R)
+
+logger = logging.getLogger(__name__)
 
 _lib = None
 _build_error: Optional[Exception] = None
@@ -63,7 +67,13 @@ def available() -> bool:
     try:
         _get_lib()
         return True
-    except Exception as e:
+    except (OSError, AttributeError, ValueError,
+            subprocess.SubprocessError) as e:
+        # the compile/dlopen/symbol-binding failure surface, narrowed
+        # (PT006): cc missing/failing (SubprocessError, FileNotFound),
+        # bad .so (OSError), stale lib missing a symbol (AttributeError)
+        if _build_error is None:
+            logger.debug("native BLS backend unavailable: %s", e)
         _build_error = e
         return False
 

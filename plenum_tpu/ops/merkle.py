@@ -87,7 +87,8 @@ def _to_default_device(levels):
     device-to-device copy after a sharded build keeps every downstream
     path byte-identical and oblivious."""
     import jax
-    dev = jax.devices()[0]
+    from plenum_tpu.ops import mesh as mesh_mod
+    dev = mesh_mod.default_device()
     return [jax.device_put(lv, dev) for lv in levels]
 
 
@@ -746,9 +747,14 @@ class ProofPipeline:
             yield out
 
     def run(self, indices: Sequence[int], n: Optional[int] = None,
-            chunk: int = 4096) -> List[List[bytes]]:
+            chunk: int = None) -> List[List[bytes]]:
         """Split one large proof request into pipelined chunks and
-        return the concatenated per-leaf paths."""
+        return the concatenated per-leaf paths. chunk defaults from
+        Config.MERKLE_DEVICE_PROOF_CHUNK (single-sourced; explicit
+        callers — the ledger routing — pass their own)."""
+        if chunk is None:
+            from plenum_tpu.common.config import Config
+            chunk = Config.MERKLE_DEVICE_PROOF_CHUNK
         idx = list(indices)
         if not idx:
             return []

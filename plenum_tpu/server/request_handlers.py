@@ -212,7 +212,8 @@ class NymHandler(WriteRequestHandler):
             return rec
         rec, _, _ = decode_state_value(self.state.get(
             nym_to_state_key(identifier), isCommitted=False))
-        if len(self._nym_cache) > 4096:
+        from plenum_tpu.common.config import Config
+        if len(self._nym_cache) > Config.NYM_CACHE_MAX:
             self._nym_cache.clear()
         self._nym_cache[identifier] = rec
         return rec

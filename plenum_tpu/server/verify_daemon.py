@@ -47,22 +47,28 @@ WRITE_HIGH_WATER = 8 * 1024 * 1024
 
 class VerifyDaemon:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 backend: str = "adaptive", window: float = 0.002,
-                 bucket: int = 4096, cpu_floor: int = 512):
+                 backend: str = "adaptive", window: float = None,
+                 bucket: int = None, cpu_floor: int = None):
         """bucket: device launches are chunked to EXACTLY this many items
         (padded by repetition) so XLA compiles ONE batch shape — variable
         shapes would hit a fresh ~100 s compile mid-run. cpu_floor:
         fused batches below this take the OpenSSL path (a near-empty
         device launch costs more than scalar verification). Both only
-        apply to device backends; backend="cpu" verifies directly."""
+        apply to device backends; backend="cpu" verifies directly.
+        None defaults single-source from Config.VERIFY_DAEMON_* (the
+        VERIFIER_BATCH_THRESHOLD precedent); explicit args win."""
+        from plenum_tpu.common.config import Config
         from plenum_tpu.crypto.batch_verifier import create_verifier
         self.host = host
         self.port = port
         self._backend_name = backend
         self._verifier = create_verifier(backend)
-        self._bucket = bucket
-        self._cpu_floor = cpu_floor
-        self._window = window
+        self._bucket = Config.VERIFY_DAEMON_BUCKET \
+            if bucket is None else bucket
+        self._cpu_floor = Config.VERIFY_DAEMON_CPU_FLOOR \
+            if cpu_floor is None else cpu_floor
+        self._window = Config.VERIFY_DAEMON_WINDOW \
+            if window is None else window
         self._queue: asyncio.Queue = asyncio.Queue()
         # one worker thread: device launches must serialize anyway, and a
         # busy worker is exactly what lets the NEXT batch coalesce deeper
@@ -229,7 +235,9 @@ class VerifyDaemon:
                     uniq_results = await loop.run_in_executor(
                         self._pool, self._verify_bucketed, order)
                 results = [uniq_results[i] for i in index]
-            except Exception:
+            except Exception:  # plenum-lint: disable=PT006 — the daemon
+                # serves every node on the host: ANY backend failure
+                # must answer all-False and keep the batcher alive
                 logger.warning("verify batch failed", exc_info=True)
                 results = [False] * len(all_items)
             logger.debug("batch done in %.2fs", loop.time() - t_launch)
@@ -270,8 +278,8 @@ class VerifyDaemon:
 
 
 async def run_daemon(host="127.0.0.1", port=0, backend="adaptive",
-                     ready_file=None, window: float = 0.002,
-                     bucket: int = 4096, cpu_floor: int = 512,
+                     ready_file=None, window: float = None,
+                     bucket: int = None, cpu_floor: int = None,
                      trace_file=None):
     daemon = VerifyDaemon(host, port, backend, window=window,
                           bucket=bucket, cpu_floor=cpu_floor)
@@ -285,7 +293,9 @@ async def run_daemon(host="127.0.0.1", port=0, backend="adaptive",
         mesh_mod.get_mesh().tracer = daemon.tracer
     await daemon.start()
     if ready_file:
-        with open(ready_file, "w") as f:
+        # one-shot startup handshake before any frame is served — not a
+        # hot-loop write
+        with open(ready_file, "w") as f:  # plenum-lint: disable=PT001
             f.write(str(daemon.port))
     while True:
         await asyncio.sleep(3600)
@@ -297,9 +307,15 @@ def main():  # pragma: no cover - exercised via subprocess in bench
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--backend", default="adaptive")
-    ap.add_argument("--window", type=float, default=0.002)
-    ap.add_argument("--bucket", type=int, default=4096)
-    ap.add_argument("--cpu-floor", type=int, default=512)
+    ap.add_argument("--window", type=float, default=None,
+                    help="coalescing window s (default: "
+                         "Config.VERIFY_DAEMON_WINDOW)")
+    ap.add_argument("--bucket", type=int, default=None,
+                    help="device launch bucket (default: "
+                         "Config.VERIFY_DAEMON_BUCKET)")
+    ap.add_argument("--cpu-floor", type=int, default=None,
+                    help="OpenSSL floor (default: "
+                         "Config.VERIFY_DAEMON_CPU_FLOOR)")
     ap.add_argument("--ready-file", default=None,
                     help="write the bound port here once listening")
     ap.add_argument("--trace-file", default=None,

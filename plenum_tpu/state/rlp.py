@@ -146,11 +146,15 @@ encode_py = _encode_py
 decode_py = decode
 encode = _encode_py
 
-try:                                   # pragma: no branch
-    from plenum_tpu.native import build_and_import
-    _c = build_and_import("rlp_c")
+# the central optional-native guard (native.try_load_ext) owns the
+# build-failure policy — no local broad except (PT006), and the
+# PLENUM_TPU_NO_NATIVE kill-switch now covers the RLP codec too
+from plenum_tpu.native import try_load_ext
+
+_c = try_load_ext("rlp_c")
+if _c is not None:
     encode = _c.encode
     decode = _c.decode
     BACKEND = "native"
-except Exception:                      # pragma: no cover - cc missing
+else:                                  # pragma: no cover - cc missing
     BACKEND = "python"

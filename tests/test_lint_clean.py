@@ -1,0 +1,51 @@
+"""Tier-1 lint gate — the tree must be clean against the baseline.
+
+Runs the full PT001–PT006 registry over ``plenum_tpu/`` in-process
+(pure stdlib ast: no JAX init, no subprocess, fast) and fails on ANY
+non-baselined finding. This is what makes every rule a standing
+invariant: re-introducing the PR 1 unauthenticated-propagate hole, an
+eager device probe, or a fresh broad except on a device path fails the
+ordinary verify run with the finding text in the assertion.
+
+Workflow when this fails: fix the finding, suppress the line with
+``# plenum-lint: disable=PTxxx`` and a reason, or add a justified entry
+to lint_baseline.json — see docs/static_analysis.md.
+"""
+import os
+
+from plenum_tpu.analysis import repo_root, run_analysis
+
+REPO = repo_root()
+BASELINE = os.path.join(REPO, "lint_baseline.json")
+
+
+def test_plenum_tpu_is_lint_clean():
+    new, baselined, baseline = run_analysis(
+        [os.path.join(REPO, "plenum_tpu")], root=REPO,
+        baseline_path=BASELINE)
+    assert new == [], (
+        "plenum-lint found %d non-baselined finding(s):\n%s\n\n"
+        "Fix it, add an inline '# plenum-lint: disable=PTxxx' with a "
+        "reason, or baseline it with a justification "
+        "(docs/static_analysis.md)." % (
+            len(new), "\n".join(f.render() for f in new)))
+
+
+def test_baseline_has_no_stale_entries():
+    """Fixed code must shed its baseline entries — a stale entry could
+    silently absorb a future regression elsewhere in the file."""
+    new, baselined, baseline = run_analysis(
+        [os.path.join(REPO, "plenum_tpu")], root=REPO,
+        baseline_path=BASELINE)
+    assert baseline.stale() == [], (
+        "stale lint_baseline.json entries (the code they matched was "
+        "fixed — prune them): %r" % (baseline.stale(),))
+
+
+def test_baseline_entries_are_justified():
+    from plenum_tpu.analysis.baseline import Baseline
+    base = Baseline.load(BASELINE)
+    for e in base.entries:
+        just = e.get("justification", "")
+        assert just and "TODO" not in just, (
+            "baseline entry without a real justification: %r" % (e,))

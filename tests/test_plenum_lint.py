@@ -1,0 +1,652 @@
+"""plenum-lint rule fixtures — every rule must fire on its historical
+bug shape and stay quiet on the fixed shape.
+
+Each PTxxx case pins (bad → fires, good → clean) against snippets
+modeled on the actual incidents: PT003's bad fixture IS the pre-PR-1
+propagator pattern, PT002's the eager-device-probe/asarray-in-dispatch
+shapes PR 4 removed, PT006's the broad excepts PR 2 narrowed. Plus
+pragma suppression, baseline round-trip/count/stale semantics, the
+--json schema, and CLI plumbing (--changed empty diff, --select /
+--disable / --severity, unknown-code rejection).
+"""
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from plenum_tpu.analysis import repo_root, run_analysis
+from plenum_tpu.analysis.baseline import Baseline
+from plenum_tpu.analysis.core import Analyzer, ModuleContext
+from plenum_tpu.analysis.cli import main as cli_main
+from plenum_tpu.analysis.rules import RULE_CLASSES, build_rules
+from plenum_tpu.analysis.rules.pt005_config_drift import (
+    ConfigLiteralDriftRule, load_config_values)
+
+REPO = repo_root()
+
+
+def check_snippet(rule, source, rel_path):
+    """Run one rule over an in-memory module."""
+    source = textwrap.dedent(source)
+    ctx = ModuleContext(rel_path, source, ast.parse(source))
+    assert rule.applies(rel_path), (rule.code, rel_path)
+    findings = [f for f in rule.check(ctx)
+                if not ctx.suppressed(f.rule, f.line)]
+    return findings
+
+
+def rule_by_code(code, **kwargs):
+    for cls in RULE_CLASSES:
+        if cls.code == code:
+            return cls(**kwargs) if kwargs else cls()
+    raise AssertionError(code)
+
+
+# --------------------------------------------------------------- PT001
+
+PT001_BAD = """
+    import time
+
+    class Service:
+        def process_propagate(self, msg, frm):
+            time.sleep(0.1)
+
+        async def serve_forever(self):
+            data = open("/tmp/x").read()
+            return self.pending.result(), data
+"""
+
+PT001_GOOD = """
+    import asyncio
+
+    class Service:
+        def process_propagate(self, msg, frm):
+            self.queue.append(msg)
+
+        async def serve_forever(self):
+            await asyncio.sleep(0.1)
+            out = await self.loop.run_in_executor(None, self.work)
+            return out
+"""
+
+
+def test_pt001_fires_on_blocking_calls_in_handlers():
+    findings = check_snippet(rule_by_code("PT001"), PT001_BAD,
+                             "plenum_tpu/server/svc.py")
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "time.sleep" in msgs
+    assert "Future.result()" in msgs
+    assert "open()" in msgs
+
+
+def test_pt001_clean_on_async_idioms():
+    assert check_snippet(rule_by_code("PT001"), PT001_GOOD,
+                         "plenum_tpu/consensus/svc.py") == []
+
+
+def test_pt001_scoped_to_server_and_consensus():
+    rule = rule_by_code("PT001")
+    assert not rule.applies("plenum_tpu/ops/merkle.py")
+    assert not rule.applies("plenum_tpu/client/client.py")
+
+
+# --------------------------------------------------------------- PT002
+
+PT002_BAD = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _probe():
+        return jax.devices()[0].platform   # the pre-PR-4 eager probe
+
+    def dispatch_batch(rows):
+        out = _kernel(jnp.asarray(rows))
+        out.block_until_ready()
+        return np.asarray(out)
+"""
+
+PT002_GOOD = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def dispatch_batch(rows):
+        idx = np.asarray(list(rows))       # host data: no taint
+        return _kernel(jnp.asarray(idx))   # un-awaited device handle
+
+    def collect_batch(handle):
+        return np.asarray(handle)          # collect half MAY sync
+"""
+
+
+def test_pt002_fires_on_eager_probe_and_dispatch_syncs():
+    findings = check_snippet(rule_by_code("PT002"), PT002_BAD,
+                             "plenum_tpu/ops/newkernel.py")
+    rules_hit = [f.message.split(" ")[0] for f in findings]
+    assert len(findings) == 3, findings
+    assert any("jax.devices" in f.message for f in findings)
+    assert any("block_until_ready" in f.message for f in findings)
+    assert any("np.asarray() on a device array" in f.message
+               for f in findings)
+    del rules_hit
+
+
+def test_pt002_clean_on_async_dispatch_and_collect():
+    assert check_snippet(rule_by_code("PT002"), PT002_GOOD,
+                         "plenum_tpu/ops/newkernel.py") == []
+
+
+def test_pt002_mesh_module_is_exempt():
+    assert not rule_by_code("PT002").applies("plenum_tpu/ops/mesh.py")
+
+
+def test_pt002_nested_def_does_not_leak_taint():
+    """A nested worker's device locals are a different scope: they must
+    not taint the outer dispatch half's same-named host variables."""
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def dispatch_batch(rows):
+            def worker(x):
+                out = jnp.add(x, x)
+                return out
+            out = [1, 2, 3]               # host list, same name
+            return int(out[0]), np.asarray(out), worker
+    """
+    assert check_snippet(rule_by_code("PT002"), src,
+                         "plenum_tpu/ops/newkernel.py") == []
+
+
+def test_pt002_taint_chains_resolve_regardless_of_order():
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def dispatch_batch(rows):
+            c = b                          # chain head textually first
+            b = a
+            a = jnp.asarray(rows)
+            return np.asarray(c)           # still a device sync
+    """
+    findings = check_snippet(rule_by_code("PT002"), src,
+                             "plenum_tpu/ops/newkernel.py")
+    assert len(findings) == 1
+    assert "np.asarray() on a device array" in findings[0].message
+
+
+# --------------------------------------------------------------- PT003
+
+# the literal pre-PR-1 propagator shape: first-sighting payloads enter
+# the vote-collecting state without authentication
+PT003_BAD = """
+    class Propagator:
+        def _process_one(self, payload, sender_client, frm):
+            state = self.requests.lookup_state(payload)
+            if state is None:
+                state = self.requests.add(Request.from_dict(payload))
+            state.propagates.add(frm)
+            if self.quorums.propagate.is_reached(len(state.propagates)):
+                self._finalise(state)
+"""
+
+PT003_GOOD = """
+    class Propagator:
+        def _process_one(self, payload, sender_client, frm):
+            state = self.requests.lookup_state(payload)
+            if state is None:
+                request = Request.from_dict(payload)
+                if self._authenticator is not None \\
+                        and not self._authenticator(request):
+                    return
+                state = self.requests.add(request)
+            state.propagates.add(frm)
+            if self.quorums.propagate.is_reached(len(state.propagates)):
+                self._finalise(state)
+
+        def propagate(self, request, client_name):
+            # client-intake path: no frm param, authenticated at intake
+            state = self.requests.add(request)
+            state.propagates.add(self.name)
+"""
+
+
+def test_pt003_fires_on_pre_pr1_propagator_pattern():
+    findings = check_snippet(rule_by_code("PT003"), PT003_BAD,
+                             "plenum_tpu/server/propagator.py")
+    assert len(findings) == 1
+    assert "without an authenticator check" in findings[0].message
+    assert findings[0].symbol == "Propagator._process_one"
+
+
+def test_pt003_clean_on_authenticated_handler():
+    assert check_snippet(rule_by_code("PT003"), PT003_GOOD,
+                         "plenum_tpu/server/propagator.py") == []
+
+
+def test_pt003_live_gate_on_real_propagator():
+    """Stripping the authenticator gate from the REAL propagator must
+    produce a non-baselined PT003 — the regression the rule exists
+    for."""
+    path = os.path.join(REPO, "plenum_tpu", "server", "propagator.py")
+    with open(path) as f:
+        src = f.read()
+    assert "_authenticator" in src
+    hole = src.replace("self._authenticator", "self._ignored")
+    ctx = ModuleContext("plenum_tpu/server/propagator.py", hole,
+                        ast.parse(hole))
+    findings = rule_by_code("PT003").check(ctx)
+    assert any(f.symbol == "Propagator._process_one" for f in findings)
+    # and the current source stays clean
+    ctx2 = ModuleContext("plenum_tpu/server/propagator.py", src,
+                         ast.parse(src))
+    assert rule_by_code("PT003").check(ctx2) == []
+
+
+# --------------------------------------------------------------- PT004
+
+PT004_BAD = """
+    import threading
+
+    class Daemon:
+        def start(self):
+            self._t = threading.Thread(target=self._work)
+            self._t.start()
+
+        def _work(self):
+            self.count += 1
+
+        def report(self):
+            self.count = 0
+"""
+
+PT004_GOOD = """
+    import threading
+
+    class Daemon:
+        def start(self):
+            self._t = threading.Thread(target=self._work)
+            self._t.start()
+
+        def _work(self):
+            with self._lock:
+                self.count += 1
+            self._buf[0] = "x"      # fixed-slot write: not a rebind
+
+        def report(self):
+            with self._lock:
+                self.count = 0
+            self._buf[1] = "y"
+"""
+
+
+def test_pt004_fires_on_unlocked_cross_thread_writes():
+    findings = check_snippet(rule_by_code("PT004"), PT004_BAD,
+                             "plenum_tpu/server/daemon.py")
+    assert len(findings) == 1
+    assert "self.count" in findings[0].message
+
+
+def test_pt004_clean_on_locked_and_fixed_slot_writes():
+    assert check_snippet(rule_by_code("PT004"), PT004_GOOD,
+                         "plenum_tpu/server/daemon.py") == []
+
+
+# --------------------------------------------------------------- PT005
+
+PT005_BAD = """
+    def make_daemon(bucket: int = 4096, floor=512):
+        pass
+
+    def route(n):
+        if n >= 2048:
+            return "device"
+        return "host"
+"""
+
+PT005_GOOD = """
+    def make_daemon(bucket: int = None, floor=None):
+        from plenum_tpu.common.config import Config
+        bucket = Config.VERIFY_DAEMON_BUCKET if bucket is None else bucket
+
+    def widths(sig, vk):
+        # equality width checks and shape math are structure, not knobs
+        ok = len(sig) != 64 and len(vk) == 32
+        buf = 64 * 1024 * 1024
+        return ok, buf, sig[32:]
+"""
+
+
+def _pt005_rule():
+    values = load_config_values(
+        os.path.join(REPO, "plenum_tpu", "common", "config.py"))
+    return ConfigLiteralDriftRule(config_values=values)
+
+
+def test_pt005_fires_on_threshold_shaped_duplicates():
+    findings = check_snippet(_pt005_rule(), PT005_BAD,
+                             "plenum_tpu/server/newdaemon.py")
+    hit = {f.message.split()[1] for f in findings}
+    assert hit == {"4096", "512", "2048"}
+    assert any("MERKLE_DEVICE_PROOF_CHUNK" in f.message
+               or "VERIFY_DAEMON_BUCKET" in f.message for f in findings)
+
+
+def test_pt005_clean_on_config_refs_and_structure_math():
+    assert check_snippet(_pt005_rule(), PT005_GOOD,
+                         "plenum_tpu/server/newdaemon.py") == []
+
+
+def test_pt005_config_values_constant_folding():
+    values = load_config_values(
+        os.path.join(REPO, "plenum_tpu", "common", "config.py"))
+    assert "VERIFY_DAEMON_BUCKET" in values[4096]
+    assert "TRACING_BUFFER_SPANS" in values[1 << 16]   # 1 << 16 folded
+    assert "MSG_LEN_LIMIT" in values[128 * 1024]       # 128 * 1024
+
+
+# --------------------------------------------------------------- PT006
+
+PT006_BAD = """
+    from plenum_tpu.ops import ed25519_jax
+
+    def verify(items):
+        try:
+            return ed25519_jax.verify_batch(items)
+        except Exception:
+            return None
+"""
+
+PT006_GOOD = """
+    from plenum_tpu.ops import ed25519_jax
+
+    def verify(items):
+        try:
+            return ed25519_jax.verify_batch(items)
+        except (AttributeError, NotImplementedError):   # PR 2 precedent
+            return None
+
+    def relog(items):
+        try:
+            return ed25519_jax.verify_batch(items)
+        except Exception:
+            log("failed")
+            raise                       # re-raise: swallows nothing
+"""
+
+
+def test_pt006_fires_on_broad_except_over_device_call():
+    findings = check_snippet(rule_by_code("PT006"), PT006_BAD,
+                             "plenum_tpu/server/v.py")
+    assert len(findings) == 1
+    assert "ed25519_jax.verify_batch" in findings[0].message
+
+
+def test_pt006_clean_on_narrow_or_reraising_handlers():
+    assert check_snippet(rule_by_code("PT006"), PT006_GOOD,
+                         "plenum_tpu/server/v.py") == []
+
+
+def test_pt006_any_call_counts_inside_ops_and_crypto():
+    src = """
+        def load():
+            try:
+                return _local_builder()
+            except Exception:
+                return None
+    """
+    assert check_snippet(rule_by_code("PT006"), src,
+                         "plenum_tpu/crypto/newlib.py")
+    assert not check_snippet(rule_by_code("PT006"), src,
+                             "plenum_tpu/storage/helper2.py")
+
+
+# -------------------------------------------------------------- pragmas
+
+def test_inline_pragma_suppresses_one_line():
+    src = """
+        import time
+
+        def process_x(self, frm):
+            time.sleep(1)  # plenum-lint: disable=PT001
+            time.sleep(2)
+    """
+    findings = check_snippet(rule_by_code("PT001"), src,
+                             "plenum_tpu/server/s.py")
+    assert [f.line for f in findings] == [6]
+
+
+def test_file_level_pragma_and_disable_all():
+    src = """\
+        # plenum-lint: disable=PT001
+        import time
+
+        def process_x(self, frm):
+            time.sleep(1)
+    """
+    assert check_snippet(rule_by_code("PT001"), src,
+                         "plenum_tpu/server/s.py") == []
+    src_all = src.replace("disable=PT001", "disable=all")
+    assert check_snippet(rule_by_code("PT001"), src_all,
+                         "plenum_tpu/server/s.py") == []
+
+
+# ------------------------------------------------------------- baseline
+
+def _fake_findings():
+    from plenum_tpu.analysis.core import Finding
+    f = Finding("PT006", "error", "plenum_tpu/x.py", 10, 4, "msg", "A.b")
+    g = Finding("PT006", "error", "plenum_tpu/x.py", 30, 4, "msg", "A.b")
+    h = Finding("PT001", "error", "plenum_tpu/y.py", 5, 0, "other", "C.d")
+    return [f, g, h]
+
+
+def test_baseline_round_trip_and_count_semantics(tmp_path):
+    findings = _fake_findings()
+    base = Baseline.from_findings(findings, justification="because")
+    path = str(tmp_path / "baseline.json")
+    base.save(path)
+    loaded = Baseline.load(path)
+    # duplicate (rule,path,symbol,message) collapses to count=2
+    assert len(loaded.entries) == 2
+    assert any(e.get("count") == 2 for e in loaded.entries)
+    assert all(e["justification"] == "because" for e in loaded.entries)
+    new, old = loaded.match(findings)
+    assert new == [] and len(old) == 3
+    # a third identical finding exceeds the count budget → new
+    extra = findings + [findings[0]]
+    new, old = loaded.match(extra)
+    assert len(new) == 1 and len(old) == 3
+
+
+def test_baseline_stale_and_line_drift(tmp_path):
+    findings = _fake_findings()
+    base = Baseline.from_findings(findings)
+    drifted = [f.__class__(f.rule, f.severity, f.path, f.line + 100,
+                           f.col, f.message, f.symbol) for f in findings]
+    new, old = base.match(drifted[:2])          # y.py finding fixed
+    assert new == [] and len(old) == 2          # lines don't matter
+    assert ("PT001", "plenum_tpu/y.py", "C.d", "other") in base.stale()
+
+
+def test_baseline_missing_file_is_empty(tmp_path):
+    base = Baseline.load(str(tmp_path / "nope.json"))
+    assert base.entries == []
+    new, old = base.match(_fake_findings())
+    assert len(new) == 3 and old == []
+
+
+def test_baseline_version_mismatch_rejected(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"version": 99, "entries": []}))
+    with pytest.raises(ValueError):
+        Baseline.load(str(path))
+
+
+# ------------------------------------------------------------------ CLI
+
+def run_cli(args, capsys):
+    code = cli_main(args)
+    out = capsys.readouterr().out
+    return code, out
+
+
+def test_cli_json_schema_stability(capsys):
+    code, out = run_cli(
+        ["--json", os.path.join(REPO, "plenum_tpu", "ops", "mesh.py")],
+        capsys)
+    data = json.loads(out)
+    assert code == 0
+    assert sorted(data) == ["findings", "summary", "tool", "version"]
+    assert data["version"] == 1 and data["tool"] == "plenum-lint"
+    assert sorted(data["summary"]) == [
+        "baselined", "errors", "files", "findings", "new", "warnings"]
+
+
+def test_cli_json_finding_keys(tmp_path, capsys):
+    bad = tmp_path / "plenum_tpu" / "server"
+    bad.mkdir(parents=True)
+    (bad / "s.py").write_text(textwrap.dedent(PT001_BAD))
+    code, out = run_cli(["--json", "--no-baseline",
+                         "--root", str(tmp_path), str(bad / "s.py")],
+                        capsys)
+    data = json.loads(out)
+    assert code == 1
+    assert data["summary"]["errors"] == 3
+    for f in data["findings"]:
+        assert sorted(f) == ["baselined", "col", "line", "message",
+                             "path", "rule", "severity", "symbol"]
+
+
+def test_cli_unknown_rule_code_rejected(capsys):
+    code, _ = run_cli(["--disable", "PT999"], capsys)
+    assert code == 2
+
+
+def test_cli_severity_override_downgrades_exit(tmp_path, capsys):
+    bad = tmp_path / "plenum_tpu" / "server"
+    bad.mkdir(parents=True)
+    (bad / "s.py").write_text(textwrap.dedent(PT001_BAD))
+    code, _ = run_cli(["--no-baseline", "--root", str(tmp_path),
+                       "--severity", "PT001=warning", str(bad / "s.py")],
+                      capsys)
+    assert code == 0
+
+
+def test_cli_select_runs_single_rule(tmp_path, capsys):
+    bad = tmp_path / "plenum_tpu" / "server"
+    bad.mkdir(parents=True)
+    (bad / "s.py").write_text(textwrap.dedent(PT001_BAD))
+    code, out = run_cli(["--json", "--no-baseline", "--select", "PT003",
+                         "--root", str(tmp_path), str(bad / "s.py")],
+                        capsys)
+    assert code == 0 and json.loads(out)["summary"]["findings"] == 0
+
+
+def test_cli_changed_empty_diff_is_clean(tmp_path, capsys):
+    """--changed against a scope with no changed files: clean message,
+    exit 0 (the metrics_stats empty-store convention)."""
+    code, out = run_cli(["--changed", str(tmp_path)], capsys)
+    assert code == 0
+    assert "no changed Python files" in out
+
+
+def test_cli_changed_fails_closed_without_git(tmp_path, capsys):
+    """--root outside any git repo: the pre-commit gate must error
+    (exit 2), never read a broken git as an empty diff."""
+    code = cli_main(["--changed", "--root", str(tmp_path)])
+    capsys.readouterr()
+    assert code == 2
+
+
+def test_cli_changed_scope_respects_path_boundaries(tmp_path, capsys):
+    """--changed with a scope of .../server must not pull in the
+    sibling .../server_extra.py via bare prefix matching."""
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    subprocess.run(["git", "-C", str(tmp_path), "-c", "user.name=t",
+                    "-c", "user.email=t@t", "commit", "-q",
+                    "--allow-empty", "-m", "init"], check=True)
+    pkg = tmp_path / "plenum_tpu"
+    (pkg / "server").mkdir(parents=True)
+    (pkg / "server" / "s.py").write_text(textwrap.dedent(PT001_BAD))
+    (pkg / "server_extra.py").write_text(textwrap.dedent(PT001_BAD))
+    code, out = run_cli(["--changed", "--json", "--no-baseline",
+                         "--root", str(tmp_path),
+                         str(pkg / "server")], capsys)
+    data = json.loads(out)
+    paths = {f["path"] for f in data["findings"]}
+    assert data["summary"]["files"] == 1
+    assert paths == {"plenum_tpu/server/s.py"}
+
+
+def test_cli_nonexistent_path_errors(capsys):
+    code, _ = run_cli([os.path.join(REPO, "plenum_tpu_TYPO")], capsys)
+    assert code == 2
+
+
+def test_cli_scoped_write_baseline_keeps_out_of_scope_entries(
+        tmp_path, capsys):
+    pkg = tmp_path / "plenum_tpu" / "server"
+    pkg.mkdir(parents=True)
+    (pkg / "a.py").write_text(textwrap.dedent(PT001_BAD))
+    (pkg / "b.py").write_text(textwrap.dedent(PT001_BAD))
+    bpath = tmp_path / "baseline.json"
+    code, _ = run_cli(["--root", str(tmp_path), "--baseline", str(bpath),
+                       "--write-baseline", str(pkg)], capsys)
+    assert code == 0
+    full = Baseline.load(str(bpath))
+    # re-writing scoped to ONE file must keep the other file's entries
+    code, _ = run_cli(["--root", str(tmp_path), "--baseline", str(bpath),
+                       "--write-baseline", str(pkg / "a.py")], capsys)
+    assert code == 0
+    merged = Baseline.load(str(bpath))
+    assert {e["path"] for e in merged.entries} \
+        == {e["path"] for e in full.entries}
+    code, _ = run_cli(["--root", str(tmp_path), "--baseline", str(bpath),
+                       str(pkg)], capsys)
+    assert code == 0
+
+
+def test_cli_write_baseline_round_trip(tmp_path, capsys):
+    bad = tmp_path / "plenum_tpu" / "server"
+    bad.mkdir(parents=True)
+    (bad / "s.py").write_text(textwrap.dedent(PT001_BAD))
+    bpath = tmp_path / "baseline.json"
+    code, _ = run_cli(["--root", str(tmp_path), "--baseline", str(bpath),
+                       "--write-baseline", str(bad / "s.py")], capsys)
+    assert code == 0 and bpath.exists()
+    code, _ = run_cli(["--root", str(tmp_path), "--baseline", str(bpath),
+                       str(bad / "s.py")], capsys)
+    assert code == 0      # everything grandfathered
+
+
+def test_script_entry_point_runs():
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "plenum_lint"),
+         "--list-rules"], capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0
+    for cls in RULE_CLASSES:
+        assert cls.code in res.stdout
+
+
+# ------------------------------------------------------------ integration
+
+def test_parse_error_becomes_pt000(tmp_path):
+    f = tmp_path / "broken.py"
+    f.write_text("def oops(:\n")
+    analyzer = Analyzer(build_rules(root=str(tmp_path)), str(tmp_path))
+    findings = analyzer.run_files([str(f)])
+    assert [x.rule for x in findings] == ["PT000"]
+
+
+def test_run_analysis_matches_shipped_baseline():
+    new, baselined, _ = run_analysis(
+        [os.path.join(REPO, "plenum_tpu")], root=REPO,
+        baseline_path=os.path.join(REPO, "lint_baseline.json"))
+    assert new == [], "\n".join(f.render() for f in new)
+    assert len(baselined) > 0
