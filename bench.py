@@ -768,6 +768,98 @@ def micro_merkle(n_leaves=None):
     }
 
 
+def micro_state():
+    """BENCH_r06 config: the device MPT state engine
+    (state/device_state.py) vs the pure-Python trie floor — batched
+    multi-key get, whole-batch apply (level-wise SHA3 dispatches), and
+    batched SPV proof generation, the three serving shapes behind
+    PruningState. Floors run the identical work through the host
+    Trie one key at a time (the pre-engine state of state/)."""
+    from plenum_tpu.state.device_state import DeviceStateEngine
+    from plenum_tpu.state.trie import BLANK_ROOT, Trie
+    from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+
+    n_base = int(os.environ.get("BENCH_STATE_BASE", "20000"))
+    n_batch = int(os.environ.get("BENCH_STATE_BATCH", "2000"))
+    base = [(b"did:bench:%012d" % i,
+             b'{"val":{"verkey":"~%020d"},"lsn":%d,"lut":1600000000}'
+             % (i, i)) for i in range(n_base)]
+    batch = base[:n_batch]
+    keys = [k for k, _ in batch]
+    fresh = [(b"did:fresh:%012d" % i, v) for i, (_, v) in
+             enumerate(batch)]
+
+    kv = KeyValueStorageInMemory()
+    eng = DeviceStateEngine(kv)
+    root = eng.apply_batch(BLANK_ROOT, base)  # build + warm compile
+    eng.get_batch(root, keys)
+    eng.proof_batch(root, keys[:64])
+
+    # apply: a 3PC-batch-sized write set onto the standing trie (the
+    # root moves, so each timed round applies onto the SAME base root)
+    def apply_round():
+        return eng.apply_batch(root, fresh)
+    apply_round()
+    t_b, t_m = best_median_time(apply_round)
+    apply_rate, apply_rate_median = n_batch / t_b, n_batch / t_m
+
+    t_b, t_m = best_median_time(lambda: eng.get_batch(root, keys))
+    get_rate, get_rate_median = n_batch / t_b, n_batch / t_m
+
+    t_b, t_m = best_median_time(lambda: eng.proof_batch(root, keys))
+    proof_rate, proof_rate_median = n_batch / t_b, n_batch / t_m
+
+    # pure-Python floor: identical content through the host trie
+    kvf = KeyValueStorageInMemory()
+    floor = Trie(kvf)
+    t0 = time.perf_counter()
+    for k, v in base:
+        floor.set(k, v)
+    floor_build_per_s = n_base / (time.perf_counter() - t0)
+    froot = floor.root_hash
+    assert froot == root, "engine root must be byte-equal to the floor"
+
+    shadow = Trie(kvf, froot)
+    t0 = time.perf_counter()
+    for k, v in fresh:
+        shadow.set(k, v)
+    floor_apply_per_s = n_batch / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for k in keys:
+        floor.get(k)
+    floor_get_per_s = n_batch / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for k in keys:
+        floor.produce_spv_proof(k, froot)
+    floor_proof_per_s = n_batch / (time.perf_counter() - t0)
+
+    return {
+        "base_keys": n_base,
+        "batch": n_batch,
+        "apply_keys_per_s": round(apply_rate, 1),
+        "apply_keys_per_s_median": round(apply_rate_median, 1),
+        "get_keys_per_s": round(get_rate, 1),
+        "get_keys_per_s_median": round(get_rate_median, 1),
+        "proofs_per_s": round(proof_rate, 1),
+        "proofs_per_s_median": round(proof_rate_median, 1),
+        "python_floor": {
+            "build_keys_per_s": round(floor_build_per_s, 1),
+            "apply_keys_per_s": round(floor_apply_per_s, 1),
+            "get_keys_per_s": round(floor_get_per_s, 1),
+            "proofs_per_s": round(floor_proof_per_s, 1),
+        },
+        "vs_python_apply": round(apply_rate / floor_apply_per_s, 2),
+        "vs_python_get": round(get_rate / floor_get_per_s, 2),
+        "vs_python_proofs": round(proof_rate / floor_proof_per_s, 2),
+        "note": "floor gets/proofs TRUST the store (zero hashing); the "
+                "engine re-verifies every node hash while serving, so "
+                "vs_python_get/proofs price added integrity too",
+        "engine": eng.stats(),
+    }
+
+
 def pool25_backlog(provider=None, mesh=True):
     """BASELINE config 5: 25-node simulated pool, mixed read/write
     against a 50k-request backlog. Default provider is the shared TPU
@@ -1149,6 +1241,7 @@ def main():
     mk = micro_merkle()
     mesh_res = micro_mesh()
     bls_results = micro_bls()
+    state_res = micro_state()
     p25 = pool25_both()
 
     print(json.dumps({
@@ -1191,6 +1284,7 @@ def main():
             "merkle": mk,
             "mesh": mesh_res,
             "bls": bls_results,
+            "state": state_res,
             "pool25_backlog": p25,
             "tracing_overhead": tracing,
         },
@@ -1211,6 +1305,9 @@ def main():
             "bls_n100_aggregate": (bls_results.get("by_n", {})
                                    .get("100", {})
                                    .get("aggregate_per_s")),
+            "state_proofs_per_s": state_res["proofs_per_s"],
+            "state_vs_python_proofs": state_res["vs_python_proofs"],
+            "state_vs_python_apply": state_res["vs_python_apply"],
             "pool25_mixed_req_per_s": p25.get("mixed_req_per_s")
             if isinstance(p25, dict) else None,
             "tracing_overhead_pct": tracing["overhead_pct"],

@@ -250,22 +250,38 @@ class PoolClient:
         over an OLD root, e.g. an absence proof predating a committed
         write). Leave it None for historical (timestamped) queries,
         where an old root is the point."""
-        if self._bls_verifier is None or self._bls_keys is None:
-            return False
         if not isinstance(result, dict):
             return False
-        from plenum_tpu.common.constants import (
-            DOMAIN_LEDGER_ID, MULTI_SIGNATURE, PROOF_NODES, ROOT_HASH,
-            STATE_PROOF)
-        sp = result.get(STATE_PROOF)
-        if not isinstance(sp, dict) or MULTI_SIGNATURE not in sp:
-            return False
+        from plenum_tpu.common.constants import STATE_PROOF
         # 1. cheap shape checks first — no pairing work for a reply
         # that could never be proof-confirmed
         kv = self._expected_state_kv(result)
         if kv is None:
             return False
         state_key, state_value = kv
+        return self.verify_proof_dict(result.get(STATE_PROOF), state_key,
+                                      state_value, max_age=max_age,
+                                      now=now)
+
+    def verify_proof_dict(self, sp, key: bytes, value: Optional[bytes],
+                          ledger_id: Optional[int] = None,
+                          max_age: Optional[float] = None,
+                          now: Optional[float] = None) -> bool:
+        """End-to-end check of ONE `{root_hash, proof_nodes,
+        multi_signature}` dict as produced by the server's
+        make_state_proof / make_state_proof_batch: the BLS multi-sig
+        must verify against n-f registered pool keys AND vouch for
+        exactly the proof's root on `ledger_id` (domain by default),
+        and the proof nodes must tie `value` (or its absence, value
+        None) to that root. Every check fails closed."""
+        if self._bls_verifier is None or self._bls_keys is None:
+            return False
+        from plenum_tpu.common.constants import (
+            DOMAIN_LEDGER_ID, MULTI_SIGNATURE, PROOF_NODES, ROOT_HASH)
+        if ledger_id is None:
+            ledger_id = DOMAIN_LEDGER_ID
+        if not isinstance(sp, dict) or MULTI_SIGNATURE not in sp:
+            return False
         try:
             from plenum_tpu.crypto.bls import MultiSignature
             multi = MultiSignature.from_dict(sp[MULTI_SIGNATURE])
@@ -275,7 +291,7 @@ class PoolClient:
         # the ledger this read serves, and recently enough
         if multi.value.state_root_hash != sp.get(ROOT_HASH):
             return False
-        if multi.value.ledger_id != DOMAIN_LEDGER_ID:
+        if multi.value.ledger_id != ledger_id:
             return False
         if max_age is not None:
             ts = multi.value.timestamp
@@ -308,7 +324,7 @@ class PoolClient:
             root = b58decode(sp[ROOT_HASH])
             nodes = PruningState.deserialize_proof(sp[PROOF_NODES])
             return PruningState.verify_state_proof(
-                root, state_key, state_value, nodes)
+                root, key, value, nodes)
         except Exception:
             return False
 

@@ -62,6 +62,22 @@ class Config:
     MERKLE_DEVICE_PROOF_CHUNK = 4096  # pipelined sub-batch size
     MERKLE_DEVICE_PIPELINE_DEPTH = 2  # gathers kept in flight
 
+    # ---- device MPT state engine (state/device_state.py behind
+    # PruningState): batched multi-key get / batch apply / batched SPV
+    # proof generation with level-wise SHA3 dispatches (ops/sha3.py).
+    # Calls below BATCH_MIN keys keep the host trie path (per-call
+    # dispatch latency wins there); inside a batched call, levels with
+    # fewer than HASH_FLOOR nodes hash via hashlib (the root level is
+    # one node — a device round trip per spine level would dominate).
+    STATE_DEVICE_ENGINE = True
+    STATE_DEVICE_BATCH_MIN = 8
+    STATE_DEVICE_HASH_FLOOR = 128
+
+    # decoded-node cache cap per Trie (state/trie.py): ~1-1.5KB per
+    # decoded branch node → tens of MB per trie at the cap; large
+    # enough to hold a full batch's spine working set
+    STATE_DECODE_CACHE_MAX = 1 << 16
+
     # ---- catchup
     CATCHUP_BATCH_SIZE = 5
     CATCHUP_REP_CHUNK = 1000      # txns per CatchupRep message

@@ -40,9 +40,10 @@ class CompactMerkleTree:
     _device_pipeline_depth = _Config.MERKLE_DEVICE_PIPELINE_DEPTH
     _device_engine = None
     # consecutive device failures before the engine is detached (every
-    # failure already falls back to the host memo path)
+    # failure already falls back to the host memo path; policy lives in
+    # utils/device_breaker.py, shared with the state engine seam)
     _DEVICE_MAX_FAILURES = 3
-    _device_fail_count = 0
+    _device_breaker = None
 
     def __init__(self, hasher: TreeHasher = None,
                  hash_store: HashStore = None):
@@ -272,6 +273,10 @@ class CompactMerkleTree:
             from plenum_tpu.ops.merkle import DeviceMerkleTree
             engine = DeviceMerkleTree(self.hasher)
         self._device_engine = engine
+        from plenum_tpu.utils.device_breaker import DeviceCircuitBreaker
+        self._device_breaker = DeviceCircuitBreaker(
+            "device proof engine", "the host memo path",
+            max_failures=self._DEVICE_MAX_FAILURES)
         if proof_min is not None:
             self._device_proof_min = proof_min
         if chunk is not None:
@@ -314,32 +319,22 @@ class CompactMerkleTree:
                 or isinstance(self.hash_store, NullHashStore)
                 or self.hash_store.leaf_count < self._size):
             return None
-        try:
+
+        def attempt():
             if not self._device_sync():
                 return None
             from plenum_tpu.ops.merkle import ProofPipeline
             pipe = ProofPipeline(self._device_engine,
                                  depth=self._device_pipeline_depth)
-            out = pipe.run(ms, n=n, chunk=self._device_proof_chunk)
-            self._device_fail_count = 0
-            return out
-        except Exception:
-            # circuit breaker: one full-traceback warning, then quiet
-            # retries, then detach — a persistently sick device must
-            # not tax (or log-spam) every serving-path batch
-            self._device_fail_count += 1
-            if self._device_fail_count >= self._DEVICE_MAX_FAILURES:
-                logger.warning("device proof engine failed %d times; "
-                               "detaching it (host memo path serves "
-                               "from now on)", self._device_fail_count)
-                self._device_engine = None
-            elif self._device_fail_count == 1:
-                logger.warning("device proof batch failed; serving from "
-                               "the host memo path", exc_info=True)
-            else:
-                logger.debug("device proof batch failed again (%d)",
-                             self._device_fail_count, exc_info=True)
-            return None
+            return pipe.run(ms, n=n, chunk=self._device_proof_chunk)
+
+        # shared circuit breaker (utils/device_breaker.py): every
+        # failure serves this batch from the host memo path; a
+        # persistently sick device is detached
+        ok, out = self._device_breaker.run(attempt, "proof batch")
+        if not ok and self._device_breaker.tripped:
+            self._device_engine = None
+        return out if ok else None
 
     def __copy__(self):
         other = CompactMerkleTree(self.hasher, NullHashStore())

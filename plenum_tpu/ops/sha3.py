@@ -1,0 +1,231 @@
+"""Batched SHA3-256 in JAX — the MPT state engine's device hash path.
+
+The trie (state/trie.py) hashes nodes with ``hashlib.sha3_256`` — NOT
+the SHA-256 the merkle ledger uses (ops/sha256.py) — so the state
+engine needs its own kernel. Same shape as the SHA-256 one: host-side
+padding into fixed-shape word arrays, one compiled executable per
+power-of-two block-count bucket, a ``lax.scan`` over the block axis
+with per-message masking for ragged block counts.
+
+Design notes (TPU-first):
+ - Keccak-f[1600] runs on 64-bit lanes; the VPU is 32-bit, so every
+   lane is an (hi, lo) uint32 pair and the 64-bit rotations decompose
+   into static 32-bit shift/or pairs (rho offsets are compile-time
+   constants, so each lane's rotation is two shifts and an or — no
+   64-bit emulation arithmetic anywhere).
+ - The 24 rounds run under ``lax.fori_loop`` with the round-constant
+   table indexed in-loop; state lives as two [B, 25] uint32 arrays.
+ - SHA3-256 rate is 136 bytes = 17 lanes; absorb XORs the padded block
+   into lanes 0..16 and permutes. The digest is lanes 0..3 serialized
+   little-endian (Keccak convention — the opposite endianness of the
+   SHA-2 kernel's big-endian words).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+RATE_BYTES = 136          # SHA3-256: r = 1088 bits
+RATE_LANES = RATE_BYTES // 8
+
+_RC = np.array([
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+], dtype=np.uint64)
+_RC_HI = (_RC >> 32).astype(np.uint32)
+_RC_LO = (_RC & 0xFFFFFFFF).astype(np.uint32)
+
+# rho rotation offsets, indexed [x][y] for lane x + 5y
+_ROT = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+
+
+# bit width of one lane half — structure of the uint32-pair emulation,
+# named so the rotation arithmetic below reads as what it is
+_HALF_BITS = 32
+
+
+def _rotl64(hi, lo, n: int):
+    """Rotate an (hi, lo) uint32 lane pair left by the STATIC amount n."""
+    n &= 63
+    if n == 0:
+        return hi, lo
+    if n == _HALF_BITS:
+        return lo, hi
+    if n < _HALF_BITS:
+        m = jnp.uint32(n)
+        c = jnp.uint32(_HALF_BITS - n)
+        return (hi << m) | (lo >> c), (lo << m) | (hi >> c)
+    m = jnp.uint32(n - _HALF_BITS)
+    c = jnp.uint32(2 * _HALF_BITS - n)
+    return (lo << m) | (hi >> c), (hi << m) | (lo >> c)
+
+
+def _keccak_round(hi, lo, rc_hi, rc_lo):
+    """One Keccak-f round over lane lists (25 arrays per half)."""
+    c_hi = [hi[x] ^ hi[x + 5] ^ hi[x + 10] ^ hi[x + 15] ^ hi[x + 20]
+            for x in range(5)]
+    c_lo = [lo[x] ^ lo[x + 5] ^ lo[x + 10] ^ lo[x + 15] ^ lo[x + 20]
+            for x in range(5)]
+    for x in range(5):
+        rh, rl = _rotl64(c_hi[(x + 1) % 5], c_lo[(x + 1) % 5], 1)
+        d_hi = c_hi[(x - 1) % 5] ^ rh
+        d_lo = c_lo[(x - 1) % 5] ^ rl
+        for y in range(5):
+            i = x + 5 * y
+            hi[i] = hi[i] ^ d_hi
+            lo[i] = lo[i] ^ d_lo
+    # rho + pi
+    b_hi: List = [None] * 25
+    b_lo: List = [None] * 25
+    for x in range(5):
+        for y in range(5):
+            j = y + 5 * ((2 * x + 3 * y) % 5)
+            b_hi[j], b_lo[j] = _rotl64(hi[x + 5 * y], lo[x + 5 * y],
+                                       _ROT[x][y])
+    # chi
+    out_hi = [None] * 25
+    out_lo = [None] * 25
+    for y in range(5):
+        for x in range(5):
+            i = x + 5 * y
+            i1 = (x + 1) % 5 + 5 * y
+            i2 = (x + 2) % 5 + 5 * y
+            out_hi[i] = b_hi[i] ^ (~b_hi[i1] & b_hi[i2])
+            out_lo[i] = b_lo[i] ^ (~b_lo[i1] & b_lo[i2])
+    out_hi[0] = out_hi[0] ^ rc_hi
+    out_lo[0] = out_lo[0] ^ rc_lo
+    return out_hi, out_lo
+
+
+def _keccak_f(state_hi, state_lo):
+    """Keccak-f[1600] over [..., 25] uint32 half-lane arrays."""
+    rc_hi = jnp.asarray(_RC_HI)
+    rc_lo = jnp.asarray(_RC_LO)
+
+    def round_fn(t, carry):
+        sh, sl = carry
+        hi = [sh[..., i] for i in range(25)]
+        lo = [sl[..., i] for i in range(25)]
+        hi, lo = _keccak_round(hi, lo, rc_hi[t], rc_lo[t])
+        return jnp.stack(hi, axis=-1), jnp.stack(lo, axis=-1)
+
+    return lax.fori_loop(0, 24, round_fn, (state_hi, state_lo))
+
+
+@functools.partial(jax.jit, static_argnames=("nblocks",))
+def _sha3_blocks(blocks, nvalid, nblocks: int):
+    """blocks: [B, nblocks, 17, 2] u32 (lane lo at [..., 0], hi at
+    [..., 1]); nvalid: [B] i32 → digests [B, 8] u32 in little-endian
+    serialization order (l0.lo, l0.hi, l1.lo, …)."""
+    b = blocks.shape[0]
+    state_hi = jnp.zeros((b, 25), dtype=jnp.uint32)
+    state_lo = jnp.zeros((b, 25), dtype=jnp.uint32)
+    pad = ((0, 0), (0, 25 - RATE_LANES))
+
+    def step(carry, xs):
+        sh, sl = carry
+        block, idx = xs
+        nh = sh ^ jnp.pad(block[..., 1], pad)
+        nl = sl ^ jnp.pad(block[..., 0], pad)
+        nh, nl = _keccak_f(nh, nl)
+        mask = (idx < nvalid)[..., None]
+        return (jnp.where(mask, nh, sh), jnp.where(mask, nl, sl)), None
+
+    idxs = jnp.arange(nblocks, dtype=jnp.int32)
+    blocks_t = jnp.moveaxis(blocks, 1, 0)  # [nblocks, B, 17, 2]
+    (state_hi, state_lo), _ = lax.scan(
+        step, (state_hi, state_lo), (blocks_t, idxs))
+    lanes = []
+    for i in range(4):
+        lanes.append(state_lo[..., i])
+        lanes.append(state_hi[..., i])
+    return jnp.stack(lanes, axis=-1)
+
+
+def pad_sha3_messages(msgs: Sequence[bytes], nblocks: int = None
+                      ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Keccak-pad `msgs` (domain suffix 0x06, final 0x80) into
+    ([B, nblocks, 17, 2] u32 half-lane words, [B] i32 block counts)."""
+    need = [len(m) // RATE_BYTES + 1 for m in msgs]
+    maxb = max(need) if need else 1
+    if nblocks is None:
+        # bucket to power of two to bound recompiles
+        nblocks = 1
+        while nblocks < maxb:
+            nblocks *= 2
+    assert maxb <= nblocks
+    n = len(msgs)
+    width = nblocks * RATE_BYTES
+    out = np.zeros((n, width), dtype=np.uint8)
+    ln0 = len(msgs[0]) if msgs else 0
+    if msgs and all(len(m) == ln0 for m in msgs):
+        # uniform lengths (level batches of same-shape nodes): one
+        # vectorized fill, no per-message loop
+        if ln0:
+            out[:, :ln0] = np.frombuffer(b"".join(msgs), dtype=np.uint8) \
+                .reshape(n, ln0)
+        out[:, ln0] = 0x06
+        out[:, need[0] * RATE_BYTES - 1] ^= 0x80
+    elif msgs:
+        # mixed lengths: one flat vectorized scatter (same shape as
+        # ops/sha256.pad_messages — the per-message loop was the host
+        # bottleneck for large mixed batches)
+        lens = np.fromiter((len(m) for m in msgs), dtype=np.int64,
+                           count=n)
+        flat = out.reshape(-1)
+        starts = np.zeros(n, dtype=np.int64)
+        np.cumsum(lens[:-1], out=starts[1:])
+        joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+        rows = np.arange(n, dtype=np.int64)
+        if joined.shape[0]:
+            dst = np.repeat(rows * width, lens) \
+                + (np.arange(joined.shape[0], dtype=np.int64)
+                   - np.repeat(starts, lens))
+            flat[dst] = joined
+        flat[rows * width + lens] = 0x06
+        ends = np.asarray(need, dtype=np.int64) * RATE_BYTES
+        last = rows * width + ends - 1
+        flat[last] = flat[last] ^ 0x80  # may share the 0x06 byte
+    words = out.reshape(n, nblocks, RATE_LANES, 2, 4).astype(np.uint32)
+    # little-endian u32 halves: [..., 0] = lo word, [..., 1] = hi word
+    words = (words[..., 0] | words[..., 1] << 8 | words[..., 2] << 16
+             | words[..., 3] << 24)
+    return words, np.asarray(need, dtype=np.int32), nblocks
+
+
+def digests_to_array(dig: np.ndarray) -> np.ndarray:
+    """[B, 8] u32 little-endian digest words → [B, 32] u8 digest bytes."""
+    arr = np.ascontiguousarray(np.asarray(dig).astype("<u4"))
+    return arr.view(np.uint8).reshape(-1, 32)
+
+
+def digests_to_bytes(dig: np.ndarray) -> List[bytes]:
+    arr = digests_to_array(dig)
+    return [arr[i].tobytes() for i in range(arr.shape[0])]
+
+
+def sha3_256_many(msgs: Sequence[bytes]) -> List[bytes]:
+    """Batched SHA3-256 over arbitrary same-or-mixed-length messages."""
+    if not msgs:
+        return []
+    words, nvalid, nblocks = pad_sha3_messages(msgs)
+    dig = _sha3_blocks(jnp.asarray(words), jnp.asarray(nvalid), nblocks)
+    return digests_to_bytes(np.asarray(dig))

@@ -1,0 +1,136 @@
+"""Batched MPT node hashing on device — the trie's dispatch seam.
+
+The state engine (state/device_state.py) decomposes many key walks /
+a whole batch's dirty-node writes into LEVELS of independent node
+blobs; every level becomes ONE device SHA3-256 dispatch through this
+module. Two fused programs:
+
+ - ``dispatch_node_hash_batch`` / ``collect_node_hash_batch``: hash a
+   level of RLP node blobs; digests come back as one [B, 32] uint8
+   buffer (apply path — the digests become the child refs of the next
+   level up).
+ - ``dispatch_node_verify_batch`` / ``collect_node_verify_batch``:
+   hash AND compare against expected refs in the same program; only a
+   [B] bool verdict crosses back (read/proof path — re-verifying node
+   integrity while serving, so a corrupted store can never serve a
+   value or proof that does not hash to its ref).
+
+Batches clearing the mesh gate (ops/mesh.py) shard the batch axis over
+every chip — each row is an independent Keccak absorb, so the SPMD
+program has zero collectives; smaller batches keep the single-device
+path, and the gate below them is the caller's (Config
+STATE_DEVICE_BATCH_MIN routes tiny batches to hashlib on host).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from plenum_tpu.ops import pow2_at_least as _pow2_at_least
+from plenum_tpu.ops.sha3 import (
+    _sha3_blocks, digests_to_array, digests_to_bytes, pad_sha3_messages)
+
+
+def _get_mesh():
+    from plenum_tpu.ops import mesh as mesh_mod
+    return mesh_mod.get_mesh()
+
+
+def _pad_single(arrays, b: int):
+    """Pad the batch axis to a power of two on the single-device path —
+    level sizes vary per call, and an unbucketed batch dimension would
+    pay a fresh XLA compile of the Keccak kernel per distinct size
+    (the same bound ops/merkle.py enforces). Padding repeats row 0, so
+    the extra rows are valid work whose results the collect slices off."""
+    from plenum_tpu.ops.mesh import pad_rows
+    bp = _pow2_at_least(b)
+    return arrays if bp == b else pad_rows(arrays, bp)
+
+
+@functools.partial(jax.jit, static_argnames=("nblocks",))
+def _sha3_blocks_eq(blocks, nvalid, expected_u8, nblocks: int):
+    """Fused hash + compare: → [B] bool, True where the SHA3-256 of the
+    message equals the expected 32-byte ref. The digest never leaves
+    the device — only the verdict does."""
+    dig = _sha3_blocks(blocks, nvalid, nblocks)  # [B, 8] u32, LE words
+    w = expected_u8.reshape(expected_u8.shape[0], 8, 4).astype(jnp.uint32)
+    exp = (w[..., 0] | w[..., 1] << 8 | w[..., 2] << 16 | w[..., 3] << 24)
+    return jnp.all(dig == exp, axis=-1)
+
+
+def dispatch_node_hash_batch(blobs: Sequence[bytes]):
+    """Start the device SHA3-256 of one level of node blobs; pair with
+    collect_node_hash_batch (the dispatch is async — the caller builds
+    the next level's host work while the device hashes this one)."""
+    b = len(blobs)
+    if b == 0:
+        return (None, 0)
+    words, nvalid, nblocks = pad_sha3_messages(blobs)
+    dm = _get_mesh()
+    if dm.should_shard(b):
+        from plenum_tpu.ops.mesh import pad_rows
+        bp = dm.padded_size(b)
+        w, nv = pad_rows([words, nvalid], bp)
+        dig = dm.dispatch(
+            lambda ww, nn: _sha3_blocks(ww, nn, nblocks), [w, nv],
+            n=b, label="state_sha3")
+    else:
+        dm.note_passthrough(b)
+        words, nvalid = _pad_single([words, nvalid], b)
+        dig = _sha3_blocks(jnp.asarray(words), jnp.asarray(nvalid),
+                           nblocks)
+    return (dig, b)
+
+
+def collect_node_hash_batch(handle) -> np.ndarray:
+    """Await a dispatch_node_hash_batch handle → [B, 32] u8 digests."""
+    dig, b = handle
+    if b == 0:
+        return np.zeros((0, 32), dtype=np.uint8)
+    return digests_to_array(np.asarray(dig)[:b])
+
+
+def dispatch_node_verify_batch(blobs: Sequence[bytes],
+                               expected: Sequence[bytes]):
+    """Start the fused hash+compare of node blobs against their 32-byte
+    refs; pair with collect_node_verify_batch."""
+    b = len(blobs)
+    if b == 0:
+        return (None, 0)
+    words, nvalid, nblocks = pad_sha3_messages(blobs)
+    exp = np.frombuffer(b"".join(expected), dtype=np.uint8).reshape(b, 32)
+    dm = _get_mesh()
+    if dm.should_shard(b):
+        from plenum_tpu.ops.mesh import pad_rows
+        bp = dm.padded_size(b)
+        w, nv, e = pad_rows([words, nvalid, exp], bp)
+        ok = dm.dispatch(
+            lambda ww, nn, ee: _sha3_blocks_eq(ww, nn, ee, nblocks),
+            [w, nv, e], n=b, label="state_sha3_verify")
+    else:
+        dm.note_passthrough(b)
+        words, nvalid, exp = _pad_single([words, nvalid, exp], b)
+        ok = _sha3_blocks_eq(jnp.asarray(words), jnp.asarray(nvalid),
+                             jnp.asarray(exp), nblocks)
+    return (ok, b)
+
+
+def collect_node_verify_batch(handle) -> np.ndarray:
+    """Await a dispatch_node_verify_batch handle → [B] bool verdicts."""
+    ok, b = handle
+    if b == 0:
+        return np.zeros((0,), dtype=bool)
+    return np.asarray(ok)[:b]
+
+
+def hash_nodes(blobs: Sequence[bytes]) -> List[bytes]:
+    """Synchronous convenience: SHA3-256 every blob in one dispatch."""
+    dig, b = dispatch_node_hash_batch(blobs)
+    if b == 0:
+        return []
+    return digests_to_bytes(np.asarray(dig)[:b])

@@ -101,6 +101,16 @@ class NodeBootstrap:
             state = None
             if lid != AUDIT_LEDGER_ID:
                 state = PruningState(make_kv(name + "_state"))
+                if conf.STATE_DEVICE_ENGINE:
+                    # batched multi-key gets, whole-batch applies and
+                    # N-key proof generation route to the device MPT
+                    # engine; below STATE_DEVICE_BATCH_MIN the host
+                    # trie keeps winning and nothing changes. Warm
+                    # once (the SHA3 kernels are process-wide) so the
+                    # first serving batch skips the jit compile.
+                    state.attach_device_engine(
+                        batch_min=conf.STATE_DEVICE_BATCH_MIN,
+                        warm=(lid == DOMAIN_LEDGER_ID))
             dm.register_new_database(lid, ledger, state,
                                      taa_acceptance_required=(
                                          lid == DOMAIN_LEDGER_ID))
@@ -409,6 +419,13 @@ class Node:
             # buffer (process-wide mesh: last tracer attached wins, like
             # the shared hub above)
             _mesh_mod.get_mesh().tracer = self.tracer
+        # state_get / state_apply / state_proof spans from the device
+        # MPT engines land in this node's buffer too
+        for _lid in (POOL_LEDGER_ID, DOMAIN_LEDGER_ID, CONFIG_LEDGER_ID):
+            _state = self.db_manager.get_state(_lid)
+            if _state is not None and \
+                    getattr(_state, "_engine", None) is not None:
+                _state._engine.tracer = self.tracer
         self.primary_connection_monitor = PrimaryConnectionMonitorService(
             self.replica.data, timer, self.replica.internal_bus, network,
             config=self.config)
@@ -795,6 +812,7 @@ class Node:
         from plenum_tpu.common.constants import CURRENT_PROTOCOL_VERSION
         intake = _fp.request_intake if _fp is not None else None
         parsed = []
+        reads = []
         for msg, client_id in msgs:
             try:
                 # C fast path: validation + both digests + signing bytes
@@ -816,12 +834,15 @@ class Node:
                     reqId=msg.get("reqId") or 0, reason=str(e)))
                 continue
             if self.read_manager.is_valid_type(request.txn_type):
-                self._process_read(request, client_id)
+                # defer: the whole intake's reads serve as ONE batch
+                # (shared state-engine walks + per-root BLS lookups)
+                reads.append((request, client_id))
                 continue
             if self.action_manager.is_valid_type(request.txn_type):
                 self._process_action(request, client_id)
                 continue
             parsed.append((request, client_id))
+        self._process_read_batch(reads)
         if not parsed:
             return None
         self.metrics.add_event(MetricsName.CLIENT_AUTH_BATCH_SIZE,
@@ -962,6 +983,42 @@ class Node:
             self._reply_to_client(client_id, Reject(
                 identifier=request.identifier or "unknown",
                 reqId=request.reqId or 0, reason=str(e)))
+
+    def _process_read_batch(self, reads):
+        """Serve one intake's reads as a single batch: GET_NYMs reading
+        the same root share ONE batched state-engine walk for values
+        and proofs (ReadRequestManager.get_results_batch). Per-request
+        failures nack that request only; a manager-level failure falls
+        back to the per-request path, so batching can never answer
+        worse than serving one at a time."""
+        if not reads:
+            return
+        if len(reads) == 1:
+            self._process_read(*reads[0])
+            return
+        with self.tracer.span("read_batch", CAT_INTAKE, n=len(reads)):
+            try:
+                results = self.read_manager.get_results_batch(
+                    [request for request, _ in reads])
+            except Exception:
+                logger.exception("%s batched read serving failed; "
+                                 "serving one at a time", self.name)
+                for request, client_id in reads:
+                    self._process_read(request, client_id)
+                return
+        for (request, client_id), result in zip(reads, results):
+            if isinstance(result, InvalidClientMessageException):
+                self._reply_to_client(client_id, RequestNack(
+                    identifier=request.identifier or "unknown",
+                    reqId=request.reqId or 0, reason=str(result)))
+            elif isinstance(result, Exception):
+                logger.error("%s failed processing read %s: %r",
+                             self.name, request, result)
+                self._reply_to_client(client_id, RequestNack(
+                    identifier=request.identifier or "unknown",
+                    reqId=request.reqId or 0, reason="internal error"))
+            else:
+                self._reply_to_client(client_id, Reply(result=result))
 
     def _process_read(self, request: Request, client_id: str):
         try:

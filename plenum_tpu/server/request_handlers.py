@@ -110,6 +110,44 @@ class ReadRequestHandler(RequestHandler):
                 proof[MULTI_SIGNATURE] = multi_sig.as_dict()
         return proof
 
+    def make_state_proof_batch(self, keys, root, with_values=False):
+        """N-key batched form of make_state_proof: proof nodes for every
+        key come from ONE state-engine call (level-wise device SHA3,
+        shared spine loads — state/device_state.py) and the BLS
+        multi-sig for the shared root resolves once, so a single node
+        can serve proof-bearing reads at scale. Each returned dict is
+        byte-identical to make_state_proof(key, root).
+
+        with_values=True → (values, proof_dicts): the SAME single walk
+        resolves every key's value (a proof walk finds it anyway), so
+        read serving never pays a second batched walk for the data."""
+        from plenum_tpu.common.constants import (
+            MULTI_SIGNATURE, PROOF_NODES, ROOT_HASH)
+        from plenum_tpu.common.serializers.base58 import b58encode
+        root_b58 = b58encode(bytes(root))
+        if with_values:
+            values, serialized = self.state.get_with_proofs_batch(
+                keys, root=root, serialize=True)
+        else:
+            values = None
+            serialized = self.state.generate_state_proof_batch(
+                keys, root=root, serialize=True)
+        multi_sig_dict = None
+        bls_store = getattr(self.database_manager, "bls_store", None)
+        if bls_store is not None:
+            multi_sig = bls_store.get(root_b58)
+            if multi_sig is not None:
+                multi_sig_dict = multi_sig.as_dict()
+        out = []
+        for nodes in serialized:
+            proof = {ROOT_HASH: root_b58, PROOF_NODES: nodes}
+            if multi_sig_dict is not None:
+                # shallow copy: replies are serialized independently and
+                # must not alias one mutable dict
+                proof[MULTI_SIGNATURE] = dict(multi_sig_dict)
+            out.append(proof)
+        return (values, out) if with_values else out
+
 
 class ActionRequestHandler(RequestHandler):
     """Non-ledger actions: validated and executed locally, no consensus
@@ -417,7 +455,10 @@ class GetNymHandler(ReadRequestHandler):
     def __init__(self, database_manager: DatabaseManager):
         super().__init__(database_manager, "105", DOMAIN_LEDGER_ID)
 
-    def get_result(self, request: Request) -> dict:
+    def _resolve_root(self, request: Request):
+        """Validate the operation and resolve the state root it reads:
+        → (nym, state_key, root|None). Shared by the single and the
+        batched serving paths so both answer identically."""
         nym = request.operation.get(TARGET_NYM)
         if not isinstance(nym, str) or not nym:
             raise InvalidClientRequest(request.identifier, request.reqId,
@@ -439,12 +480,11 @@ class GetNymHandler(ReadRequestHandler):
                     if ts_store is not None else None)
         else:
             root = self.state.committedHeadHash
-        if root is None:
-            data, seq_no, txn_time, proof = None, None, None, None
-        else:
-            data, seq_no, txn_time = decode_state_value(
-                self.state.get_for_root_hash(root, key))
-            proof = self.make_state_proof(key, root)
+        return nym, key, root
+
+    @staticmethod
+    def _assemble(request: Request, nym: str, value, proof) -> dict:
+        data, seq_no, txn_time = decode_state_value(value)
         return {
             TXN_TYPE: "105",
             "identifier": request.identifier,
@@ -457,3 +497,43 @@ class GetNymHandler(ReadRequestHandler):
             "txnTime": txn_time,
             "state_proof": proof,
         }
+
+    def get_result(self, request: Request) -> dict:
+        nym, key, root = self._resolve_root(request)
+        if root is None:
+            value, proof = None, None
+        else:
+            value = self.state.get_for_root_hash(root, key)
+            proof = self.make_state_proof(key, root)
+        return self._assemble(request, nym, value, proof)
+
+    def get_results_batch(self, requests) -> list:
+        """Serve MANY GET_NYMs at once: requests reading the same root
+        (the common case — every current-state read shares the
+        committed root) resolve their values and their proofs through
+        ONE batched state-engine walk each (make_state_proof_batch),
+        with the BLS multi-sig looked up once per root. Per-request
+        validation failures come back as exception instances in the
+        result slots, so one bad request never fails the batch."""
+        out: list = [None] * len(requests)
+        by_root: dict = {}
+        for i, request in enumerate(requests):
+            try:
+                nym, key, root = self._resolve_root(request)
+            except InvalidClientRequest as e:
+                out[i] = e
+                continue
+            if root is None:
+                out[i] = self._assemble(request, nym, None, None)
+            else:
+                by_root.setdefault(bytes(root), []).append(
+                    (i, request, nym, key))
+        for root, items in by_root.items():
+            keys = [key for _, _, _, key in items]
+            # ONE walk serves both the values and the proofs
+            values, proofs = self.make_state_proof_batch(
+                keys, root, with_values=True)
+            for (i, request, nym, _), value, proof in zip(items, values,
+                                                          proofs):
+                out[i] = self._assemble(request, nym, value, proof)
+        return out

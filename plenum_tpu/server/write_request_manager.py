@@ -288,3 +288,36 @@ class ReadRequestManager:
                 request.identifier, request.reqId,
                 "unknown read type {}".format(request.txn_type))
         return handler.get_result(request)
+
+    def get_results_batch(self, requests: List[Request]) -> list:
+        """Serve many reads in one pass: requests are grouped per
+        handler, and handlers exposing `get_results_batch` (GET_NYM —
+        one batched state-engine walk for values + proofs) take whole
+        groups at once; the rest answer one by one. Result slots align
+        with `requests`; a slot holds the result dict OR the exception
+        that request raised — per-request failures never fail the
+        batch."""
+        out: list = [None] * len(requests)
+        groups: Dict[str, list] = {}
+        for i, request in enumerate(requests):
+            if request.txn_type not in self.request_handlers:
+                out[i] = InvalidClientRequest(
+                    request.identifier, request.reqId,
+                    "unknown read type {}".format(request.txn_type))
+                continue
+            groups.setdefault(request.txn_type, []).append(i)
+        for txn_type, idxs in groups.items():
+            handler = self.request_handlers[txn_type]
+            batch = getattr(handler, "get_results_batch", None)
+            if batch is not None and len(idxs) > 1:
+                for i, res in zip(idxs, batch([requests[i]
+                                               for i in idxs])):
+                    out[i] = res
+                continue
+            for i in idxs:
+                try:
+                    out[i] = handler.get_result(requests[i])
+                except Exception as e:  # slot-aligned: the caller nacks
+                    # this request and serves the rest of the batch
+                    out[i] = e
+        return out

@@ -4,5 +4,8 @@ state/pruning_state.py:14, Trie state/trie/pruning_trie.py:215).
 """
 from plenum_tpu.state.trie import Trie, verify_proof
 from plenum_tpu.state.pruning_state import PruningState, State
+from plenum_tpu.state.device_state import (
+    CorruptStateError, DeviceStateEngine)
 
-__all__ = ["Trie", "verify_proof", "PruningState", "State"]
+__all__ = ["Trie", "verify_proof", "PruningState", "State",
+           "DeviceStateEngine", "CorruptStateError"]

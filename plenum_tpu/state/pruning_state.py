@@ -5,14 +5,26 @@ Reference: state/state.py:5 (State ABC), state/pruning_state.py:14
 `committedHeadHash` moves only on 3PC commit; revert rewinds head to the
 committed root (the trie keeps all nodes, so rewinding is just a root
 swap — same trick the reference uses).
+
+Device engine seam: `attach_device_engine` routes batched gets, whole
+pending-buffer flushes and multi-key proof generation through the
+device MPT engine (state/device_state.py) — the same attach shape as
+`CompactMerkleTree.attach_device_engine`: calls below the config batch
+threshold keep the host trie path, every engine failure falls back to
+the host path, and a persistently failing engine is detached (circuit
+breaker) so a sick device can never tax the serving path.
 """
 from __future__ import annotations
 
+import logging
 from abc import ABC, abstractmethod
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from plenum_tpu.common.serializers.base58 import b58encode
+from plenum_tpu.state.device_state import CorruptStateError
 from plenum_tpu.state.trie import BLANK_ROOT, Trie, verify_proof
+
+logger = logging.getLogger(__name__)
 
 try:
     from plenum_tpu.state.trie_native import NativeTrie as _TrieBackend
@@ -53,9 +65,21 @@ class State(ABC):
     def committedHeadHash(self) -> bytes: ...
 
 
+from plenum_tpu.common.config import Config as _Config
+
+
 class PruningState(State):
     # key under which the committed root hash survives restarts
     rootHashKey = b"\x88\x88committedRoot"
+
+    # device MPT engine routing (state/device_state.py): batched calls
+    # at/above this many keys go through the engine; below it the host
+    # trie wins on latency. Single-sourced from Config like the
+    # MERKLE_DEVICE_* knobs.
+    _engine_batch_min = _Config.STATE_DEVICE_BATCH_MIN
+    # consecutive engine failures before it is detached (every failure
+    # already falls back to the host trie path)
+    _ENGINE_MAX_FAILURES = 3
 
     def __init__(self, kv):
         """kv: KeyValueStorage for trie nodes (+ the committed-root key)."""
@@ -75,6 +99,56 @@ class PruningState(State):
         # bumps on every write; validation memos key on it (cheaper than
         # forcing a flush to compare head roots)
         self.mutation_count = 0
+        self._engine = None
+        self._engine_breaker = None
+
+    # ----------------------------------------------------- device engine
+
+    def attach_device_engine(self, engine=None, batch_min: int = None,
+                             warm: bool = False):
+        """Route batched gets / whole-batch flushes / multi-key proof
+        generation through a device MPT engine
+        (state/device_state.DeviceStateEngine). Calls below `batch_min`
+        keys keep the host trie path — it wins below the routing
+        threshold. warm=True compiles the SHA3 kernels now, keeping the
+        one-time jit cost off the first serving call."""
+        if engine is None:
+            from plenum_tpu.state.device_state import DeviceStateEngine
+            engine = DeviceStateEngine(self._kv)
+        self._engine = engine
+        from plenum_tpu.utils.device_breaker import DeviceCircuitBreaker
+        # KeyError (genuinely missing node — the host path fails the
+        # same way) and CorruptStateError (a node that does not hash
+        # to its ref — an integrity failure the host path would
+        # silently serve) are NOT device faults: they propagate
+        self._engine_breaker = DeviceCircuitBreaker(
+            "state device engine", "the host trie",
+            max_failures=self._ENGINE_MAX_FAILURES,
+            reraise=(KeyError, CorruptStateError))
+        if batch_min is not None:
+            self._engine_batch_min = batch_min
+        if warm:
+            try:
+                engine.warm()
+            except Exception:  # plenum-lint: disable=PT006 — warm-up is
+                # best-effort: a broken backend must not fail bootstrap;
+                # the first real batch retries and the breaker detaches
+                logger.warning("state engine warm-up failed; it will "
+                               "retry lazily", exc_info=True)
+        return engine
+
+    def _engine_call(self, fn, label: str):
+        """Run one engine operation under the shared circuit breaker
+        (utils/device_breaker.py): None on failure — the caller serves
+        from the host trie — and a persistently failing engine is
+        detached for good."""
+        if self._engine is None:
+            return None
+        engine = self._engine
+        ok, out = self._engine_breaker.run(lambda: fn(engine), label)
+        if not ok and self._engine_breaker.tripped:
+            self._engine = None
+        return out if ok else None
 
     # ------------------------------------------------------------ writes
 
@@ -90,6 +164,18 @@ class PruningState(State):
         if not self._pending:
             return
         pending, self._pending = self._pending, {}
+        if self._engine is not None \
+                and len(pending) >= self._engine_batch_min:
+            # whole-batch device apply: every dirty node hashed
+            # level-wise in one SHA3 dispatch per level; the root is
+            # byte-equal to the host path's (content-canonical trie)
+            root = self._engine_call(
+                lambda eng: eng.apply_batch(self._trie.root_hash,
+                                            list(pending.items())),
+                "apply_batch")
+            if root is not None:
+                self._trie.root_hash = root
+                return
         set_many = getattr(self._trie, "set_many", None)
         if set_many is not None:
             set_many(list(pending.items()))
@@ -111,6 +197,42 @@ class PruningState(State):
     def get_for_root_hash(self, root_hash: bytes, key: bytes
                           ) -> Optional[bytes]:
         return self._trie.get_at_root(root_hash, key)
+
+    # ------------------------------------------------------ batched reads
+
+    def get_batch(self, keys: Sequence[bytes], isCommitted: bool = True
+                  ) -> List[Optional[bytes]]:
+        """Values for many keys in one call: the device engine walks
+        every key level-lockstep with one hash-verify dispatch per
+        level; uncommitted reads still see the pending write buffer."""
+        if isCommitted:
+            return self.get_batch_for_root_hash(self._committed_root,
+                                                keys)
+        out: List[Optional[bytes]] = [None] * len(keys)
+        missing_idx, missing_keys = [], []
+        for i, key in enumerate(keys):
+            k = bytes(key)
+            if k in self._pending:
+                out[i] = self._pending[k] or None
+            else:
+                missing_idx.append(i)
+                missing_keys.append(k)
+        if missing_keys:
+            vals = self.get_batch_for_root_hash(self._trie.root_hash,
+                                                missing_keys)
+            for i, v in zip(missing_idx, vals):
+                out[i] = v
+        return out
+
+    def get_batch_for_root_hash(self, root_hash: bytes,
+                                keys: Sequence[bytes]
+                                ) -> List[Optional[bytes]]:
+        if len(keys) >= self._engine_batch_min:
+            vals = self._engine_call(
+                lambda eng: eng.get_batch(root_hash, keys), "get_batch")
+            if vals is not None:
+                return vals
+        return [self._trie.get_at_root(root_hash, k) for k in keys]
 
     # ------------------------------------------------------- commit/revert
 
@@ -163,10 +285,55 @@ class PruningState(State):
         nodes = self._trie.produce_spv_proof(
             key, root if root is not None else self.committedHeadHash)
         if serialize:
-            import base64
-            from plenum_tpu.state import rlp as _rlp
-            return base64.b64encode(_rlp.encode(list(nodes))).decode("ascii")
+            return self.serialize_proof(nodes)
         return nodes
+
+    def generate_state_proof_batch(self, keys: Sequence[bytes],
+                                   root: Optional[bytes] = None,
+                                   serialize: bool = False) -> List:
+        """Proof nodes for MANY keys under one root in one engine call
+        (shared spine nodes load and hash-verify once per level, not
+        once per key); each entry is byte-identical to
+        generate_state_proof for the same key."""
+        root = root if root is not None else self.committedHeadHash
+        proofs = None
+        if len(keys) >= self._engine_batch_min:
+            proofs = self._engine_call(
+                lambda eng: eng.proof_batch(root, keys), "proof_batch")
+        if proofs is None:
+            proofs = [self._trie.produce_spv_proof(k, root) for k in keys]
+        if serialize:
+            return [self.serialize_proof(nodes) for nodes in proofs]
+        return proofs
+
+    def get_with_proofs_batch(self, keys: Sequence[bytes],
+                              root: Optional[bytes] = None,
+                              serialize: bool = False):
+        """→ (values, proofs) for many keys under one root from ONE
+        engine walk (the proof walk resolves values anyway) — the
+        read-serving shape, where every reply carries both. Entries
+        are byte-identical to get_for_root_hash + generate_state_proof
+        per key."""
+        root = root if root is not None else self.committedHeadHash
+        out = None
+        if len(keys) >= self._engine_batch_min:
+            out = self._engine_call(
+                lambda eng: eng.get_with_proof_batch(root, keys),
+                "get_with_proof_batch")
+        if out is None:
+            out = ([self._trie.get_at_root(root, k) for k in keys],
+                   [self._trie.produce_spv_proof(k, root) for k in keys])
+        values, proofs = out
+        if serialize:
+            proofs = [self.serialize_proof(nodes) for nodes in proofs]
+        return values, proofs
+
+    @staticmethod
+    def serialize_proof(nodes: Sequence[bytes]) -> str:
+        """Wire form clients receive: one base64-encoded RLP list."""
+        import base64
+        from plenum_tpu.state import rlp as _rlp
+        return base64.b64encode(_rlp.encode(list(nodes))).decode("ascii")
 
     @staticmethod
     def deserialize_proof(proof: str) -> List[bytes]:

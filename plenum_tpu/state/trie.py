@@ -60,10 +60,13 @@ def hp_decode(data: bytes) -> Tuple[List[int], bool]:
     return nibbles[skip:], terminal
 
 
+from plenum_tpu.common.config import Config as _Config
+
+
 class Trie:
-    # ~1-1.5KB per decoded branch node → tens of MB per trie at the cap;
-    # large enough to hold a full batch's spine working set
-    _DECODE_CACHE_MAX = 1 << 16
+    # single-sourced from Config (PT005): ONE place to tune the
+    # decoded-node cache alongside the other STATE_* knobs
+    _DECODE_CACHE_MAX = _Config.STATE_DECODE_CACHE_MAX
 
     def __init__(self, store, root_hash: Optional[bytes] = None):
         """store: KeyValueStorage-like (get/put raising KeyError on miss)."""

@@ -1,0 +1,66 @@
+"""Shared circuit-breaker policy for attach-behind device engines.
+
+Two subsystems attach an optional device engine behind a host
+implementation (`CompactMerkleTree.attach_device_engine`,
+`PruningState.attach_device_engine`) with the same fallback contract:
+every engine failure serves THAT call from the host path; the first
+failure logs one full traceback, later ones log at debug (a sick
+device must not log-spam the serving path); after `max_failures`
+CONSECUTIVE failures the breaker trips and the caller detaches the
+engine for good. Success resets the count. This module is the ONE
+place that policy lives — the seams configure the wording and the
+exception types that must propagate, nothing else.
+"""
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceCircuitBreaker:
+    def __init__(self, what: str, fallback: str, max_failures: int = 3,
+                 reraise: tuple = ()):
+        """what/fallback: log wording ("device proof engine" / "the
+        host memo path"). reraise: exception types that are DOMAIN
+        errors, not device faults (the host path would raise them too,
+        or they must surface) — they propagate untouched and do not
+        count against the device."""
+        self.what = what
+        self.fallback = fallback
+        self.max_failures = max_failures
+        self.reraise = tuple(reraise)
+        self.fail_count = 0
+
+    @property
+    def tripped(self) -> bool:
+        """True once the caller should detach the engine."""
+        return self.fail_count >= self.max_failures
+
+    def run(self, fn, label: str = ""):
+        """Run one engine operation under the policy → (ok, result).
+        ok False means serve this call from the host fallback — and
+        detach the engine if `tripped` flipped."""
+        try:
+            out = fn()
+        except self.reraise:
+            raise
+        except Exception:  # plenum-lint: disable=PT006 — this IS the
+            # designed host-fallback boundary: ANY engine/device
+            # failure must degrade to the host path, never crash
+            self.fail_count += 1
+            what = "{} {}".format(self.what, label).strip()
+            if self.tripped:
+                logger.warning(
+                    "%s failed %d times; detaching the engine (%s "
+                    "serves from now on)", what, self.fail_count,
+                    self.fallback)
+            elif self.fail_count == 1:
+                logger.warning("%s failed; serving from %s", what,
+                               self.fallback, exc_info=True)
+            else:
+                logger.debug("%s failed again (%d)", what,
+                             self.fail_count, exc_info=True)
+            return False, None
+        self.fail_count = 0
+        return True, out

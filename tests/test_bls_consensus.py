@@ -255,6 +255,112 @@ def test_single_node_read_with_multisig_proof(bls_keys, mock_timer):
     assert not plain.is_confirmed(read4)
 
 
+def test_client_verify_proof_dict_against_live_pool(bls_keys, mock_timer):
+    """ISSUE 6 satellite: PoolClient.verify_proof_dict checks a
+    {root_hash, proof_nodes, multi_signature} dict straight from
+    make_state_proof — trie proof check + BLS multi-sig check in ONE
+    call — against a live sim pool, including batched serving: many
+    GET_NYMs answered through the node's batched read path must each
+    carry a proof the helper accepts."""
+    from plenum_tpu.client.client import PoolClient
+    from plenum_tpu.client.wallet import Wallet
+    from plenum_tpu.common.constants import (
+        NYM, PROOF_NODES, ROOT_HASH, TARGET_NYM, VERKEY)
+    from plenum_tpu.common.messages.node_messages import Reply
+    from plenum_tpu.common.state_codec import (
+        encode_state_value, nym_to_state_key)
+    from plenum_tpu.crypto.signer import SimpleSigner
+
+    names = list(bls_keys)
+    nodes, sinks, timer = _bls_pool(mock_timer, names, bls_keys)
+    # 8 authors + 1 absence read → the 9-key proof batch clears the
+    # engine threshold (STATE_DEVICE_BATCH_MIN=8), so the live pool
+    # serves these proofs through the DEVICE engine path
+    authors = [SimpleSigner(seed=bytes([0x60 + i]) * 32)
+               for i in range(8)]
+    for i, author in enumerate(authors):
+        req = {"identifier": author.identifier, "reqId": i + 1,
+               "protocolVersion": 2,
+               "operation": {"type": NYM, TARGET_NYM: author.identifier,
+                             VERKEY: author.verkey}}
+        req["signature"] = author.sign(dict(req))
+        for n in nodes.values():
+            n.process_client_request(dict(req), "w%d" % i)
+    _pump_nodes(timer, nodes, 10.0)
+    assert all(n.db_manager.get_ledger(1).size == len(authors)
+               for n in nodes.values())
+
+    # serve every author's GET_NYM from ONE node through the BATCHED
+    # intake path (dispatch_client_batch routes reads as one batch)
+    first = names[0]
+    reads = []
+    for i, author in enumerate(authors):
+        reads.append(({"identifier": author.identifier,
+                       "reqId": 100 + i,
+                       "operation": {"type": "105",
+                                     TARGET_NYM: author.identifier}},
+                      "r%d" % i))
+    # absence read rides the same batch
+    ghost = SimpleSigner(seed=b"\x7f" * 32)
+    reads.append(({"identifier": authors[0].identifier, "reqId": 200,
+                   "operation": {"type": "105",
+                                 TARGET_NYM: ghost.identifier}},
+                  "rg"))
+    before = len(sinks[first])
+    nodes[first].process_client_batch(reads)
+    replies = [m for _, m in sinks[first][before:]
+               if isinstance(m, Reply)]
+    assert len(replies) == len(reads)
+
+    verifier = BlsCryptoVerifierPlenum()
+    wallet = Wallet()
+    wallet.add_identifier(signer=SimpleSigner(seed=b"\x61" * 32))
+    client = PoolClient(
+        wallet, names, send_fn=lambda n, m: None,
+        bls_verifier=verifier,
+        bls_key_provider=lambda n: bls_keys[n].pk)
+    import copy
+    for reply in replies:
+        result = reply.result
+        sp = result["state_proof"]
+        key = nym_to_state_key(result["dest"])
+        if result["data"] is None:
+            value = None
+        else:
+            value = encode_state_value(result["data"], result["seqNo"],
+                                       result["txnTime"])
+        # one-call end-to-end check: trie proof + BLS multi-sig
+        assert client.verify_proof_dict(sp, key, value)
+        ts = sp["multi_signature"]["value"]["timestamp"]
+        assert client.verify_proof_dict(sp, key, value, max_age=300,
+                                        now=ts + 5)
+        assert not client.verify_proof_dict(sp, key, value, max_age=300,
+                                            now=ts + 10000)
+        # forgeries fail closed
+        assert not client.verify_proof_dict(sp, key, b"forged-value")
+        if value is not None:
+            assert not client.verify_proof_dict(sp, key, None)
+        wrong_root = copy.deepcopy(sp)
+        from plenum_tpu.common.serializers.base58 import b58encode
+        wrong_root[ROOT_HASH] = b58encode(b"\x55" * 32)
+        assert not client.verify_proof_dict(wrong_root, key, value)
+        bad_sig = copy.deepcopy(sp)
+        ms = bad_sig["multi_signature"]
+        ms["signature"] = ms["signature"][:-4] + "1111"
+        assert not client.verify_proof_dict(bad_sig, key, value)
+        no_ms = {ROOT_HASH: sp[ROOT_HASH], PROOF_NODES: sp[PROOF_NODES]}
+        assert not client.verify_proof_dict(no_ms, key, value)
+        assert not client.verify_proof_dict(sp, key, value, ledger_id=0)
+    # batched replies must be byte-identical to the single-read path
+    single_sink = []
+    nodes[first]._reply_to_client = \
+        lambda cid, msg: single_sink.append(msg)
+    for msg, cid in reads:
+        nodes[first].process_client_request(dict(msg), cid)
+    singles = [m.result for m in single_sink if isinstance(m, Reply)]
+    assert [r.result for r in replies] == singles
+
+
 def test_deferred_share_verify_drops_bad_share_at_order(bls_keys,
                                                         mock_timer):
     """Optimistic batch verification (BLS_DEFER_SHARE_VERIFY=True, the

@@ -1,0 +1,385 @@
+"""Device MPT state engine (ISSUE 6): batched trie reads, level-wise
+SHA3 apply, batched SPV proofs.
+
+Acceptance: batched device get/apply/proof results are byte-identical
+to the pure-Python Trie across ragged batch sizes (roots, values AND
+proof_nodes), every proof passes the existing verify_proof, and
+detaching the engine (circuit breaker) leaves all state behavior on
+the host path intact.
+"""
+import hashlib
+import random
+
+import pytest
+
+from plenum_tpu.state.device_state import (
+    CorruptStateError, DeviceStateEngine)
+from plenum_tpu.state.pruning_state import PruningState
+from plenum_tpu.state.trie import BLANK_ROOT, Trie, verify_proof
+from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
+
+
+# ------------------------------------------------------------ SHA3 kernel
+
+def test_sha3_kernel_matches_hashlib():
+    from plenum_tpu.ops.sha3 import sha3_256_many
+    rng = random.Random(3)
+    msgs = [b"", b"a", b"x" * 135, b"y" * 136, b"z" * 137, b"w" * 272]
+    msgs += [bytes(rng.randrange(256) for _ in range(rng.randrange(700)))
+             for _ in range(30)]
+    for m, got in zip(msgs, sha3_256_many(msgs)):
+        assert got == hashlib.sha3_256(m).digest(), len(m)
+    # uniform-length fast path (level batches of same-shape nodes)
+    uni = [bytes(rng.randrange(256) for _ in range(65)) for _ in range(50)]
+    for m, got in zip(uni, sha3_256_many(uni)):
+        assert got == hashlib.sha3_256(m).digest()
+
+
+def test_trie_jax_verify_batch_detects_mismatch():
+    from plenum_tpu.ops import trie_jax
+    blobs = [b"node-%d" % i for i in range(9)]
+    digs = [hashlib.sha3_256(b).digest() for b in blobs]
+    ok = trie_jax.collect_node_verify_batch(
+        trie_jax.dispatch_node_verify_batch(blobs, digs))
+    assert ok.all()
+    digs[4] = b"\x00" * 32
+    ok = trie_jax.collect_node_verify_batch(
+        trie_jax.dispatch_node_verify_batch(blobs, digs))
+    assert not ok[4] and ok.sum() == 8
+
+
+# --------------------------------------------------- randomized equivalence
+
+def _host_apply(trie, pairs):
+    for k, v in pairs:
+        if v:
+            trie.set(k, v)
+        else:
+            trie.delete(k)
+    return trie.root_hash
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 33, 100, 257])
+def test_apply_get_proof_equivalence_ragged(n):
+    """Ragged batch sizes: engine roots/values/proofs byte-equal the
+    pure-Python trie, and every proof passes verify_proof."""
+    kv_host, kv_dev = KeyValueStorageInMemory(), KeyValueStorageInMemory()
+    host = Trie(kv_host)
+    eng = DeviceStateEngine(kv_dev, hash_floor=4)  # force device hashing
+    pairs = [(b"k-%d-%d" % (n, i), b"v-%d" % i) for i in range(n)]
+    root = eng.apply_batch(BLANK_ROOT, pairs)
+    assert root == _host_apply(host, pairs)
+    keys = [k for k, _ in pairs] + [b"absent-%d" % n]
+    assert eng.get_batch(root, keys) == [host.get(k) for k in keys]
+    proofs = eng.proof_batch(root, keys)
+    for k, p in zip(keys, proofs):
+        assert p == host.produce_spv_proof(k, root), k
+        assert verify_proof(root, k, host.get(k), p)
+
+
+def test_randomized_interleaved_batches_and_deletes():
+    """Multiple batches with overwrites and deletes on top of earlier
+    roots: every intermediate root, value and proof byte-equal."""
+    rng = random.Random(4242)
+    kv_host, kv_dev = KeyValueStorageInMemory(), KeyValueStorageInMemory()
+    host = Trie(kv_host)
+    eng = DeviceStateEngine(kv_dev, hash_floor=4)
+    root = BLANK_ROOT
+    keyspace = [bytes(rng.randrange(256)
+                      for _ in range(rng.randrange(1, 12)))
+                for _ in range(150)]
+    model = {}
+    for batch_no in range(6):
+        batch = {}
+        for _ in range(rng.randrange(1, 90)):
+            k = rng.choice(keyspace)
+            if rng.random() < 0.25 and k in model:
+                batch[k] = b""
+            else:
+                batch[k] = b"v%d-%d" % (batch_no, rng.randrange(1000))
+        pairs = list(batch.items())
+        root = eng.apply_batch(root, pairs)
+        assert root == _host_apply(host, pairs), batch_no
+        for k, v in batch.items():
+            if v:
+                model[k] = v
+            else:
+                model.pop(k, None)
+        sample = rng.sample(keyspace, 40)
+        assert eng.get_batch(root, sample) == \
+            [model.get(k) for k in sample], batch_no
+        for k, p in zip(sample, eng.proof_batch(root, sample)):
+            assert p == host.produce_spv_proof(k, root), (batch_no, k)
+            assert verify_proof(root, k, model.get(k), p)
+
+
+def test_old_roots_stay_readable_through_engine():
+    kv = KeyValueStorageInMemory()
+    eng = DeviceStateEngine(kv, hash_floor=4)
+    r1 = eng.apply_batch(BLANK_ROOT, [(b"a", b"1"), (b"b", b"2")])
+    r2 = eng.apply_batch(r1, [(b"a", b"3"), (b"c", b"4")])
+    assert eng.get_batch(r1, [b"a", b"b", b"c"]) == [b"1", b"2", None]
+    assert eng.get_batch(r2, [b"a", b"b", b"c"]) == [b"3", b"2", b"4"]
+
+
+def test_engine_detects_corrupt_store():
+    """A stored node whose bytes do not hash to its ref must be caught
+    by the fused device verify (the host trie would serve it)."""
+    kv = KeyValueStorageInMemory()
+    eng = DeviceStateEngine(kv, hash_floor=1)
+    pairs = [(b"key-%d" % i, b"value-%d" % i) for i in range(64)]
+    root = eng.apply_batch(BLANK_ROOT, pairs)
+    # corrupt one interior/leaf blob in place
+    victim = next(h for h in kv._dict if h != root
+                  and h != PruningState.rootHashKey and len(h) == 32)
+    blob = bytearray(kv.get(victim))
+    blob[-1] ^= 1
+    kv.put(victim, bytes(blob))
+    with pytest.raises(CorruptStateError):
+        eng.get_batch(root, [k for k, _ in pairs])
+    with pytest.raises(CorruptStateError):
+        eng.proof_batch(root, [k for k, _ in pairs])
+
+
+def test_engine_raises_keyerror_for_missing_node():
+    kv = KeyValueStorageInMemory()
+    eng = DeviceStateEngine(kv, hash_floor=1)
+    root = eng.apply_batch(BLANK_ROOT,
+                           [(b"k%d" % i, b"v%d" % i) for i in range(40)])
+    victim = next(h for h in list(kv._dict)
+                  if h != root and len(h) == 32)
+    kv.remove(victim)
+    with pytest.raises(KeyError):
+        eng.get_batch(root, [b"k%d" % i for i in range(40)])
+
+
+# ------------------------------------------------ PruningState attach seam
+
+def _mirrored_states(batch_min=4, floor=4):
+    ref = PruningState(KeyValueStorageInMemory())
+    st = PruningState(KeyValueStorageInMemory())
+    eng = st.attach_device_engine(batch_min=batch_min)
+    eng.hash_floor = floor
+    return ref, st, eng
+
+
+def test_pruning_state_engine_flush_and_commit():
+    ref, st, eng = _mirrored_states()
+    for s in (ref, st):
+        for i in range(60):
+            s.set(b"did:%d" % i, b'{"v":%d}' % i)
+    assert st.headHash == ref.headHash
+    assert eng.dispatches > 0, "flush must have routed to the engine"
+    st.commit()
+    ref.commit()
+    assert st.committedHeadHash == ref.committedHeadHash
+    keys = [b"did:%d" % i for i in range(60)] + [b"did:none"]
+    assert st.get_batch(keys) == [ref.get(k) for k in keys]
+    assert st.generate_state_proof_batch(keys) == \
+        [ref.generate_state_proof(k) for k in keys]
+    assert st.generate_state_proof_batch(keys, serialize=True) == \
+        [ref.generate_state_proof(k, serialize=True) for k in keys]
+    # the fused read-serving shape: ONE walk → (values, proofs)
+    vals, proofs = st.get_with_proofs_batch(keys)
+    assert vals == [ref.get(k) for k in keys]
+    assert proofs == [ref.generate_state_proof(k) for k in keys]
+
+
+def test_pruning_state_small_batches_keep_host_path():
+    ref, st, eng = _mirrored_states(batch_min=100)
+    for s in (ref, st):
+        for i in range(20):
+            s.set(b"x%d" % i, b"y%d" % i)
+    assert st.headHash == ref.headHash
+    assert eng.dispatches == 0, "below batch_min nothing touches devices"
+    assert st.get_batch([b"x1", b"x2"]) == [None, None]  # uncommitted
+    assert st.get_batch([b"x1", b"x2"], isCommitted=False) == [b"y1", b"y2"]
+
+
+def test_pruning_state_uncommitted_batch_reads_see_pending():
+    _, st, _ = _mirrored_states()
+    for i in range(30):
+        st.set(b"p%d" % i, b"q%d" % i)
+    h = st.headHash  # flush
+    st.set(b"p0", b"OVERRIDE")
+    st.set(b"extra", b"E")
+    st.remove(b"p1")
+    got = st.get_batch([b"p0", b"p1", b"p2", b"extra"], isCommitted=False)
+    assert got == [b"OVERRIDE", None, b"q2", b"E"]
+    # committed view unchanged
+    assert st.get_batch([b"p0", b"extra"]) == [None, None]
+    st.revertToHead(h)
+    assert st.get_batch([b"p0", b"p1"], isCommitted=False) == [b"q0", b"q1"]
+
+
+def test_circuit_breaker_detaches_and_host_serves():
+    class Boom:
+        tracer = None
+
+        def apply_batch(self, *a):
+            raise RuntimeError("boom")
+
+        def get_batch(self, *a):
+            raise RuntimeError("boom")
+
+        def proof_batch(self, *a):
+            raise RuntimeError("boom")
+
+    ref = PruningState(KeyValueStorageInMemory())
+    st = PruningState(KeyValueStorageInMemory())
+    st.attach_device_engine(engine=Boom(), batch_min=1)
+    for s in (ref, st):
+        for i in range(25):
+            s.set(b"cb%d" % i, b"v%d" % i)
+    assert st.headHash == ref.headHash  # host fallback root
+    keys = [b"cb%d" % i for i in range(25)]
+    st.get_batch(keys, isCommitted=False)
+    st.generate_state_proof_batch(keys, root=st.headHash)
+    assert st._engine is None, "3 consecutive failures must detach"
+    # detached state keeps serving identically to a plain host state
+    st.commit()
+    ref.commit()
+    assert st.get_batch(keys) == [ref.get(k) for k in keys]
+    assert st.generate_state_proof_batch(keys) == \
+        [ref.generate_state_proof(k) for k in keys]
+
+
+def test_engine_failure_preserves_pending_writes():
+    """One transient engine failure must not lose the batch: the host
+    path absorbs the same pending writes."""
+    calls = []
+
+    class FlakyEngine(DeviceStateEngine):
+        def apply_batch(self, root_hash, pairs):
+            calls.append(len(pairs))
+            raise RuntimeError("transient")
+
+    st = PruningState(KeyValueStorageInMemory())
+    st.attach_device_engine(
+        engine=FlakyEngine(st._kv), batch_min=1)
+    ref = PruningState(KeyValueStorageInMemory())
+    for s in (ref, st):
+        for i in range(10):
+            s.set(b"f%d" % i, b"g%d" % i)
+    assert st.headHash == ref.headHash
+    assert calls == [10]
+
+
+def test_warm_compiles_without_error():
+    st = PruningState(KeyValueStorageInMemory())
+    eng = st.attach_device_engine(batch_min=4, warm=True)
+    assert eng is st._engine
+
+
+def test_state_spans_reach_tracer():
+    from plenum_tpu.observability.tracing import Tracer
+    tracer = Tracer(name="t", capacity=64)
+    st = PruningState(KeyValueStorageInMemory())
+    eng = st.attach_device_engine(batch_min=2)
+    eng.tracer = tracer
+    eng.hash_floor = 2
+    for i in range(20):
+        st.set(b"s%d" % i, b"t%d" % i)
+    st.commit()
+    st.get_batch([b"s1", b"s2", b"s3"])
+    st.generate_state_proof_batch([b"s1", b"s2", b"s3"])
+    names = {r[1] for r in tracer.spans()}
+    assert {"state_apply", "state_get", "state_proof"} <= names
+
+
+@pytest.fixture
+def mesh():
+    """Save/restore the process-wide mesh configuration around a test."""
+    from plenum_tpu.ops import mesh as mesh_mod
+    m = mesh_mod.get_mesh()
+    prior = (m.enabled, m.max_devices, m.shard_min)
+    yield mesh_mod
+    mesh_mod.configure(enabled=prior[0], max_devices=prior[1],
+                       shard_min=prior[2])
+
+
+def test_sharded_hash_and_verify_bit_identical(mesh):
+    """Level hashes sharded over the virtual 8-device mesh are
+    bit-identical to hashlib, verdicts included."""
+    from plenum_tpu.ops import trie_jax
+    mesh.configure(enabled=True, shard_min=16, max_devices=0)
+    rng = random.Random(11)
+    blobs = [bytes(rng.randrange(256) for _ in range(rng.randrange(1, 200)))
+             for _ in range(67)]  # ragged, above shard_min
+    got = trie_jax.collect_node_hash_batch(
+        trie_jax.dispatch_node_hash_batch(blobs))
+    digs = [hashlib.sha3_256(b).digest() for b in blobs]
+    assert [bytes(r) for r in got] == digs
+    ok = trie_jax.collect_node_verify_batch(
+        trie_jax.dispatch_node_verify_batch(blobs, digs))
+    assert ok.all()
+    digs[13] = digs[14]
+    ok = trie_jax.collect_node_verify_batch(
+        trie_jax.dispatch_node_verify_batch(blobs, digs))
+    assert not ok[13] and ok.sum() == len(blobs) - 1
+
+
+# --------------------------------------------------- batched read serving
+
+def test_get_nym_batch_matches_single_results():
+    """GetNymHandler.get_results_batch (one engine walk + one BLS
+    lookup per root) answers byte-identically to get_result, and a bad
+    request in the batch nacks only itself."""
+    from plenum_tpu.common.constants import DOMAIN_LEDGER_ID, NYM
+    from plenum_tpu.common.exceptions import InvalidClientRequest
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.ledger.ledger import Ledger
+    from plenum_tpu.server.database_manager import DatabaseManager
+    from plenum_tpu.server.request_handlers import (
+        GetNymHandler, NymHandler, encode_state_value, nym_to_state_key)
+
+    dm = DatabaseManager()
+    state = PruningState(KeyValueStorageInMemory())
+    state.attach_device_engine(batch_min=2)
+    dm.register_new_database(DOMAIN_LEDGER_ID,
+                             Ledger(txn_store=KeyValueStorageInMemory()),
+                             state)
+    for i in range(12):
+        state.set(nym_to_state_key("did:%d" % i),
+                  encode_state_value({"verkey": "vk%d" % i}, i + 1, 1000))
+    state.commit()
+    handler = GetNymHandler(dm)
+
+    def read(i, dest):
+        return Request(identifier="reader", reqId=i,
+                       operation={"type": "105", "dest": dest})
+
+    reqs = [read(i, "did:%d" % i) for i in range(12)]
+    reqs.append(read(99, "did:absent"))
+    singles = [handler.get_result(r) for r in reqs]
+    batch = handler.get_results_batch(reqs)
+    assert batch == singles
+    # a dest-less request fails alone, the rest still answer
+    bad = Request(identifier="reader", reqId=500,
+                  operation={"type": "105"})
+    mixed = handler.get_results_batch([reqs[0], bad, reqs[1]])
+    assert mixed[0] == singles[0]
+    assert isinstance(mixed[1], InvalidClientRequest)
+    assert mixed[2] == singles[1]
+
+
+def test_read_manager_batch_groups_and_aligns():
+    from plenum_tpu.common.exceptions import InvalidClientRequest
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.server.write_request_manager import ReadRequestManager
+
+    class EchoHandler:
+        txn_type = "echo"
+
+        def get_result(self, request):
+            return {"reqId": request.reqId}
+
+    rm = ReadRequestManager()
+    rm.register_req_handler(EchoHandler())
+    reqs = [Request(identifier="i", reqId=1, operation={"type": "echo"}),
+            Request(identifier="i", reqId=2, operation={"type": "nope"}),
+            Request(identifier="i", reqId=3, operation={"type": "echo"})]
+    out = rm.get_results_batch(reqs)
+    assert out[0] == {"reqId": 1}
+    assert isinstance(out[1], InvalidClientRequest)
+    assert out[2] == {"reqId": 3}

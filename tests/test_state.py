@@ -147,6 +147,104 @@ def test_spv_proof_tamper_detected(trie):
     assert not verify_proof(root, b"t1", b"v1", tampered)
 
 
+# ----------------------------------- adversarial verifier coverage
+# (the client-facing verify_proof / verify_state_proof must fail closed
+# on every forgery shape a single malicious node could attempt)
+
+@pytest.fixture
+def proven_trie(trie):
+    for i in range(64):
+        trie.set(b"adv-key-%02d" % i, b"adv-val-%02d" % i)
+    return trie
+
+
+def test_verify_proof_rejects_every_tampered_node(proven_trie):
+    """Flipping ANY byte of ANY hash-referenced proof node breaks the
+    hash chain. (Nodes under 32 encoded bytes are inline — their
+    standalone proof copies are redundant by construction, the verifier
+    reads them out of the parent's encoding — so only the >= 32-byte
+    nodes are load-bearing.)"""
+    root = proven_trie.root_hash
+    proof = proven_trie.produce_spv_proof(b"adv-key-17")
+    assert verify_proof(root, b"adv-key-17", b"adv-val-17", proof)
+    assert sum(len(p) >= 32 for p in proof) >= 3
+    for i in range(len(proof)):
+        if len(proof[i]) < 32:
+            continue
+        for pos in (0, len(proof[i]) // 2, len(proof[i]) - 1):
+            bad = list(proof)
+            bad[i] = bad[i][:pos] + bytes([bad[i][pos] ^ 0x40]) \
+                + bad[i][pos + 1:]
+            assert not verify_proof(root, b"adv-key-17", b"adv-val-17",
+                                    bad), (i, pos)
+
+
+def test_verify_proof_rejects_wrong_root(proven_trie):
+    proof = proven_trie.produce_spv_proof(b"adv-key-03")
+    for bad_root in (b"\x00" * 32, b"\xff" * 32,
+                     bytes(reversed(proven_trie.root_hash))):
+        assert not verify_proof(bad_root, b"adv-key-03", b"adv-val-03",
+                                proof)
+    # a GENUINE old root does not validate the new tree's proof either
+    old = Trie(KeyValueStorageInMemory())
+    old.set(b"adv-key-03", b"adv-val-03")
+    assert not verify_proof(old.root_hash, b"adv-key-03", b"adv-val-03",
+                            proof)
+
+
+def test_verify_proof_rejects_value_substitution(proven_trie):
+    root = proven_trie.root_hash
+    proof = proven_trie.produce_spv_proof(b"adv-key-29")
+    assert not verify_proof(root, b"adv-key-29", b"adv-val-30", proof)
+    assert not verify_proof(root, b"adv-key-29", b"", proof)
+    # a membership proof must not double as an absence proof
+    assert not verify_proof(root, b"adv-key-29", None, proof)
+    # nor prove a DIFFERENT key (proof of key A, claim about key B)
+    assert not verify_proof(root, b"adv-key-30", b"adv-val-29", proof)
+
+
+def test_verify_proof_absence_for_missing_keys(proven_trie):
+    """Absence proofs: provable for genuinely missing keys, not
+    forgeable for present ones, and tamper-evident themselves."""
+    root = proven_trie.root_hash
+    for absent in (b"adv-key-99", b"zzz", b"", b"adv-key-1"):
+        proof = proven_trie.produce_spv_proof(absent)
+        assert verify_proof(root, absent, None, proof), absent
+        # the absence proof cannot claim a value instead
+        assert not verify_proof(root, absent, b"forged", proof), absent
+    proof = proven_trie.produce_spv_proof(b"adv-key-99")
+    tampered = [p[:-1] + bytes([p[-1] ^ 1]) for p in proof]
+    assert not verify_proof(root, b"adv-key-99", None, tampered)
+    # empty proof list only proves absence under the BLANK root
+    assert verify_proof(BLANK_ROOT, b"anything", None, [])
+    assert not verify_proof(root, b"adv-key-99", None, [])
+
+
+def test_verify_state_proof_negative_paths():
+    st = PruningState(KeyValueStorageInMemory())
+    st.set(b"did:neg", b'{"verkey":"k"}')
+    st.commit()
+    root = st.committedHeadHash
+    proof = st.generate_state_proof(b"did:neg")
+    assert PruningState.verify_state_proof(root, b"did:neg",
+                                           b'{"verkey":"k"}', proof)
+    # wrong root / substituted value / tampered node / fake absence
+    assert not PruningState.verify_state_proof(
+        b"\x11" * 32, b"did:neg", b'{"verkey":"k"}', proof)
+    assert not PruningState.verify_state_proof(
+        root, b"did:neg", b'{"verkey":"ATTACKER"}', proof)
+    assert not PruningState.verify_state_proof(
+        root, b"did:neg", None, proof)
+    bad = [p[:-1] + bytes([p[-1] ^ 2]) for p in proof]
+    assert not PruningState.verify_state_proof(
+        root, b"did:neg", b'{"verkey":"k"}', bad)
+    # serialized round trip preserves verifiability
+    wire = st.generate_state_proof(b"did:neg", serialize=True)
+    nodes = PruningState.deserialize_proof(wire)
+    assert PruningState.verify_state_proof(root, b"did:neg",
+                                           b'{"verkey":"k"}', nodes)
+
+
 # ------------------------------------------------------------ PruningState
 
 def test_state_committed_vs_head():
@@ -195,6 +293,79 @@ def test_state_persists_committed_root(tdir):
     assert st2.committedHeadHash == root
     assert st2.get(b"persist") == b"me"
     st2.close()
+
+
+def test_revert_with_multiple_uncommitted_batches():
+    """The 3PC revert path on view change: several applied-but-
+    uncommitted batches are in flight; revertToHead rewinds to the
+    committed prefix and every intermediate root stays readable."""
+    st = PruningState(KeyValueStorageInMemory())
+    st.set(b"base", b"0")
+    st.commit()
+    committed = st.committedHeadHash
+    roots = [committed]
+    for batch in range(1, 4):  # three uncommitted batches stacked
+        st.set(b"k%d" % batch, b"v%d" % batch)
+        st.set(b"base", b"b%d" % batch)
+        roots.append(st.headHash)
+    assert len(set(roots)) == 4
+    assert st.committedHeadHash == committed
+    # every in-flight batch's root is readable via get_for_root_hash
+    # (BLS state-root checks and freshness probes read exactly this way)
+    for batch in range(1, 4):
+        assert st.get_for_root_hash(roots[batch], b"base") == \
+            b"b%d" % batch
+        assert st.get_for_root_hash(roots[batch], b"k%d" % batch) == \
+            b"v%d" % batch
+        assert st.get_for_root_hash(roots[batch],
+                                    b"k%d" % (batch + 1)) is None
+    # view change: revert the whole uncommitted suffix
+    st.revertToHead(committed)
+    assert st.headHash == committed
+    assert st.get(b"base", isCommitted=False) == b"0"
+    for batch in range(1, 4):
+        assert st.get(b"k%d" % batch, isCommitted=False) is None
+    # the trie keeps history: the abandoned roots are STILL readable
+    # (catchup / audit against in-flight roots after the revert)
+    assert st.get_for_root_hash(roots[2], b"k2") == b"v2"
+
+
+def test_revert_to_intermediate_uncommitted_root():
+    """Revert to a MIDDLE in-flight batch (the partial-rewind shape:
+    batches above the last prepared certificate are discarded, the
+    prefix below it is kept)."""
+    st = PruningState(KeyValueStorageInMemory())
+    st.set(b"a", b"1")
+    st.commit()
+    st.set(b"a", b"2")
+    r1 = st.headHash
+    st.set(b"a", b"3")
+    st.set(b"b", b"x")
+    assert st.headHash != r1
+    st.revertToHead(r1)
+    assert st.headHash == r1
+    assert st.get(b"a", isCommitted=False) == b"2"
+    assert st.get(b"b", isCommitted=False) is None
+    # committing the kept prefix lands exactly r1
+    st.commit()
+    assert st.committedHeadHash == r1
+    assert st.get(b"a", isCommitted=True) == b"2"
+
+
+def test_revert_discards_pending_buffer_and_commit_follows():
+    """Writes still buffered (never flushed into a root) belong to the
+    abandoned head and must vanish on revert; a later commit must not
+    resurrect them."""
+    st = PruningState(KeyValueStorageInMemory())
+    st.set(b"keep", b"1")
+    st.commit()
+    committed = st.committedHeadHash
+    st.set(b"ghost", b"boo")  # buffered only — no headHash read yet
+    st.revertToHead(committed)
+    st.commit()
+    assert st.committedHeadHash == committed
+    assert st.get(b"ghost", isCommitted=True) is None
+    assert st.get(b"ghost", isCommitted=False) is None
 
 
 def test_state_proof_roundtrip():
