@@ -968,6 +968,182 @@ def pool25_both():
     return tpu
 
 
+def bench_recovery():
+    """Recovery SLO config (ROADMAP item 4): a 25-node sim pool
+    measures (a) failover latency — primary goes silent under load →
+    every honest node completes the view change AND orders again — and
+    (b) catchup-completion latency for a lagging node syncing under a
+    lying seeder while another peer churns (leaves + rejoins) mid-
+    catchup. Latencies are SIM seconds on the MockTimer: deterministic
+    and host-load independent, which is what makes them gateable.
+    Both are checked against the Config SLOs; the pool runs with the
+    flight recorder ON, so a violation auto-dumps a merged timeline
+    whose filename embeds the measured latency and the threshold, and
+    the leecher backoff + view-change escalation events are counted
+    into the report from the same buffers."""
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+    from plenum_tpu.crypto.signer import SimpleSigner
+    from plenum_tpu.runtime.sim_random import DefaultSimRandom
+    from plenum_tpu.server.node import Node
+    from plenum_tpu.testing.mock_timer import MockTimer
+    from plenum_tpu.testing.sim_network import SimNetwork
+    from plenum_tpu.testing.adversary import (
+        AdversaryController, LivenessViolation, LyingCatchupSeeder,
+        Scenario, SilentNode, SLOViolation)
+
+    n_nodes = int(os.environ.get("BENCH_REC_NODES", "25"))
+    failover_slo = float(os.environ.get(
+        "BENCH_REC_FAILOVER_SLO", str(Config.RECOVERY_FAILOVER_SLO_S)))
+    catchup_slo = float(os.environ.get(
+        "BENCH_REC_CATCHUP_SLO", str(Config.RECOVERY_CATCHUP_SLO_S)))
+
+    timer = MockTimer()
+    timer.set_time(SIM_EPOCH)
+    net = SimNetwork(timer, DefaultSimRandom(77), min_latency=0.001,
+                     max_latency=0.01)
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15, ToleratePrimaryDisconnection=4,
+                  NEW_VIEW_TIMEOUT=8, STATE_FRESHNESS_UPDATE_INTERVAL=3,
+                  CATCHUP_TXN_TIMEOUT=2, TRACING_ENABLED=True,
+                  HEARTBEAT_FREQ=10 ** 6, VERIFIER_PROVIDER="cpu",
+                  MESH_ENABLED=False)
+    names = ["B%02d" % i for i in range(n_nodes)]
+    nodes = [Node(n, names, timer, net.create_peer(n), config=conf)
+             for n in names]
+
+    def submit(to_nodes, i, req_id):
+        signer = SimpleSigner(seed=bytes([0x41 + i % 60]) * 32)
+        req = {"identifier": signer.identifier, "reqId": req_id,
+               "protocolVersion": 2,
+               "operation": {"type": NYM,
+                             TARGET_NYM: signer.identifier,
+                             VERKEY: signer.verkey}}
+        req["signature"] = signer.sign(dict(req))
+        for nd in to_nodes:
+            nd.process_client_request(dict(req), "bench-recovery")
+
+    adv = AdversaryController(timer, seed=7)
+    adv.set_pool(nodes)
+    out = {"nodes": n_nodes, "unit": "sim-seconds",
+           "failover_slo_s": failover_slo, "catchup_slo_s": catchup_slo}
+    violations = []
+
+    def gated_measure(scn, name, cond, within, slo):
+        """Measure + SLO-gate one recovery; a mild SLO miss AND a
+        catastrophic liveness failure both land in `violations` (with
+        a dumped timeline) instead of killing the bench run — the
+        report must come out strictly MORE complete the worse things
+        get, never less. → latency or None."""
+        try:
+            val = scn.measure(cond, within=within, desc=name)
+        except LivenessViolation as e:
+            path = scn.dump_trace(tag="liveness_%s" % name)
+            violations.append("%s%s" % (
+                e, " [flight recorder: %s]" % path if path else ""))
+            return None
+        try:
+            scn.check_slo(name, val, slo)
+        except SLOViolation as e:
+            violations.append(str(e))
+        return val
+
+    # ---- failover: the primary goes silent under load
+    primary = next(nd for nd in nodes if nd.replica.data.is_primary)
+    sc = Scenario(timer, nodes, adversary=adv,
+                  honest=[nd.name for nd in nodes if nd is not primary])
+    submit(nodes, 0, 1)
+    sc.run(3)
+    behavior = SilentNode()
+    adv.corrupt(primary, behavior)
+    honest = sc.honest
+    submit(honest, 1, 2)
+    base = {nd.name: nd.last_ordered[1] for nd in honest}
+
+    def ordering_resumed():
+        return all(nd.view_no >= 1
+                   and not nd.replica.data.waiting_for_new_view
+                   and nd.last_ordered[1] > base[nd.name]
+                   for nd in honest)
+
+    failover_s = gated_measure(sc, "failover", ordering_resumed,
+                               4 * failover_slo + 60, failover_slo)
+    out["failover_s"] = round(failover_s, 2) \
+        if failover_s is not None else None
+    # crashed primary restarts: release + catchup back into the pool
+    adv.release(primary, behavior)
+    primary.start_catchup()
+    try:
+        sc.run_until(lambda: not primary.leecher.in_progress, 120,
+                     "ex-primary rejoins via catchup")
+    except LivenessViolation as e:
+        violations.append(str(e))
+
+    # ---- catchup under lying seeders + membership churn: one seeder
+    # GARBLES chunks (convicted by audit-path verification, then
+    # excluded), one STALLS silently (only retry backoff + rotation
+    # can route around it), and a third peer churns out/in while the
+    # laggard syncs
+    laggard = nodes[-1]
+    net.disconnect(laggard.name)
+    live = [nd for nd in nodes if nd is not laggard]
+    sc_live = Scenario(timer, live, adversary=adv,
+                       honest=[nd.name for nd in live])
+    for i in range(4):
+        submit(live, 2 + i, 3 + i)
+        sc_live.run(3)
+    non_primaries = [nd for nd in live
+                     if not nd.replica.data.is_primary]
+    liar, staller, churner = non_primaries[:3]
+    adv.corrupt(liar, LyingCatchupSeeder())
+    adv.corrupt(staller, LyingCatchupSeeder(
+        lie_cons_proofs=False, garble_reps=False, stall_every=1))
+    net.reconnect(laggard.name)
+    laggard.start_catchup()
+    # churn racing the catchup: a peer drops and later rejoins
+    adv.at(0.2, lambda: net.disconnect(churner.name), "churner leaves")
+    adv.at(3.0, lambda: net.reconnect(churner.name), "churner rejoins")
+    target = live[0]
+    sc2 = Scenario(timer, nodes, adversary=adv,
+                   honest=[nd.name for nd in nodes
+                           if nd not in (liar, staller, churner)])
+
+    def caught_up():
+        return (not laggard.leecher.in_progress
+                and laggard.domain_ledger.size
+                == target.domain_ledger.size)
+
+    catchup_s = gated_measure(sc2, "catchup", caught_up,
+                              4 * catchup_slo + 60, catchup_slo)
+    out["catchup_s"] = round(catchup_s, 2) \
+        if catchup_s is not None else None
+    out["catchup_bad_peers"] = sorted(laggard.leecher.bad_peers)
+
+    # recovery observables straight from the flight-recorder buffers:
+    # the backoff/escalation machinery must be VISIBLE, not assumed —
+    # one pass per node (spans() copies the whole ring under a lock)
+    from collections import Counter
+    counts = Counter()
+    for nd in nodes:
+        counts.update(rec[1] for rec in nd.tracer.spans())
+    out["trace_events"] = {name: counts[name] for name in (
+        "catchup_start", "catchup_done", "catchup_retry",
+        "catchup_bad_peer", "view_change_start", "view_change_done",
+        "vc_timeout_escalated")}
+    # the counts above come from per-node ring buffers shared with the
+    # (much chattier) 3PC/device lanes: if any ring wrapped, early
+    # recovery instants were evicted and the counts undercount — flag
+    # it rather than report a silently-degraded number
+    wrapped = [nd.name for nd in nodes
+               if nd.tracer.stats().get("dropped", 0) > 0]
+    if wrapped:
+        out["trace_events"]["ring_wrapped_nodes"] = len(wrapped)
+    out["slo_ok"] = not violations
+    if violations:
+        out["violations"] = violations
+    return out
+
+
 def micro_mesh():
     """Device-mesh dispatch layer (ops/mesh.py): the single-device
     overhead gate, plus a per-device-count weak-scaling sweep through
@@ -1235,6 +1411,7 @@ def main():
     cpu_rate = cpu_ordered / cpu_elapsed
 
     tracing = tracing_overhead()
+    recovery = bench_recovery()
 
     (device_rate, device_rate_median, ed_single_shot, ed_single_shot_med,
      openssl_rate, python_rate, ed_sweep) = micro_ed25519()
@@ -1287,6 +1464,7 @@ def main():
             "state": state_res,
             "pool25_backlog": p25,
             "tracing_overhead": tracing,
+            "recovery": recovery,
         },
     }))
     # compact one-line summary LAST: the driver records only a bounded
@@ -1314,6 +1492,11 @@ def main():
             "mesh_devices": mesh_res["devices"],
             "mesh_overhead_pct": mesh_res.get(
                 "single_device_overhead_pct"),
+            "recovery_failover_s": recovery.get("failover_s"),
+            "recovery_failover_slo_s": recovery.get("failover_slo_s"),
+            "recovery_catchup_s": recovery.get("catchup_s"),
+            "recovery_catchup_slo_s": recovery.get("catchup_slo_s"),
+            "recovery_slo_ok": recovery.get("slo_ok"),
         }
     }, separators=(",", ":")))
 

@@ -19,6 +19,8 @@ from plenum_tpu.analysis.rules.pt005_config_drift import (
     ConfigLiteralDriftRule)
 from plenum_tpu.analysis.rules.pt006_broad_except import (
     BroadExceptOnDevicePathRule)
+from plenum_tpu.analysis.rules.pt007_fixed_retry_timer import (
+    FixedRetryTimerRule)
 
 RULE_CLASSES = (
     BlockingCallRule,
@@ -27,6 +29,7 @@ RULE_CLASSES = (
     CrossThreadSharedStateRule,
     ConfigLiteralDriftRule,
     BroadExceptOnDevicePathRule,
+    FixedRetryTimerRule,
 )
 
 

@@ -36,6 +36,12 @@ class Config:
     # ---- view change (reference plenum/config.py:197-201, 295)
     ToleratePrimaryDisconnection = 60
     NEW_VIEW_TIMEOUT = 30
+    # PBFT-style timeout escalation: each consecutive FAILED view change
+    # (NEW_VIEW timeout or mismatch) doubles the next NEW_VIEW wait, up
+    # to the cap; any completed view change resets to NEW_VIEW_TIMEOUT.
+    # Without this a pool whose view changes keep colliding (partition
+    # just healing, slow links) thrashes at the base period forever.
+    NEW_VIEW_TIMEOUT_MAX = 480
     VIEW_CHANGE_RESEND_TIMEOUT = 10
     INSTANCE_CHANGE_RESEND_TIMEOUT = 300
     OUTDATED_INSTANCE_CHANGES_CHECK_INTERVAL = 300
@@ -88,6 +94,14 @@ class Config:
     CATCHUP_TXN_TIMEOUT = 6
     CatchupTransactionsTimeout = 6
     MAX_CATCHUP_RETRY = 3
+    # leecher retry policy (server/catchup.py): capped exponential
+    # backoff from CATCHUP_TXN_TIMEOUT — retry i waits
+    # min(base * 2^i, MAX) plus up to JITTER_FRAC of that (deterministic
+    # per (ledger, retry) so sim runs replay). Progress (an adopted
+    # target or a buffered rep) resets the backoff. A fixed period
+    # hammers dead peers and synchronizes the whole pool's re-requests.
+    CATCHUP_RETRY_BACKOFF_MAX = 60
+    CATCHUP_RETRY_JITTER_FRAC = 0.25
 
     # ---- transport (reference stp_core/config.py)
     MSG_LEN_LIMIT = 128 * 1024
@@ -195,6 +209,22 @@ class Config:
     MESH_ENABLED = True
     MESH_MAX_DEVICES = 0         # 0 = all devices (rounded down to 2^k)
     MESH_SHARD_MIN = 2048        # below this one chip wins on latency
+
+    # ---- device circuit breaker (utils/device_breaker.py, shared by
+    # the merkle + MPT engine seams): after max_failures consecutive
+    # engine failures the breaker opens for this many seconds — every
+    # call serves the host fallback with zero device I/O — then allows
+    # ONE probe call through; success re-attaches, failure re-trips
+    # quietly for another cooldown
+    BREAKER_COOLDOWN_S = 30
+
+    # ---- recovery SLOs (sim-time seconds; bench.py `recovery` config
+    # and the soak scenarios gate on these): primary crash → ordering
+    # resumes on every honest node; lagging node under adversarial
+    # seeding completes catchup. Violations auto-dump a flight-recorder
+    # timeline with the measured latency in the filename.
+    RECOVERY_FAILOVER_SLO_S = 40.0
+    RECOVERY_CATCHUP_SLO_S = 60.0
 
     # ---- metrics
     METRICS_COLLECTOR_TYPE = None

@@ -249,6 +249,11 @@ class OrderingService:
             self._queue_entry_time[digest] = self._timer.get_current_time()
         # a stashed PRE-PREPARE may have been waiting for this request
         self._stasher.process_all_stashed(STASH_WAITING_REQUESTS)
+        # ...and so may a paused new-view re-apply (the re-order path
+        # checks request availability like process_preprepare does, but
+        # is driven directly, not through the stasher)
+        if self._new_view_bids_to_reorder:
+            self._reapply_ready_batches()
 
     def send_3pc_batch(self) -> int:
         """Primary: create and send batches if triggers fire. Called every
@@ -723,6 +728,11 @@ class OrderingService:
     def process_view_change_started(self, msg: ViewChangeStarted):
         """Revert uncommitted work; keep old-view PrePrepares for
         re-ordering (reference ordering_service view_change hooks)."""
+        # obsolete the previous NEW_VIEW's re-order set FIRST: the
+        # add_finalized_request calls below must not resume a stale
+        # re-apply onto the state we are about to revert (the coming
+        # NEW_VIEW defines a fresh set)
+        self._new_view_bids_to_reorder = []
         if self.is_master:
             self._executor.revert_unordered_batches()
         self._last_applied_seq = self._data.last_ordered_3pc[1]
@@ -747,7 +757,6 @@ class OrderingService:
         self.prepares.clear()
         self.commits.clear()
         self.batches.clear()
-        self._new_view_bids_to_reorder = []
 
     def process_new_view_checkpoints_applied(
             self, msg: NewViewCheckpointsApplied):
@@ -824,6 +833,17 @@ class OrderingService:
         pp = PrePrepare(**params)
         key = (pp.viewNo, pp.ppSeqNo)
         already_ordered = pp.ppSeqNo <= self._data.last_ordered_3pc[1]
+        if self.is_master and not already_ordered and not all(
+                self._executor.is_request_known(d) for d in pp.reqIdr):
+            # same contract as process_preprepare's
+            # STASH_WAITING_REQUESTS: our PROPAGATE quorum for one of
+            # the batch's requests hasn't completed yet (a node that
+            # slept through the original proposal can hold the PP but
+            # not the request). Pause the sequential re-apply — NOT a
+            # bad-PP discard — and add_finalized_request resumes it
+            # when the request lands. Applying would KeyError and kill
+            # the prod loop mid-view-change.
+            return False
         if self.is_master and not already_ordered:
             if pp.stateRootHash is None or pp.txnRootHash is None:
                 self._discard_bad_old_view_pp(bid, "missing root hashes")

@@ -31,6 +31,7 @@ from plenum_tpu.common.messages.node_messages import (
 from plenum_tpu.common.serializers.serialization import serialize_msg_for_signing
 from plenum_tpu.consensus.batch_id import BatchID, batch_id_from
 from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
+from plenum_tpu.observability.tracing import CAT_RECOVERY, NullTracer
 from plenum_tpu.consensus.primary_selector import (
     RoundRobinConstantNodesPrimariesSelector)
 from plenum_tpu.runtime.stashing_router import DISCARD, StashingRouter
@@ -174,6 +175,17 @@ class ViewChangeService:
         self._network = network
         self._config = config or Config()
         self.metrics = NullMetricsCollector()  # node injects the real one
+        self.tracer = NullTracer()             # node injects the real one
+        # consecutive FAILED view changes (NEW_VIEW timeout or computed
+        # mismatch) since the last completed one: each failure doubles
+        # the next NEW_VIEW wait up to NEW_VIEW_TIMEOUT_MAX (PBFT-style
+        # escalation — colliding view changes need a widening window to
+        # ever overlap); any success resets to the base timeout
+        self.consecutive_failed_view_changes = 0
+        # a mismatch counts ONCE per view: every straggler VIEW_CHANGE/
+        # ack re-enters _finish_view_change and re-detects the same
+        # mismatch — re-counting each would escalate straight to the cap
+        self._mismatch_counted_view: Optional[int] = None
         self._selector = primaries_selector or \
             RoundRobinConstantNodesPrimariesSelector(data.validators)
         self._builder = NewViewBuilder(data)
@@ -221,6 +233,9 @@ class ViewChangeService:
         logger.info("%s starting view change %d → %d (new primary %s)",
                     self._data.name, old_view, proposed_view_no,
                     self._data.primary_name)
+        self.tracer.instant("view_change_start", CAT_RECOVERY,
+                            key=str(proposed_view_no),
+                            timeout=self.new_view_timeout())
         # tell ordering to revert uncommitted + archive old-view PPs
         self._bus.send(ViewChangeStarted(view_no=proposed_view_no))
         vc = self._build_view_change_msg()
@@ -255,6 +270,32 @@ class ViewChangeService:
             checkpoints=checkpoints,
         )
 
+    def new_view_timeout(self) -> float:
+        """The CURRENT NEW_VIEW wait: base doubled per consecutive
+        failed view change, capped at NEW_VIEW_TIMEOUT_MAX."""
+        base = self._config.NEW_VIEW_TIMEOUT
+        cap = getattr(self._config, "NEW_VIEW_TIMEOUT_MAX",
+                      Config.NEW_VIEW_TIMEOUT_MAX)
+        return min(float(cap), float(base) * (
+            2 ** min(self.consecutive_failed_view_changes, 16)))
+
+    def _view_change_failed(self, reason: str, view_no: int):
+        """Count a failed view change and escalate the running timer so
+        the NEXT wait (this timer's re-fire and any view change started
+        meanwhile) uses the doubled window."""
+        self.consecutive_failed_view_changes += 1
+        timeout = self.new_view_timeout()
+        if self._new_view_timer is not None:
+            self._new_view_timer.update_interval(timeout)
+        self.tracer.instant("vc_timeout_escalated", CAT_RECOVERY,
+                            key=str(view_no), reason=reason,
+                            failed=self.consecutive_failed_view_changes,
+                            next_timeout=timeout)
+        logger.warning("%s view change %d failed (%s); consecutive "
+                       "failures %d, NEW_VIEW timeout now %.1fs",
+                       self._data.name, view_no, reason,
+                       self.consecutive_failed_view_changes, timeout)
+
     def _schedule_new_view_timeout(self):
         self._cancel_timers()
         view_at_schedule = self._data.view_no
@@ -264,12 +305,14 @@ class ViewChangeService:
                     and self._data.view_no == view_at_schedule:
                 logger.warning("%s NEW_VIEW timeout in view %d",
                                self._data.name, view_at_schedule)
+                self._view_change_failed("NEW_VIEW_TIMEOUT",
+                                         view_at_schedule)
                 self._bus.send(VoteForViewChange(
                     suspicion="NEW_VIEW_TIMEOUT",
                     view_no=view_at_schedule + 1))
 
         self._new_view_timer = RepeatingTimer(
-            self._timer, self._config.NEW_VIEW_TIMEOUT, on_timeout)
+            self._timer, self.new_view_timeout(), on_timeout)
 
     def _cancel_timers(self):
         if self._new_view_timer is not None:
@@ -395,17 +438,25 @@ class ViewChangeService:
                 [list(batch_id_from(b)) for b in nv.batches]:
             logger.warning("%s NEW_VIEW mismatch — voting next view",
                            self._data.name)
+            if self._mismatch_counted_view != view_no:
+                self._mismatch_counted_view = view_no
+                self._view_change_failed("NEW_VIEW_MISMATCH", view_no)
             self._bus.send(VoteForViewChange(
                 suspicion="NEW_VIEW_MISMATCH", view_no=view_no + 1))
             return
         self._data.waiting_for_new_view = False
         self._cancel_timers()
+        # a COMPLETED view change de-escalates: the next one starts
+        # from the base NEW_VIEW_TIMEOUT again
+        self.consecutive_failed_view_changes = 0
         started = getattr(self, "_vc_started_at", None)
         if started is not None:
             self.metrics.add_event(
                 MetricsName.VIEW_CHANGE_TIME,
                 time.perf_counter() - started)
             self._vc_started_at = None
+        self.tracer.instant("view_change_done", CAT_RECOVERY,
+                            key=str(view_no))
         self._bus.send(NewViewAccepted(
             view_no=view_no,
             view_changes=list(nv.viewChanges),
@@ -429,3 +480,47 @@ class ViewChangeService:
                         checkpoint["seqNoEnd"],
                         self._data.last_ordered_3pc[1])
             self._bus.send(NeedMasterCatchup())
+
+    def rearm_new_view_timeout(self):
+        """Re-arm the NEW_VIEW timeout for the CURRENT data.view_no.
+        Needed when catchup re-targets a pending view change (pool
+        evidence raised data.view_no past the view the running timer
+        was scheduled for): the timer's view guard would otherwise
+        never fire again — no escalation, no further votes — and the
+        node would wedge silently with reads still pinned."""
+        if self._data.waiting_for_new_view:
+            self._schedule_new_view_timeout()
+
+    def absorb_view_from_catchup(self, ordered_view_no: int):
+        """Catchup proved view >= `ordered_view_no` completed pool-wide
+        (the audit ledger holds a batch ORDERED in that view — ordering
+        only resumes after a NEW_VIEW lands). A node still waiting for
+        that NEW_VIEW missed it, typically while disconnected, and no
+        retransmission path exists: MessageReq only heals 3PC gaps and
+        is disabled mid view change. Without this it wedges — stashing
+        all new-view 3PC traffic, re-voting for view changes nobody
+        else wants, and serving reads from its pinned root forever.
+        Complete the view change from the catchup evidence instead:
+        same bookkeeping as _finish_view_change, with nothing to
+        re-order (catchup already delivered the committed batches)."""
+        # evidence must be a batch ordered AT OR PAST the pending view,
+        # and view >= 1: batches at view v (v >= 1) can only exist once
+        # view v's NEW_VIEW completed, whereas view-0 batches predate
+        # any view change and prove nothing about one
+        if not self._data.waiting_for_new_view \
+                or ordered_view_no < max(1, self._data.view_no):
+            return
+        view_no = self._data.view_no
+        self._data.waiting_for_new_view = False
+        self._new_view = None
+        self._cancel_timers()
+        self.consecutive_failed_view_changes = 0
+        self._vc_started_at = None
+        self.tracer.instant("view_change_done", CAT_RECOVERY,
+                            key=str(view_no), absorbed="catchup")
+        logger.info("%s view change to %d absorbed from catchup "
+                    "evidence (pool ordered in view %d)",
+                    self._data.name, view_no, ordered_view_no)
+        self._bus.send(NewViewAccepted(
+            view_no=view_no, view_changes=[], checkpoint=None,
+            batches=[]))

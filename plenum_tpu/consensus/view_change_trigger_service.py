@@ -152,11 +152,40 @@ class ViewChangeTriggerService:
         self._try_start(proposed_view_no)
 
     def process_instance_change(self, msg: InstanceChange, frm: str):
+        if msg.viewNo == self._data.view_no \
+                and self._data.waiting_for_new_view \
+                and frm != self._data.name:
+            # the one-ahead straggler deadlock: we already ADOPTED this
+            # view change (our vote was consumed when it started) but
+            # it cannot complete until the sender's side assembles the
+            # same quorum — with a mute node, their count stalls at
+            # n-f-1 forever while we uselessly vote for view+1.
+            # Re-affirming our own vote for the PENDING view lets them
+            # reach n-f and join us. Bounded: only in response to a
+            # peer's vote, throttled to one resend per window.
+            self._reaffirm_pending_vote(msg.viewNo)
+            return None
         if msg.viewNo <= self._data.view_no:
             return (DISCARD, "instance change for current/old view")
         self._cache.add_vote(msg.viewNo, frm)
         self._try_start(msg.viewNo)
         return None
+
+    def _reaffirm_pending_vote(self, view_no: int):
+        now = self._timer.get_current_time()
+        # throttle is per VIEW: a later view change deadlocking shortly
+        # after the previous one re-affirmed must not wait out a stale
+        # cross-view window
+        last_view, last_at = getattr(self, "_last_reaffirm", (None, 0.0))
+        if last_view == view_no and \
+                now - last_at < self._config.VIEW_CHANGE_RESEND_TIMEOUT:
+            return
+        self._last_reaffirm = (view_no, now)
+        logger.info("%s re-affirming instance-change vote for pending "
+                    "view %d (peers still gathering the quorum)",
+                    self._data.name, view_no)
+        self._network.send(InstanceChange(viewNo=view_no,
+                                          reason=GENERIC_SUSPICION_CODE))
 
     def _try_start(self, view_no: int):
         if view_no <= self._data.view_no:

@@ -39,7 +39,7 @@ class CompactMerkleTree:
     _device_proof_chunk = _Config.MERKLE_DEVICE_PROOF_CHUNK
     _device_pipeline_depth = _Config.MERKLE_DEVICE_PIPELINE_DEPTH
     _device_engine = None
-    # consecutive device failures before the engine is detached (every
+    # consecutive device failures before the breaker opens (every
     # failure already falls back to the host memo path; policy lives in
     # utils/device_breaker.py, shared with the state engine seam)
     _DEVICE_MAX_FAILURES = 3
@@ -137,10 +137,13 @@ class CompactMerkleTree:
             if self._size == 0:
                 self._bulk_build(leaf_hashes)
                 if self._device_engine is not None \
+                        and not self._device_breaker.open \
                         and self._device_engine.tree_size == 0:
                     # keep the engine warm through the big growth event
                     # (recovery/catchup) — one fused dispatch now, so a
-                    # later proof batch only syncs the scalar delta
+                    # later proof batch only syncs the scalar delta.
+                    # An open breaker skips this: no device I/O while
+                    # cooling down.
                     try:
                         self._device_engine.build_from_leaf_hashes(
                             leaf_hashes)
@@ -214,14 +217,30 @@ class CompactMerkleTree:
         fr = {height: value for _, height, value in self._frontier}
         new_levels = {0: leaf_hashes}
         eng = self._device_engine
+        nodes = None
         if eng is not None and eng.tree_size == old_n:
             # device-resident incremental append: ~2b device hashes,
             # one small dispatch per level; new complete nodes come
             # back as arrays and are persisted at the identical
-            # (start, height) keys
-            nodes = eng.append_leaf_hashes(
-                np.frombuffer(b"".join(leaf_hashes), dtype=np.uint8)
-                .reshape(-1, 32), return_nodes=True)
+            # (start, height) keys. Breaker-guarded: a failure serves
+            # this extend from the host level-wise path, and the engine
+            # is reset so a half-applied append can never survive into
+            # a later proof sync.
+            def _attempt():
+                return eng.append_leaf_hashes(
+                    np.frombuffer(b"".join(leaf_hashes), dtype=np.uint8)
+                    .reshape(-1, 32), return_nodes=True)
+
+            ok, nodes = self._device_breaker.run(_attempt, "bulk extend")
+            if not ok:
+                nodes = None
+                try:
+                    if eng.tree_size != old_n:  # half-applied append
+                        eng.reset()
+                except Exception:
+                    logger.debug("device engine reset after failed bulk "
+                                 "extend also failed", exc_info=True)
+        if nodes is not None:
             for height, pos, rows in nodes:
                 if height == 0:
                     continue  # leaves were written above
@@ -330,10 +349,10 @@ class CompactMerkleTree:
 
         # shared circuit breaker (utils/device_breaker.py): every
         # failure serves this batch from the host memo path; a
-        # persistently sick device is detached
+        # persistently sick device opens the breaker (cooldown, then a
+        # single recovery probe) — the engine stays attached so a
+        # healed device resumes serving without a re-attach
         ok, out = self._device_breaker.run(attempt, "proof batch")
-        if not ok and self._device_breaker.tripped:
-            self._device_engine = None
         return out if ok else None
 
     def __copy__(self):
@@ -496,7 +515,12 @@ class CompactMerkleTree:
         self._frontier = []
         self._root_cache = None  # size alone can't invalidate a shrink
         if self._device_engine is not None:
-            self._device_engine.reset()
+            try:
+                self._device_engine.reset()
+            except Exception:  # plenum-lint: disable=PT006 — a sick
+                # device must not block a host-tree reset; the breaker
+                # path resyncs (or keeps falling back) on next use
+                logger.debug("device engine reset failed", exc_info=True)
         self.hash_store.reset()
 
     def __repr__(self):

@@ -49,6 +49,7 @@ CAT_EXECUTE = "execute"      # batch apply + durable commit
 CAT_DEVICE = "device"        # accelerator dispatch/collect seams
 CAT_BLS = "bls"              # BLS share aggregation
 CAT_REPLY = "reply"          # reply construction + audit paths
+CAT_RECOVERY = "recovery"    # view change / catchup / breaker lifecycle
 
 Record = Tuple[str, str, str, float, Optional[float], Optional[str],
                Optional[dict]]

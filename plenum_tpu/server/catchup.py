@@ -18,6 +18,7 @@ the root binds every byte, so a lying seeder can delay but never corrupt
 from __future__ import annotations
 
 import logging
+import zlib
 from collections import defaultdict
 from enum import Enum, auto
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -32,7 +33,8 @@ from plenum_tpu.consensus.quorums import Quorums
 from plenum_tpu.ledger.ledger import Ledger
 from plenum_tpu.ledger.merkle_verifier import MerkleVerifier
 from plenum_tpu.ledger.tree_hasher import TreeHasher
-from plenum_tpu.runtime.timer import RepeatingTimer, TimerService
+from plenum_tpu.observability.tracing import CAT_RECOVERY, NullTracer
+from plenum_tpu.runtime.timer import TimerService
 
 logger = logging.getLogger(__name__)
 
@@ -194,11 +196,27 @@ class LedgerLeecher:
                  on_txn: Callable[[int, dict], None],
                  on_done: Callable[[int], None],
                  config: Optional[Config] = None,
-                 view_tracker: Optional[Dict[str, int]] = None):
+                 view_tracker: Optional[Dict[str, int]] = None,
+                 bad_peers: Optional[Set[str]] = None,
+                 record: Callable[..., None] = None,
+                 name: str = "?"):
         # peer → highest view_no that peer has reported (shared across
         # ledgers by NodeLeecherService; feeds pool_view_estimate)
         self._view_tracker = view_tracker if view_tracker is not None \
             else {}
+        # peers whose reps failed audit-path verification (shared across
+        # ledgers: a seeder lying about one ledger is not trusted with
+        # the others either); chunk assignment skips them
+        self._bad_peers = bad_peers if bad_peers is not None else set()
+        # recovery-trace hook: record(event_name, **args) → flight
+        # recorder instant (NodeLeecherService wires the node tracer)
+        self._record = record or (lambda event, **args: None)
+        # per-NODE jitter salt: without it every node computes the
+        # identical jittered delay for the same (lid, retry) and the
+        # pool re-requests in lockstep anyway — crc32, not hash(),
+        # because str hashing is randomized per process and would break
+        # seeded-sim replay
+        self._jitter_salt = zlib.crc32(name.encode())
         self.lid = lid
         self._db = db_manager
         self._network = network
@@ -213,7 +231,16 @@ class LedgerLeecher:
         self.target_size: Optional[int] = None
         self.target_root: Optional[str] = None
         self._buffer: Dict[int, dict] = {}
-        self._retry_timer: Optional[RepeatingTimer] = None
+        # retry machinery: one-shot self-rescheduling with capped
+        # exponential backoff (NOT a fixed-period RepeatingTimer — see
+        # plenum-lint PT007); the generation guard makes stale scheduled
+        # callbacks no-ops after stop()/restart, and the kept closure
+        # reference lets stop() actually cancel the heap entry (a
+        # backoff-max delay would otherwise sit there ~75s post-catchup)
+        self.retry_count = 0
+        self._retry_gen = 0
+        self._retry_cb = None
+        self.next_retry_delay: Optional[float] = None
 
     @property
     def ledger(self) -> Ledger:
@@ -228,9 +255,9 @@ class LedgerLeecher:
         self._buffer.clear()
         self.target_size = None
         self.target_root = None
+        self.retry_count = 0
         self._broadcast_status()
-        self._retry_timer = RepeatingTimer(
-            self._timer, self._config.CATCHUP_TXN_TIMEOUT, self._retry)
+        self._schedule_retry()
 
     def _broadcast_status(self):
         ledger = self.ledger
@@ -239,22 +266,83 @@ class LedgerLeecher:
             ppSeqNo=None, merkleRoot=ledger.root_hash, protocolVersion=2))
 
     def stop(self):
-        if self._retry_timer is not None:
-            self._retry_timer.stop()
-            self._retry_timer = None
+        self._retry_gen += 1  # belt: any uncancelled retry is a no-op
+        if self._retry_cb is not None:
+            self._timer.cancel(self._retry_cb)
+            self._retry_cb = None
         self.state = LeecherState.DONE
 
     def _finish(self):
         self.stop()
         self._on_done(self.lid)
 
+    # ------------------------------------------------- retry + backoff
+
+    def _retry_delay(self) -> float:
+        """Capped exponential backoff with deterministic jitter:
+        retry i waits min(base * 2^i, cap) plus up to JITTER_FRAC of
+        that. Jitter derives from (node-name salt, lid, retry) — int
+        tuples hash stably in CPython, and the crc32 salt makes it
+        differ ACROSS nodes — so the whole fault pattern replays
+        bit-identically under a seeded sim while the pool's re-request
+        bursts desynchronize (N laggards starting catchup together must
+        not hammer the seeders in lockstep). Progress resets
+        retry_count (and with it the delay) to the base."""
+        conf = self._config
+        base = float(conf.CATCHUP_TXN_TIMEOUT)
+        cap = float(getattr(conf, "CATCHUP_RETRY_BACKOFF_MAX",
+                            Config.CATCHUP_RETRY_BACKOFF_MAX))
+        frac = float(getattr(conf, "CATCHUP_RETRY_JITTER_FRAC",
+                             Config.CATCHUP_RETRY_JITTER_FRAC))
+        delay = min(cap, base * (2 ** min(self.retry_count, 16)))
+        unit = (hash((self._jitter_salt, self.lid,
+                      self.retry_count)) & 0xFFFF) / 65536.0
+        return delay * (1.0 + frac * unit)
+
+    def _schedule_retry(self):
+        self._retry_gen += 1
+        gen = self._retry_gen
+        if self._retry_cb is not None:
+            self._timer.cancel(self._retry_cb)
+        delay = self._retry_delay()
+        self.next_retry_delay = delay
+
+        def fire():
+            if gen != self._retry_gen \
+                    or self.state != LeecherState.SYNCING:
+                return
+            self._retry()
+
+        self._retry_cb = fire
+        self._timer.schedule(delay, fire)
+
     def _retry(self):
         if self.state != LeecherState.SYNCING:
             return
+        # count BEFORE re-requesting so the very first retry already
+        # rotates the chunk assignment off whichever peer just starved
+        # it (and the next wait doubles)
+        self.retry_count += 1
+        self._record("catchup_retry", lid=self.lid,
+                     retry=self.retry_count,
+                     delay=round(self.next_retry_delay or 0.0, 3),
+                     bad_peers=len(self._bad_peers))
         if self.target_size is None:
             self._broadcast_status()
         else:
             self._request_missing()
+        self._schedule_retry()
+
+    def _note_progress(self):
+        """A peer answered usefully (target adopted / txns buffered):
+        the backoff restarts from the base period. The pending retry is
+        re-armed too — resetting only the counter would leave an
+        escalated (up-to-cap) delay already sitting in the timer heap,
+        so a chunk still missing (stalling seeder) would wait out the
+        stale long window even though the pool just proved responsive."""
+        if self.retry_count:
+            self.retry_count = 0
+            self._schedule_retry()
 
     # ----------------------------------------------------- status phase
 
@@ -294,6 +382,7 @@ class LedgerLeecher:
         if self.target_size is None or end > self.target_size:
             self.target_size = end
             self.target_root = root
+            self._note_progress()
             self._request_missing()
 
     # -------------------------------------------------------- rep phase
@@ -307,7 +396,17 @@ class LedgerLeecher:
         if not missing:
             self._try_apply()
             return
-        peers = sorted(self._network.connecteds) or [None]
+        connecteds = sorted(self._network.connecteds)
+        # skip peers whose reps failed proof verification; if that
+        # leaves nobody, fall back to everyone (a wrongly-blamed pool
+        # beats a stalled catchup — the root check still protects us)
+        peers = [p for p in connecteds if p not in self._bad_peers] \
+            or connecteds or [None]
+        # rotate assignment by retry round: a dead or silently lying
+        # peer must not keep receiving the same chunk forever (the
+        # pre-rotation deterministic split starved exactly like that)
+        rot = self.retry_count % len(peers)
+        peers = peers[rot:] + peers[:rot]
         # split contiguous chunks across peers
         chunk = max(1, (len(missing) + len(peers) - 1) // len(peers))
         for i, peer in enumerate(peers):
@@ -361,11 +460,28 @@ class LedgerLeecher:
         if self.target_size is None:
             return
         if not self._verify_rep_proofs(rep, frm):
+            # a proven-lying seeder is excluded from chunk assignment
+            # (for every ledger) and its chunk re-requested elsewhere
+            # right away instead of waiting out the retry period — but
+            # only on the FIRST conviction: an already-convicted peer
+            # spamming garbled reps must not amplify into a broadcast
+            # re-request per rep (the retry backoff owns re-requests
+            # from here on). Verified reps from convicted peers are
+            # still accepted below: the all-convicted fallback depends
+            # on a wrongly-blamed peer being able to redeem itself.
+            if frm not in self._bad_peers:
+                self._bad_peers.add(frm)
+                self._record("catchup_bad_peer", lid=self.lid, peer=frm)
+                self._request_missing()
             return
+        added = False
         for seq_str, txn in rep.txns.items():
             seq = int(seq_str)
             if self.ledger.size < seq <= self.target_size:
                 self._buffer[seq] = txn
+                added = True
+        if added:
+            self._note_progress()
         self._try_apply()
 
     def _try_apply(self):
@@ -411,7 +527,13 @@ class NodeLeecherService:
                  on_catchup_txn: Callable[[int, dict], None],
                  on_finished: Callable[[], None],
                  config: Optional[Config] = None,
-                 name: str = "?"):
+                 name: str = "?",
+                 peer_ok: Callable[[str], bool] = None):
+        """peer_ok(frm) → False rejects a catchup message before it can
+        touch any leecher state: the Node wires current pool membership
+        + its blacklist, so an unknown or blacklisted sender can neither
+        vote on targets nor feed reps (it could previously pad the
+        status/cons-proof quorums with fabricated senders)."""
         self._db = db_manager
         self._network = network
         self._timer = timer
@@ -419,8 +541,13 @@ class NodeLeecherService:
         self.name = name
         self.in_progress = False
         self._quorums = quorums_source
+        self._peer_ok = peer_ok or (lambda frm: True)
+        self.tracer = NullTracer(name)  # node injects the real one
         # peer → highest view reported in any status/proof this catchup
         self._view_tracker: Dict[str, int] = {}
+        # peers whose reps failed proof verification (shared: lying
+        # about one ledger disqualifies a seeder for all of them)
+        self.bad_peers: Set[str] = set()
         self._leechers: Dict[int, LedgerLeecher] = {}
         for lid in CATCHUP_LEDGER_ORDER:
             if self._db.get_ledger(lid) is None:
@@ -428,13 +555,20 @@ class NodeLeecherService:
             self._leechers[lid] = LedgerLeecher(
                 lid, db_manager, network, timer, quorums_source,
                 on_txn=on_catchup_txn, on_done=self._on_ledger_done,
-                config=config, view_tracker=self._view_tracker)
+                config=config, view_tracker=self._view_tracker,
+                bad_peers=self.bad_peers, record=self._record,
+                name=name)
         self._order = [lid for lid in CATCHUP_LEDGER_ORDER
                        if lid in self._leechers]
         self._current = 0
         network.subscribe(LedgerStatus, self._route_status)
         network.subscribe(ConsistencyProof, self._route_proof)
         network.subscribe(CatchupRep, self._route_rep)
+
+    def _record(self, event: str, **args):
+        """Recovery-lane flight-recorder instant (leecher retry/backoff
+        + bad-peer events land on the node's merged timeline)."""
+        self.tracer.instant(event, CAT_RECOVERY, **args)
 
     # ------------------------------------------------------------ routing
 
@@ -444,16 +578,22 @@ class NodeLeecherService:
         return self._leechers[self._order[self._current]]
 
     def _route_status(self, msg: LedgerStatus, frm: str):
+        if not self._peer_ok(frm):
+            return
         leecher = self._leechers.get(msg.ledgerId)
         if leecher is not None:
             leecher.process_ledger_status(msg, frm)
 
     def _route_proof(self, msg: ConsistencyProof, frm: str):
+        if not self._peer_ok(frm):
+            return
         leecher = self._leechers.get(msg.ledgerId)
         if leecher is not None:
             leecher.process_consistency_proof(msg, frm)
 
     def _route_rep(self, msg: CatchupRep, frm: str):
+        if not self._peer_ok(frm):
+            return
         leecher = self._leechers.get(msg.ledgerId)
         if leecher is not None:
             leecher.process_catchup_rep(msg, frm)
@@ -466,6 +606,9 @@ class NodeLeecherService:
         self.in_progress = True
         self._current = 0
         self._view_tracker.clear()
+        # a fresh catchup forgives past liars: membership may have
+        # changed, and the per-rep verification re-convicts instantly
+        self.bad_peers.clear()
         self._start_current()
 
     def pool_view_estimate(self) -> Optional[int]:

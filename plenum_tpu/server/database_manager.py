@@ -27,6 +27,37 @@ class DatabaseManager:
         # enabled; read handlers attach it to state proofs (reference
         # plenum/server/database_manager.py:112 bls_store property)
         self.bls_store = None
+        # lid → committed state root pinned for read serving while the
+        # node recovers (catchup / view change): roots committed txn-by-
+        # txn during catchup carry no BLS multi-sig yet, so serving them
+        # would strip the proof's multi_signature mid-recovery. The MPT
+        # keeps history, so the pinned (pre-recovery, BLS-signed) root
+        # stays readable and provable until the node unpins.
+        self._pinned_read_roots: Dict[int, bytes] = {}
+
+    def pin_read_roots(self):
+        """Pin every state's current committed root: proof-bearing reads
+        keep answering from it until unpin_read_roots (graceful read
+        degradation during view change / catchup). Already-pinned
+        ledgers are left alone — a view change starting MID-catchup
+        must not overwrite the pre-recovery signed pin with an unsigned
+        intermediate root catchup just committed."""
+        for lid, db in self.databases.items():
+            if db.state is not None and lid not in self._pinned_read_roots:
+                root = db.state.committedHeadHash
+                if root is not None:
+                    self._pinned_read_roots[lid] = bytes(root)
+
+    def unpin_read_roots(self):
+        self._pinned_read_roots.clear()
+
+    def pinned_read_root(self, lid) -> Optional[bytes]:
+        return self._pinned_read_roots.get(lid)
+
+    @property
+    def reads_degraded(self) -> bool:
+        """True while reads serve pinned (pre-recovery) roots."""
+        return bool(self._pinned_read_roots)
 
     def register_new_database(self, lid: int, ledger: Ledger,
                               state: Optional[State] = None,

@@ -53,7 +53,7 @@ from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
 
 _fp = try_load_ext("fastpath")
 from plenum_tpu.observability.tracing import (
-    CAT_DEVICE, CAT_INTAKE, CAT_REPLY, NullTracer, Tracer)
+    CAT_DEVICE, CAT_INTAKE, CAT_RECOVERY, CAT_REPLY, NullTracer, Tracer)
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
@@ -403,9 +403,13 @@ class Node:
                 _staged.metrics = self.metrics
         self.db_manager.metrics = self.metrics
         # same single-injection-point pattern for the flight recorder:
-        # every traced seam records into THIS node's ring buffer
+        # every traced seam records into THIS node's ring buffer (the
+        # view changer's recovery lane — view_change_start/done,
+        # vc_timeout_escalated — rides along; the leecher is attached
+        # after construction below)
         for _traced in (self.propagator, self.executor, self.replica,
-                        self.replica.ordering, bls_bft_replica):
+                        self.replica.ordering, bls_bft_replica,
+                        getattr(self.replica, "view_changer", None)):
             if _traced is not None:
                 _traced.tracer = self.tracer
         if verifier is not None and hasattr(verifier, "tracer"):
@@ -485,9 +489,31 @@ class Node:
             quorums_source=lambda: self.replica.data.quorums,
             on_catchup_txn=self._on_catchup_txn,
             on_finished=self._on_catchup_finished,
-            config=self.config, name=name)
+            config=self.config, name=name,
+            # catchup evidence only counts from current validators the
+            # node has not blacklisted: an unknown sender must not pad
+            # status/cons-proof quorums or feed reps (the blacklister is
+            # constructed below; the lambda dereferences at call time)
+            peer_ok=lambda frm: (
+                frm in self.pool_manager.validators
+                and not self.blacklister.is_blacklisted(frm)))
+        self.leecher.tracer = self.tracer
         self.replica.internal_bus.subscribe(
             NeedMasterCatchup, lambda msg: self.start_catchup())
+        # graceful read degradation half 2: ordering pauses for the
+        # whole view change, so proof-bearing reads pin the last
+        # committed (BLS-signed) roots until the new view lands —
+        # catchup pins/unpins the same way in start_catchup /
+        # _on_catchup_finished
+        from plenum_tpu.common.messages.internal_messages import (
+            ViewChangeStarted)
+        self.replica.internal_bus.subscribe(
+            ViewChangeStarted,
+            lambda msg: self.db_manager.pin_read_roots())
+        self.replica.internal_bus.subscribe(
+            NewViewAccepted,
+            lambda msg: self.leecher.in_progress
+            or self.db_manager.unpin_read_roots())
 
         # ---- suspicion reporting + blacklisting (reference
         # reportSuspiciousNode + SimpleBlacklister): every suspicion is
@@ -740,14 +766,37 @@ class Node:
             data = get_payload_data(last_audit)
             view_no = data.get("viewNo", 0)
             pp_seq_no = data.get("ppSeqNo", 0)
+        # a batch ORDERED in the view we're still waiting on proves its
+        # NEW_VIEW completed pool-wide while we weren't looking (likely
+        # disconnected) — absorb the pending view change from this
+        # evidence, or the node wedges: NEW_VIEW is never retransmitted
+        # and MessageReq is disabled mid view change (audit viewNo is
+        # the batch's ORIGINAL view, so re-ordered old-view batches
+        # never count as evidence — only genuinely new ones)
+        if last_audit is not None \
+                and self.replica.data.waiting_for_new_view:
+            vc_service = getattr(self.replica, "view_changer", None)
+            if vc_service is not None:
+                vc_service.absorb_view_from_catchup(view_no)
         if pool_view is not None:
             view_no = max(view_no, pool_view)
         current = self.replica.data.last_ordered_3pc
         if (view_no, pp_seq_no) <= current:
             return
         pp_seq_no = max(pp_seq_no, current[1])
+        view_was = self.replica.data.view_no
         self.replica.data.last_ordered_3pc = (view_no, pp_seq_no)
         self.replica.data.view_no = view_no
+        # absorb didn't fire (no batch ordered at the pending view yet)
+        # but pool evidence re-targeted a still-pending view change to
+        # a HIGHER view: the running NEW_VIEW timer's view guard now
+        # never matches, so re-arm it for the adopted view — the node
+        # keeps escalating/voting instead of wedging silently
+        if view_no > view_was \
+                and self.replica.data.waiting_for_new_view:
+            vc_service = getattr(self.replica, "view_changer", None)
+            if vc_service is not None:
+                vc_service.rearm_new_view_timeout()
         self.replica.ordering.lastPrePrepareSeqNo = pp_seq_no
         self.replica.ordering._last_applied_seq = pp_seq_no
         self.replica.checkpointer.caught_up_till_3pc((view_no, pp_seq_no))
@@ -1188,7 +1237,12 @@ class Node:
         if self.leecher.in_progress:
             return
         logger.info("%s starting catchup", self.name)
+        self.tracer.instant("catchup_start", CAT_RECOVERY)
         self._catchup_started_at = __import__("time").perf_counter()
+        self._catchup_started_sim = self.timer.get_current_time()
+        # reads degrade gracefully: keep serving the last committed
+        # (BLS-signed) roots while catchup rewrites state txn by txn
+        self.db_manager.pin_read_roots()
         self.mode_participating = False
         for replica in self.replicas:
             replica.data.node_mode_participating = False
@@ -1249,6 +1303,19 @@ class Node:
         # evidence gathered during catchup (f+1-supported estimate)
         self._adopt_3pc_from_audit(
             pool_view=self.leecher.pool_view_estimate())
+        # recovery over: reads resume serving the live committed roots
+        # (new multi-sigs arrive with the next ordered batches) — unless
+        # a view change is still pending, in which case the pin survives
+        # until NewViewAccepted (ordering is paused that whole window,
+        # so the caught-up roots would stay unsigned throughout it)
+        if not self.replica.data.waiting_for_new_view:
+            self.db_manager.unpin_read_roots()
+        self.tracer.instant(
+            "catchup_done", CAT_RECOVERY,
+            sim_s=round(self.timer.get_current_time()
+                        - getattr(self, "_catchup_started_sim",
+                                  self.timer.get_current_time()), 3),
+            bad_peers=len(self.leecher.bad_peers))
         if self.name not in self.pool_manager.validators:
             # catchup may have delivered our own demotion — a
             # non-validator must not resume voting

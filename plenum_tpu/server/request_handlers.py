@@ -479,7 +479,14 @@ class GetNymHandler(ReadRequestHandler):
             root = (ts_store.get_equal_or_prev(ts, self.ledger_id)
                     if ts_store is not None else None)
         else:
-            root = self.state.committedHeadHash
+            # graceful read degradation: while the node recovers
+            # (catchup / view change) reads keep serving the pinned
+            # pre-recovery committed root — the newest root that still
+            # has a BLS multi-sig — instead of the unsigned
+            # intermediate roots catchup commits txn by txn
+            root = self.database_manager.pinned_read_root(self.ledger_id)
+            if root is None:
+                root = self.state.committedHeadHash
         return nym, key, root
 
     @staticmethod

@@ -11,8 +11,9 @@ pending-buffer flushes and multi-key proof generation through the
 device MPT engine (state/device_state.py) — the same attach shape as
 `CompactMerkleTree.attach_device_engine`: calls below the config batch
 threshold keep the host trie path, every engine failure falls back to
-the host path, and a persistently failing engine is detached (circuit
-breaker) so a sick device can never tax the serving path.
+the host path, and a persistently failing engine opens the circuit
+breaker (cooldown + single recovery probe, utils/device_breaker.py) so
+a sick device can never tax the serving path yet a healed one resumes.
 """
 from __future__ import annotations
 
@@ -77,8 +78,8 @@ class PruningState(State):
     # trie wins on latency. Single-sourced from Config like the
     # MERKLE_DEVICE_* knobs.
     _engine_batch_min = _Config.STATE_DEVICE_BATCH_MIN
-    # consecutive engine failures before it is detached (every failure
-    # already falls back to the host trie path)
+    # consecutive engine failures before the breaker opens (every
+    # failure already falls back to the host trie path)
     _ENGINE_MAX_FAILURES = 3
 
     def __init__(self, kv):
@@ -140,14 +141,14 @@ class PruningState(State):
     def _engine_call(self, fn, label: str):
         """Run one engine operation under the shared circuit breaker
         (utils/device_breaker.py): None on failure — the caller serves
-        from the host trie — and a persistently failing engine is
-        detached for good."""
+        from the host trie. A persistently failing engine opens the
+        breaker (cooldown with zero device I/O, then a single recovery
+        probe); the engine stays attached so a healed device resumes
+        serving without a re-attach."""
         if self._engine is None:
             return None
         engine = self._engine
         ok, out = self._engine_breaker.run(lambda: fn(engine), label)
-        if not ok and self._engine_breaker.tripped:
-            self._engine = None
         return out if ok else None
 
     # ------------------------------------------------------------ writes

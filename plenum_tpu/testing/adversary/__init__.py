@@ -17,10 +17,12 @@ Usage sketch::
     # safety invariants every tick, raises InvariantViolation on fork
 """
 from plenum_tpu.testing.adversary.behaviors import (  # noqa: F401
-    Behavior, ConflictingPrepare, DuplicateThreePC, EquivocatingPrimary,
-    LinkFault, PoisonedBlsShare, TamperedPropagate)
+    Behavior, ConflictingPrepare, DuplicateThreePC, EquivocatingNewView,
+    EquivocatingPrimary, LinkFault, LyingCatchupSeeder, Partition,
+    PoisonedBlsShare, SilentNode, TamperedPropagate)
 from plenum_tpu.testing.adversary.controller import (  # noqa: F401
     AdversaryController)
 from plenum_tpu.testing.adversary.invariants import (  # noqa: F401
     InvariantChecker, InvariantViolation)
-from plenum_tpu.testing.adversary.scenario import Scenario  # noqa: F401
+from plenum_tpu.testing.adversary.scenario import (  # noqa: F401
+    LivenessViolation, Scenario, SLOViolation)

@@ -16,7 +16,8 @@ import logging
 from typing import List, Optional, Tuple
 
 from plenum_tpu.common.messages.node_messages import (
-    Commit, PrePrepare, Prepare, Propagate, PropagateBatch)
+    CatchupRep, Commit, ConsistencyProof, NewView, PrePrepare, Prepare,
+    Propagate, PropagateBatch)
 
 logger = logging.getLogger(__name__)
 
@@ -227,6 +228,182 @@ class PoisonedBlsShare(Behavior):
         params["blsSig"] = poisoned
         self.record("seq={} poisoned".format(msg.ppSeqNo))
         return [(Commit(**params), dst)]
+
+
+class SilentNode(Behavior):
+    """A crashed (or byzantine-silent) node: every outgoing message is
+    swallowed, and optionally every incoming one too. Installed on the
+    primary this is the classic fail-stop failover scenario — honest
+    nodes' disconnect/freshness watchdogs must vote a view change and
+    ordering must resume under the new primary. Unlike
+    SimNetwork.disconnect it keeps the transport 'connected' (no
+    Disconnected events), which is the HARD variant: a hung process
+    holds its sockets open, so only protocol-level timeouts can notice."""
+
+    name = "silent-node"
+
+    def __init__(self, drop_incoming: bool = True,
+                 message_types=None):
+        """message_types: restrict the silence (None = everything) —
+        e.g. only 3PC messages, keeping heartbeats alive."""
+        super().__init__()
+        self._drop_incoming = drop_incoming
+        self._types = tuple(message_types) if message_types else None
+        self._dropped = 0
+
+    def _silent_for(self, msg) -> bool:
+        return self._types is None or isinstance(msg, self._types)
+
+    def on_send(self, msg, dst):
+        if not self._silent_for(msg):
+            return None
+        self._dropped += 1
+        if self._dropped == 1:
+            self.record("went silent")
+        return []
+
+    def on_incoming(self, msg, frm):
+        if not self._drop_incoming or not self._silent_for(msg):
+            return None
+        return []
+
+
+class EquivocatingNewView(Behavior):
+    """A byzantine NEW primary abusing the one message only it may
+    send. Modes:
+
+    * ``equivocate`` — `real_count` recipients (None = half) get the
+      honest NEW_VIEW; the rest get a forgery with a tampered
+      checkpoint digest. Honest validators recompute the decision from
+      the referenced VIEW_CHANGEs (``_finish_view_change``), detect the
+      mismatch and vote the next view — the pool must converge past
+      the equivocator.
+    * ``stale`` — the first NEW_VIEW is swallowed and every later one
+      is replaced by the previously captured (now stale) message, which
+      receivers discard as an old view. Nobody ever completes the view
+      change under this primary, so the NEW_VIEW timeout (and its
+      escalation) is what recovers the pool.
+    """
+
+    name = "equivocate-nv"
+
+    def __init__(self, mode: str = "equivocate",
+                 real_count: Optional[int] = None):
+        assert mode in ("equivocate", "stale")
+        super().__init__()
+        self._mode = mode
+        self._real_count = real_count
+        self._last: Optional[NewView] = None
+
+    @staticmethod
+    def _forge(msg: NewView) -> NewView:
+        params = dict(msg.as_dict())
+        chk = dict(params.get("checkpoint") or {})
+        chk["digest"] = "forged-" + str(chk.get("digest", ""))[:32]
+        params["checkpoint"] = chk
+        return NewView(**params)
+
+    def on_send(self, msg, dst):
+        if not isinstance(msg, NewView):
+            return None
+        if self._mode == "stale":
+            prev, self._last = self._last, msg
+            if prev is None:
+                self.record("view={} NEW_VIEW swallowed".format(
+                    msg.viewNo))
+                return []
+            self.record("view={} replaced by stale view={}".format(
+                msg.viewNo, prev.viewNo))
+            return [(prev, dst)]
+        targets = _broadcast_targets(self.controller, self.node_name, dst)
+        if not targets:
+            return None
+        shuffled = self.controller.random.shuffle(sorted(targets))
+        half = max(0, len(shuffled) // 2) if self._real_count is None \
+            else max(0, min(self._real_count, len(shuffled)))
+        group_real, group_forged = shuffled[:half], shuffled[half:]
+        if not group_forged:
+            return None
+        self.record("view={} real->{} forged->{}".format(
+            msg.viewNo, ",".join(sorted(group_real)) or "-",
+            ",".join(sorted(group_forged))))
+        out = [(self._forge(msg), group_forged)]
+        if group_real:
+            out.insert(0, (msg, group_real))
+        return out
+
+
+class LyingCatchupSeeder(Behavior):
+    """A byzantine catchup provider: consistency proofs advertise a
+    forged root (they can never reach the leecher's quorum, only delay
+    it), and catchup reps are garbled — the per-txn content is mutated
+    while the audit paths still claim the honest range, so a leecher
+    verifying against the quorum-agreed root rejects the chunk at rep
+    time, marks this peer bad, and re-requests elsewhere. ``stall_every``
+    > 0 swallows every Nth rep instead (the silent-stall variant that
+    only the retry backoff + peer rotation can route around)."""
+
+    name = "lying-seeder"
+
+    def __init__(self, lie_cons_proofs: bool = True,
+                 garble_reps: bool = True, stall_every: int = 0):
+        super().__init__()
+        self._lie_proofs = lie_cons_proofs
+        self._garble = garble_reps
+        self._stall_every = stall_every
+        self._reps = 0
+
+    def on_send(self, msg, dst):
+        if isinstance(msg, ConsistencyProof) and self._lie_proofs:
+            from plenum_tpu.ledger.ledger import Ledger
+            params = dict(msg.as_dict())
+            params["newMerkleRoot"] = Ledger.hashToStr(
+                b"\x11" * 32)
+            self.record("lied cons-proof {}..{}".format(
+                msg.seqNoStart, msg.seqNoEnd))
+            return [(ConsistencyProof(**params), dst)]
+        if isinstance(msg, CatchupRep):
+            self._reps += 1
+            if self._stall_every and \
+                    self._reps % self._stall_every == 0:
+                self.record("stalled rep n={}".format(len(msg.txns)))
+                return []
+            if self._garble:
+                garbled = {seq: dict(txn, lie=self._reps)
+                           for seq, txn in msg.txns.items()}
+                self.record("garbled rep n={}".format(len(garbled)))
+                return [(CatchupRep(
+                    ledgerId=msg.ledgerId, txns=garbled,
+                    consProof=list(msg.consProof),
+                    auditPaths=getattr(msg, "auditPaths", None)), dst)]
+        return None
+
+
+class Partition(Behavior):
+    """One side of a network partition: sends reach only the peers in
+    ``reachable`` and incoming traffic from outside it is dropped.
+    Install one instance per node with reachable = that node's own
+    group (AdversaryController.partition wires a whole pool split);
+    releasing the behaviors heals the partition — LinkFault-style held
+    state does not exist here, so healing is instantaneous."""
+
+    name = "partition"
+
+    def __init__(self, reachable):
+        super().__init__()
+        self._reachable = set(reachable)
+
+    def on_send(self, msg, dst):
+        targets = _broadcast_targets(self.controller, self.node_name, dst)
+        kept = [t for t in targets if t in self._reachable]
+        if len(kept) == len(targets):
+            return None
+        return [(msg, kept)] if kept else []
+
+    def on_incoming(self, msg, frm):
+        if frm in self._reachable:
+            return None
+        return []
 
 
 class LinkFault(Behavior):

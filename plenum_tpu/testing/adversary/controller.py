@@ -158,6 +158,32 @@ class AdversaryController:
             self.release(name)
         self._ticker.stop()
 
+    def partition(self, *groups) -> Dict[str, object]:
+        """Split the pool into isolated groups: every node in a group
+        gets a Partition behavior whose reachable set is its own group
+        (cross-group traffic drops both ways). → {node_name: behavior}
+        for heal_partition. Nodes under partition count as 'corrupted'
+        for Scenario's default honest-set derivation — partition tests
+        pass an explicit honest list."""
+        from plenum_tpu.testing.adversary.behaviors import Partition
+        behaviors: Dict[str, object] = {}
+        for group in groups:
+            names = [self._name_of(n) for n in group]
+            for node in group:
+                behavior = Partition(reachable=names)
+                self.corrupt(node, behavior)
+                behaviors[self._name_of(node)] = behavior
+        self.record("partition {}".format(
+            " / ".join("+".join(sorted(self._name_of(n) for n in g))
+                       for g in groups)))
+        return behaviors
+
+    def heal_partition(self, behaviors: Dict[str, object]) -> None:
+        """Remove every Partition behavior installed by partition()."""
+        for name, behavior in behaviors.items():
+            self.release(name, behavior)
+        self.record("partition healed")
+
     # ---------------------------------------------------------- schedule
 
     def at(self, delay: float, action: Callable[[], None],

@@ -6,7 +6,13 @@ When a safety invariant fails and the pool is traced (Config
 TRACING_ENABLED), the runner automatically dumps the merged pool
 flight-recorder timeline (observability/) next to the failure — the
 ring buffers hold exactly the window leading up to the violation.
-Override the directory with PLENUM_TPU_TRACE_DIR."""
+Override the directory with PLENUM_TPU_TRACE_DIR.
+
+Soak mode (docs/robustness.md): `soak(rounds, fault, ...)` repeats
+inject → measure recovery (sim seconds) → heal → settle, gating each
+round's latency through `check_slo` — an SLO violation dumps the same
+merged timeline with the measured latency and threshold embedded in
+the filename and assertion text."""
 from __future__ import annotations
 
 import logging
@@ -25,6 +31,13 @@ _dump_seq = [0]
 
 class LivenessViolation(AssertionError):
     """The pool failed to make progress inside the bounded window."""
+
+
+class SLOViolation(AssertionError):
+    """A recovery-latency SLO was exceeded. The assertion text (and the
+    auto-dumped flight-recorder filename) embeds the measured latency
+    and the threshold, so a soak failure is triageable from the
+    artifact alone — no need to rerun to learn how bad it was."""
 
 
 class Scenario:
@@ -88,10 +101,13 @@ class Scenario:
                               % (e.args[0], path),) + e.args[1:]
             raise
 
-    def dump_trace(self, path: Optional[str] = None) -> Optional[str]:
+    def dump_trace(self, path: Optional[str] = None,
+                   tag: str = "invariant_failure") -> Optional[str]:
         """Merge every traced node's ring buffer into one pool-wide
         Chrome trace-event file. → path, or None when no node has
-        tracing enabled."""
+        tracing enabled. `tag` lands in the generated filename so an
+        artifact directory full of dumps stays self-describing (SLO
+        dumps embed the measured latency and threshold there)."""
         from plenum_tpu.observability.export import (
             export_chrome_trace, pool_tracers)
         tracers = [t for t in pool_tracers(self.nodes)
@@ -103,14 +119,80 @@ class Scenario:
                 or tempfile.gettempdir()
             _dump_seq[0] += 1
             path = os.path.join(
-                out_dir, "invariant_failure_trace_%d_%d.json"
-                % (os.getpid(), _dump_seq[0]))
+                out_dir, "%s_trace_%d_%d.json"
+                % (tag, os.getpid(), _dump_seq[0]))
         try:
             return export_chrome_trace(tracers, path)
         except OSError:
             logger.warning("could not write flight-recorder trace to %s",
                            path, exc_info=True)
             return None
+
+    # ------------------------------------------------- recovery SLOs
+
+    def measure(self, condition: Callable[[], bool], within: float,
+                desc: str) -> float:
+        """Pump until condition() holds → elapsed SIM seconds (the
+        recovery-latency measurement primitive: deterministic under
+        MockTimer, independent of host load)."""
+        t0 = self.timer.get_current_time()
+        self.run_until(condition, within, desc)
+        return self.timer.get_current_time() - t0
+
+    def check_slo(self, name: str, measured_s: float,
+                  threshold_s: float) -> float:
+        """Gate a measured recovery latency against its SLO. On
+        violation the merged flight-recorder timeline is auto-dumped
+        with the measured latency AND the threshold embedded in the
+        filename, and the raised assertion text carries both plus the
+        dump path — the failure artifact alone tells the whole story."""
+        if measured_s <= threshold_s:
+            return measured_s
+        tag = "slo_%s_%.2fs_gt_%.2fs" % (name, measured_s, threshold_s)
+        path = self.dump_trace(tag=tag.replace("/", "_"))
+        text = ("recovery SLO '%s' violated: measured %.2fs > "
+                "threshold %.2fs (sim time)" % (name, measured_s,
+                                                threshold_s))
+        if path:
+            logger.error("%s — flight-recorder timeline dumped to %s "
+                         "(load in ui.perfetto.dev)", text, path)
+            text += " [flight recorder: %s]" % path
+        raise SLOViolation(text)
+
+    # ------------------------------------------------------ soak mode
+
+    def soak(self, rounds: int, fault: Callable[[int], tuple],
+             settle: float = 5.0, within: float = 60.0,
+             slo: Optional[float] = None,
+             slo_name: str = "recovery") -> List[dict]:
+        """Repeated fault rounds with per-tick safety invariants and
+        per-round recovery-latency measurement — the long-run shape
+        where real RBFT deployments break (faults landing on a pool
+        still digesting the previous fault's recovery).
+
+        fault(round_idx) → (desc, recovered_condition, heal_fn|None):
+        inject the fault before returning; `recovered_condition` is
+        pumped under invariant checks until true (LivenessViolation
+        after `within` sim seconds); heal_fn (if any) runs after
+        recovery; then the pool settles for `settle` sim seconds before
+        the next round. With `slo` set, every round's recovery latency
+        is gated through check_slo (auto-dumping timelines on
+        violation). → per-round records [{round, fault, recovery_s}]."""
+        results: List[dict] = []
+        for r in range(rounds):
+            desc, recovered, heal = fault(r)
+            latency = self.measure(
+                recovered, within, "round %d: %s" % (r, desc))
+            if heal is not None:
+                heal()
+            if settle:
+                self.run(settle)
+            results.append({"round": r, "fault": desc,
+                            "recovery_s": round(latency, 3)})
+            if slo is not None:
+                self.check_slo("%s_round%d" % (slo_name, r), latency,
+                               slo)
+        return results
 
     # ------------------------------------------------- liveness helpers
 
@@ -142,6 +224,13 @@ class Scenario:
 
         return self.run_until(
             done, within, "view change to >= {}".format(min_view))
+
+    def await_catchup_done(self, node, within: float = 60.0) -> "Scenario":
+        """The node's leecher must finish syncing every ledger within
+        the window (catchup-completion liveness)."""
+        return self.run_until(
+            lambda: not node.leecher.in_progress, within,
+            "catchup completes on {}".format(node.name))
 
 
 def _replica(node):

@@ -212,22 +212,38 @@ def test_pruning_state_uncommitted_batch_reads_see_pending():
     assert st.get_batch([b"p0", b"p1"], isCommitted=False) == [b"q0", b"q1"]
 
 
-def test_circuit_breaker_detaches_and_host_serves():
+def test_circuit_breaker_opens_host_serves_and_probe_reattaches():
     class Boom:
         tracer = None
+        calls = 0
+
+        def __init__(self):
+            self.sick = True
+
+        def _maybe(self):
+            Boom.calls += 1
+            if self.sick:
+                raise RuntimeError("boom")
 
         def apply_batch(self, *a):
-            raise RuntimeError("boom")
+            self._maybe()
+            raise RuntimeError("healed engine unused in this test")
 
-        def get_batch(self, *a):
-            raise RuntimeError("boom")
+        def get_batch(self, root, keys, **kw):
+            self._maybe()
+            raise RuntimeError("healed engine unused in this test")
 
-        def proof_batch(self, *a):
-            raise RuntimeError("boom")
+        def proof_batch(self, *a, **kw):
+            self._maybe()
+            raise RuntimeError("healed engine unused in this test")
 
     ref = PruningState(KeyValueStorageInMemory())
     st = PruningState(KeyValueStorageInMemory())
-    st.attach_device_engine(engine=Boom(), batch_min=1)
+    eng = Boom()
+    st.attach_device_engine(engine=eng, batch_min=1)
+    clock = [0.0]
+    st._engine_breaker._clock = lambda: clock[0]
+    st._engine_breaker.cooldown_s = 30.0
     for s in (ref, st):
         for i in range(25):
             s.set(b"cb%d" % i, b"v%d" % i)
@@ -235,13 +251,34 @@ def test_circuit_breaker_detaches_and_host_serves():
     keys = [b"cb%d" % i for i in range(25)]
     st.get_batch(keys, isCommitted=False)
     st.generate_state_proof_batch(keys, root=st.headHash)
-    assert st._engine is None, "3 consecutive failures must detach"
-    # detached state keeps serving identically to a plain host state
+    # 3 consecutive failures OPEN the breaker; the engine stays
+    # attached but sees zero calls during the cooldown
+    assert st._engine is eng and st._engine_breaker.open
+    calls_at_trip = Boom.calls
     st.commit()
     ref.commit()
     assert st.get_batch(keys) == [ref.get(k) for k in keys]
     assert st.generate_state_proof_batch(keys) == \
         [ref.generate_state_proof(k) for k in keys]
+    assert Boom.calls == calls_at_trip, \
+        "open breaker must not touch the engine"
+    # cooldown over, still sick: the single probe re-trips quietly and
+    # the host keeps serving correctly
+    clock[0] += 31.0
+    assert st.get_batch(keys) == [ref.get(k) for k in keys]
+    assert Boom.calls == calls_at_trip + 1
+    assert st._engine_breaker.open
+    # recovery probe on a healed engine closes the breaker again
+    clock[0] += 31.0
+
+    def healed_get(root, keys, **kw):
+        Boom.calls += 1
+        return [ref.get(k) for k in keys]
+
+    eng.get_batch = healed_get
+    assert st.get_batch(keys) == [ref.get(k) for k in keys]
+    assert not st._engine_breaker.open
+    assert st._engine_breaker.recoveries == 1
 
 
 def test_engine_failure_preserves_pending_writes():

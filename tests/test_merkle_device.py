@@ -16,6 +16,7 @@ from plenum_tpu.ledger.compact_merkle_tree import CompactMerkleTree
 from plenum_tpu.ledger.hash_store import MemoryHashStore
 from plenum_tpu.ledger.merkle_verifier import MerkleVerifier
 from plenum_tpu.ledger.tree_hasher import TreeHasher
+from plenum_tpu.ops.merkle import DeviceMerkleTree
 
 H = TreeHasher()
 V = MerkleVerifier(H)
@@ -308,30 +309,69 @@ def test_seeder_chunks_reps_with_verified_audit_paths():
                 path, 25, root)
 
 
-def test_device_engine_circuit_breaker_detaches_after_failures():
+def test_device_engine_circuit_breaker_opens_and_recovers():
     """A persistently failing engine falls back to the host memo path
-    every time and is detached after _DEVICE_MAX_FAILURES — proofs
+    every time; after _DEVICE_MAX_FAILURES the breaker OPENS (engine
+    stays attached, zero device calls during the cooldown), and once
+    the device heals the post-cooldown probe re-attaches it — proofs
     stay correct throughout."""
     tree = CompactMerkleTree(TreeHasher(), MemoryHashStore())
     for i in range(40):
         tree.append(b"cb-%d" % i)
     exp = tree.inclusion_proofs_batch(list(range(40)), 40)
 
-    class Broken:
-        tree_size = 0
+    class FlakyEngine:
+        """Sick until healed; healed = transparent proxy over a REAL
+        DeviceMerkleTree, so the recovery probe exercises the genuine
+        sync + ProofPipeline path."""
 
-        def reset(self):
-            pass
+        def __init__(self):
+            self.real = None
+            self.calls = 0
 
-        def build_from_leaf_hashes(self, _):
-            raise RuntimeError("device is sick")
+        def heal(self):
+            self.real = DeviceMerkleTree()
 
-    tree.attach_device_engine(engine=Broken(), proof_min=1)
+        @property
+        def tree_size(self):
+            return self.real.tree_size if self.real is not None else 0
+
+        def build_from_leaf_hashes(self, leaves):
+            self.calls += 1
+            if self.real is None:
+                raise RuntimeError("device is sick")
+            return self.real.build_from_leaf_hashes(leaves)
+
+        def __getattr__(self, name):  # healed: delegate everything
+            if self.real is None:
+                raise RuntimeError("device is sick")
+            return getattr(self.real, name)
+
+    eng = FlakyEngine()
+    tree.attach_device_engine(engine=eng, proof_min=1)
+    clock = [0.0]
+    breaker = tree._device_breaker
+    breaker._clock = lambda: clock[0]
+    breaker.cooldown_s = 30.0
     for _ in range(tree._DEVICE_MAX_FAILURES):
-        assert tree._device_engine is not None
+        assert not breaker.open
         assert tree.inclusion_proofs_batch(list(range(40)), 40) == exp
-    assert tree._device_engine is None  # detached, host path serves
+    # OPEN: engine stays attached but is never called during cooldown
+    assert breaker.open and tree._device_engine is eng
+    calls_at_trip = eng.calls
     assert tree.inclusion_proofs_batch(list(range(40)), 40) == exp
+    assert eng.calls == calls_at_trip, "open breaker must not touch it"
+    # cooldown over, still sick: the single probe re-trips quietly
+    clock[0] += 31.0
+    assert tree.inclusion_proofs_batch(list(range(40)), 40) == exp
+    assert eng.calls == calls_at_trip + 1 and breaker.open
+    # device heals: the next probe succeeds, the breaker closes, and
+    # proofs really come from the device engine again
+    clock[0] += 31.0
+    eng.heal()
+    assert tree.inclusion_proofs_batch(list(range(40)), 40) == exp
+    assert not breaker.open and breaker.recoveries == 1
+    assert eng.tree_size == 40, "probe resynced the healed engine"
 
 
 def test_seeder_audit_paths_config_off():

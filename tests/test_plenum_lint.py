@@ -406,6 +406,62 @@ def test_pt006_any_call_counts_inside_ops_and_crypto():
                              "plenum_tpu/storage/helper2.py")
 
 
+# --------------------------------------------------------------- PT007
+
+# the PR-7 incident shape: the leecher's fixed-period retry timer
+PT007_BAD = """
+    from plenum_tpu.runtime.timer import RepeatingTimer
+
+    class Leecher:
+        def start(self):
+            self._retry_timer = RepeatingTimer(self._timer, 6,
+                                               self._retry)
+
+        def _arm_resend(self):
+            self._t = RepeatingTimer(self._timer, interval=2.5,
+                                     callback=self._resend)
+"""
+
+PT007_GOOD = """
+    from plenum_tpu.runtime.timer import RepeatingTimer
+
+    class Leecher:
+        def start(self):
+            # config-sourced period is fine even on a retry target...
+            self._retry_timer = RepeatingTimer(
+                self._timer, self._config.CATCHUP_TXN_TIMEOUT,
+                self._retry)
+
+        def _schedule_retry(self):
+            # ...and one-shot self-rescheduling with backoff is the
+            # preferred shape (no RepeatingTimer at all)
+            self._timer.schedule(self._retry_delay(), self._fire)
+
+        def start_metrics(self):
+            # periodic NON-retry work may keep a literal cadence
+            self._flush_timer = RepeatingTimer(self._timer, 10,
+                                               self._flush)
+"""
+
+
+def test_pt007_fires_on_literal_period_retry_timers():
+    findings = check_snippet(rule_by_code("PT007"), PT007_BAD,
+                             "plenum_tpu/server/catchup2.py")
+    assert len(findings) == 2
+    assert all("backoff" in f.message for f in findings)
+
+
+def test_pt007_clean_on_config_period_backoff_and_non_retry():
+    assert check_snippet(rule_by_code("PT007"), PT007_GOOD,
+                         "plenum_tpu/server/catchup2.py") == []
+
+
+def test_pt007_out_of_scope_paths():
+    rule = rule_by_code("PT007")
+    assert not rule.applies("plenum_tpu/testing/adversary/controller.py")
+    assert rule.applies("plenum_tpu/client/client.py")
+
+
 # -------------------------------------------------------------- pragmas
 
 def test_inline_pragma_suppresses_one_line():

@@ -1,0 +1,954 @@
+"""Recovery under fire: view-change storms, catchup churn, membership
+faults, and the production hardening they force (ROADMAP item 4).
+
+Tier-1 half: short variants (4-node pools, 1 fault round) of every
+scenario plus unit coverage for the recovery mechanics — leecher
+backoff/rotation/exclusion, NEW_VIEW timeout escalation, the breaker
+half-open probe, hostile-sender routing, graceful read degradation,
+and the SLO-violation dump format. The `slow`-marked soak half runs
+the same scenarios at 25-node scale with repeated fault rounds.
+"""
+import os
+
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import AUDIT_LEDGER_ID, DOMAIN_LEDGER_ID
+from plenum_tpu.common.messages.node_messages import (
+    CatchupRep, ConsistencyProof, LedgerStatus, ViewChangeAck)
+from plenum_tpu.consensus.quorums import Quorums
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.catchup import (
+    LedgerLeecher, LeecherState, NodeLeecherService)
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.mock_timer import MockTimer
+from plenum_tpu.testing.sim_network import SimNetwork, Tap
+from plenum_tpu.testing.adversary import (
+    AdversaryController, EquivocatingNewView, LyingCatchupSeeder,
+    Scenario, SilentNode, SLOViolation)
+from plenum_tpu.utils.device_breaker import DeviceCircuitBreaker
+
+from tests.test_adversary import build_pool, submit
+from tests.test_node_e2e import (
+    ClientSink, NAMES, SIM_EPOCH, signed_nym_request, submit_to_all)
+from tests.test_view_change_e2e import live_roots_agree
+
+
+# ========================================================= breaker unit
+
+
+def test_breaker_half_open_probe_lifecycle():
+    """CLOSED → OPEN after max_failures; zero calls during cooldown;
+    one probe after it — failure re-trips quietly, success closes."""
+    clock = [0.0]
+    calls = []
+    sick = [True]
+
+    def op():
+        calls.append(1)
+        if sick[0]:
+            raise RuntimeError("boom")
+        return "ok"
+
+    br = DeviceCircuitBreaker("engine", "host", max_failures=3,
+                              cooldown_s=10.0, clock=lambda: clock[0])
+    for i in range(3):
+        assert br.run(op) == (False, None)
+    assert br.open and br.trips == 1 and len(calls) == 3
+    # OPEN: the engine is never touched
+    assert br.run(op) == (False, None)
+    assert len(calls) == 3
+    # cooldown over, still sick: single probe, quiet re-trip
+    clock[0] = 11.0
+    assert br.probe_due()
+    assert br.run(op) == (False, None)
+    assert len(calls) == 4 and br.open and br.trips == 2
+    assert br.run(op) == (False, None) and len(calls) == 4
+    # healed: the next probe closes the breaker
+    clock[0] = 22.0
+    sick[0] = False
+    assert br.run(op) == (True, "ok")
+    assert not br.open and br.recoveries == 1 and br.fail_count == 0
+    # and a later success stays on the normal path
+    assert br.run(op) == (True, "ok")
+
+
+def test_breaker_reraise_exempt_from_probe_accounting():
+    """Domain errors propagate untouched in every state and never
+    count against the device."""
+    clock = [0.0]
+    br = DeviceCircuitBreaker("engine", "host", max_failures=1,
+                              reraise=(KeyError,), cooldown_s=5.0,
+                              clock=lambda: clock[0])
+
+    def missing():
+        raise KeyError("nope")
+
+    with pytest.raises(KeyError):
+        br.run(missing)
+    assert not br.open and br.fail_count == 0
+    br.run(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    assert br.open
+    clock[0] = 6.0
+    with pytest.raises(KeyError):
+        br.run(missing)  # the probe's domain error surfaces too
+
+
+# ================================================== leecher retry unit
+
+
+class _FakeLedger:
+    size = 0
+
+    @property
+    def root_hash(self):
+        from plenum_tpu.ledger.ledger import Ledger
+        return Ledger.hashToStr(b"\x00" * 32)
+
+
+class _FakeDb:
+    def __init__(self, lids=(DOMAIN_LEDGER_ID,)):
+        self._lids = set(lids)
+
+    def get_ledger(self, lid):
+        return _FakeLedger() if lid in self._lids else None
+
+
+class _FakeNet:
+    def __init__(self, connecteds=()):
+        self.connecteds = set(connecteds)
+        self.sent = []
+
+    def send(self, msg, dst=None):
+        self.sent.append((msg, dst))
+
+    def subscribe(self, *a, **kw):
+        pass
+
+
+def _leecher(connecteds=("A", "B", "C"), **conf):
+    net = _FakeNet(connecteds)
+    leecher = LedgerLeecher(
+        DOMAIN_LEDGER_ID, _FakeDb(), net, MockTimer(),
+        quorums_source=lambda: Quorums(4),
+        on_txn=lambda lid, txn: None, on_done=lambda lid: None,
+        config=Config(CATCHUP_TXN_TIMEOUT=2, **conf))
+    return leecher, net
+
+
+def test_leecher_backoff_doubles_and_caps_with_bounded_jitter():
+    leecher, _ = _leecher()
+    base, cap = 2.0, Config.CATCHUP_RETRY_BACKOFF_MAX
+    frac = Config.CATCHUP_RETRY_JITTER_FRAC
+    prev_floor = 0.0
+    for i in range(10):
+        leecher.retry_count = i
+        floor = min(cap, base * (2 ** i))
+        delay = leecher._retry_delay()
+        assert floor <= delay <= floor * (1 + frac), (i, delay)
+        assert floor >= prev_floor
+        prev_floor = floor
+    # deterministic: the same (lid, retry) always draws the same jitter
+    leecher.retry_count = 3
+    assert leecher._retry_delay() == leecher._retry_delay()
+    # progress resets to the base period
+    leecher._note_progress()
+    assert leecher.retry_count == 0
+    assert leecher._retry_delay() <= base * (1 + frac)
+
+
+def test_leecher_rotates_assignment_and_skips_bad_peers():
+    leecher, net = _leecher(connecteds=("A", "B", "C"))
+    leecher.state = LeecherState.SYNCING
+    leecher.target_size = 6
+    leecher.target_root = "whatever"
+
+    def first_assignee():
+        net.sent.clear()
+        leecher._request_missing()
+        reqs = {dst[0]: msg for msg, dst in net.sent}
+        # the peer holding seqNo 1 (the chunk a dead peer would starve)
+        return next(dst for dst, msg in reqs.items()
+                    if msg.seqNoStart == 1)
+
+    leecher.retry_count = 0
+    holders = [first_assignee()]
+    for retry in (1, 2):
+        leecher.retry_count = retry
+        holders.append(first_assignee())
+    # rotation: three consecutive retries hand the first chunk to three
+    # different peers — no peer can starve a chunk forever
+    assert len(set(holders)) == 3, holders
+    # a peer whose reps failed verification receives nothing at all
+    leecher._bad_peers.add("B")
+    net.sent.clear()
+    leecher._request_missing()
+    assert net.sent and all("B" not in dst for _, dst in net.sent)
+    # all peers convicted: fall back to everyone rather than stall
+    leecher._bad_peers.update({"A", "C"})
+    net.sent.clear()
+    leecher._request_missing()
+    assert net.sent
+
+
+def test_leecher_marks_lying_peer_and_rerequests_immediately():
+    """A rep failing audit-path verification convicts the sender (for
+    every ledger — the set is shared) and re-requests the chunk without
+    waiting out the retry period."""
+    leecher, net = _leecher(connecteds=("A", "B"))
+    leecher.state = LeecherState.SYNCING
+    leecher.target_size = 2
+    leecher.target_root = "x" * 44
+    net.sent.clear()
+    rep = CatchupRep(ledgerId=DOMAIN_LEDGER_ID,
+                     txns={"1": {"txn": {"data": {"lie": 1}}}},
+                     consProof=[],
+                     auditPaths={"1": ["3yZ" * 10]})
+    leecher.process_catchup_rep(rep, "B")
+    assert "B" in leecher._bad_peers
+    assert not leecher._buffer, "the lying chunk must not be buffered"
+    assert net.sent, "the chunk is re-requested right away"
+    assert all("B" not in dst for _, dst in net.sent)
+
+
+def test_convicted_peer_rep_spam_does_not_amplify_rerequests():
+    """Only the FIRST conviction triggers the immediate re-request: a
+    convicted peer spamming garbled reps must not turn into a broadcast
+    CatchupReq burst per rep (O(spam_rate x peers) amplification that
+    bypasses the retry backoff). And a later rep that verifies — e.g.
+    a path-less legacy rep riding the final root check — still buffers,
+    so a wrongly-blamed peer can redeem itself under the all-convicted
+    fallback."""
+    leecher, net = _leecher(connecteds=("A", "B"))
+    leecher.state = LeecherState.SYNCING
+    leecher.target_size = 2
+    leecher.target_root = "x" * 44
+    garbled = CatchupRep(ledgerId=DOMAIN_LEDGER_ID,
+                         txns={"1": {"txn": {"data": {"lie": 1}}}},
+                         consProof=[],
+                         auditPaths={"1": ["3yZ" * 10]})
+    net.sent.clear()
+    leecher.process_catchup_rep(garbled, "B")
+    first_burst = len(net.sent)
+    assert first_burst, "first conviction re-requests immediately"
+    for _ in range(5):
+        leecher.process_catchup_rep(garbled, "B")
+    assert len(net.sent) == first_burst, \
+        "spam from an already-convicted peer must not re-request again"
+    # redemption: a rep that passes verification still buffers
+    honest = CatchupRep(ledgerId=DOMAIN_LEDGER_ID,
+                        txns={"2": {"txn": {"data": {"ok": 1}}}},
+                        consProof=[])
+    leecher.process_catchup_rep(honest, "B")
+    assert 2 in leecher._buffer
+
+
+def test_progress_rearms_escalated_retry_at_base_period():
+    """_note_progress must re-arm the PENDING retry, not just zero the
+    counter: an escalated (up-to-cap) delay already sitting in the
+    timer heap would otherwise make a still-missing chunk wait out the
+    stale long window even though the pool just proved responsive."""
+    leecher, _ = _leecher()
+    base = 2.0 * (1 + Config.CATCHUP_RETRY_JITTER_FRAC)
+    leecher.state = LeecherState.SYNCING
+    leecher.retry_count = 6
+    leecher._schedule_retry()
+    assert leecher.next_retry_delay > base, "escalated delay armed"
+    leecher._note_progress()
+    assert leecher.retry_count == 0
+    assert leecher.next_retry_delay <= base, \
+        "progress re-arms the retry at the base period"
+
+
+# ============================================= hostile-sender routing
+
+
+def test_leecher_routing_rejects_unknown_and_blacklisted_senders():
+    """status/proof/rep from peers outside peer_ok must not advance ANY
+    leecher state: 3 fabricated senders could otherwise forge the
+    status quorum or a consistency-proof quorum."""
+    net = _FakeNet(("A", "B", "C"))
+    service = NodeLeecherService(
+        _FakeDb(), net, MockTimer(),
+        quorums_source=lambda: Quorums(4),
+        on_catchup_txn=lambda lid, txn: None,
+        on_finished=lambda: None,
+        config=Config(CATCHUP_TXN_TIMEOUT=2),
+        peer_ok=lambda frm: frm in {"A", "B", "C"})
+    service.start()
+    leecher = service._active()
+    assert leecher is not None and service.in_progress
+    ledger = leecher.ledger
+    # forged status-quorum attempt (same size+root → "we're in sync")
+    from plenum_tpu.ledger.ledger import Ledger
+    status = LedgerStatus(ledgerId=leecher.lid, txnSeqNo=ledger.size,
+                          viewNo=7, ppSeqNo=1,
+                          merkleRoot=ledger.root_hash,
+                          protocolVersion=2)
+    for evil in ("Evil1", "Evil2", "Evil3"):
+        service._route_status(status, evil)
+    assert not leecher._statuses_same
+    assert service.in_progress, "forged quorum must not finish catchup"
+    assert service.pool_view_estimate() is None  # no view evidence
+    # forged consistency-proof quorum must not set a target
+    proof = ConsistencyProof(
+        ledgerId=leecher.lid, seqNoStart=ledger.size, seqNoEnd=5,
+        viewNo=7, ppSeqNo=1,
+        oldMerkleRoot=ledger.root_hash,
+        newMerkleRoot=Ledger.hashToStr(b"\x13" * 32), hashes=[])
+    for evil in ("Evil1", "Evil2", "Evil3"):
+        service._route_proof(proof, evil)
+    assert leecher.target_size is None
+    # nor may an unknown sender feed reps
+    service._route_rep(CatchupRep(ledgerId=leecher.lid,
+                                  txns={"1": {"t": 1}}, consProof=[]),
+                       "Evil1")
+    assert not leecher._buffer
+    # the same messages from legitimate peers DO advance state
+    service._route_proof(proof, "A")
+    service._route_proof(proof, "B")
+    assert leecher.target_size == 5
+    assert service.pool_view_estimate() == 7  # f+1 = 2 reporters
+
+
+def test_node_wires_membership_and_blacklist_into_leecher():
+    """End-to-end: a full Node's leecher ignores senders outside the
+    live validator set and blacklisted validators."""
+    timer, net, nodes, sinks = build_pool(61)
+    node = nodes[0]
+    node.start_catchup()
+    leecher = node.leecher._active()
+    assert leecher is not None
+    from plenum_tpu.ledger.ledger import Ledger
+    proof = ConsistencyProof(
+        ledgerId=leecher.lid, seqNoStart=leecher.ledger.size,
+        seqNoEnd=9, viewNo=1, ppSeqNo=1,
+        oldMerkleRoot=leecher.ledger.root_hash,
+        newMerkleRoot=Ledger.hashToStr(b"\x17" * 32), hashes=[])
+    node.leecher._route_proof(proof, "NotAValidator")
+    node.leecher._route_proof(proof, "NotAValidator2")
+    assert leecher.target_size is None
+    node.blacklister.blacklist(NAMES[1])
+    node.leecher._route_proof(proof, NAMES[1])
+    assert leecher.target_size is None
+    node.leecher._route_proof(proof, NAMES[2])
+    node.leecher._route_proof(proof, NAMES[3])
+    assert leecher.target_size == 9
+
+
+# =========================================== view-change ack routing
+
+
+def test_no_ack_when_view_change_sender_is_selected_primary():
+    """Acks confirm OTHER nodes' VIEW_CHANGEs to the new primary; the
+    primary's own VIEW_CHANGE needs no ack (it is its own direct
+    receipt) — and non-primaries must still count it."""
+    timer, net, nodes, sinks = build_pool(62)
+    tap = Tap(message_types=[ViewChangeAck])
+    net.add_processor(tap)
+    for n in nodes:
+        n.replica.start_view_change()
+    sc = Scenario(timer, nodes)
+    sc.await_view_change(min_view=1, within=40)
+    new_primary = nodes[0].master_primary_name
+    acks = [(m.frm, m.message, m.dst) for m in tap.seen]
+    assert acks, "a completed view change must have routed acks"
+    for frm, ack, dst in acks:
+        assert dst == new_primary, "acks go only to the new primary"
+        assert ack.name != new_primary, \
+            "nobody acks the primary's own VIEW_CHANGE back to it"
+        assert frm != new_primary, "the primary never acks"
+    # the primary's own VIEW_CHANGE was still counted as confirmed
+    assert all(not n.replica.data.waiting_for_new_view for n in nodes)
+
+
+# ========================================================== failover
+
+
+def test_silent_primary_failover_within_slo():
+    """Fail-stop primary (process hangs, sockets stay open): honest
+    watchdogs must vote the view change and ordering must resume —
+    measured in sim time and gated against the failover SLO."""
+    timer, net, nodes, sinks = build_pool(63)
+    primary = next(n for n in nodes if n.replica.data.is_primary)
+    adv = AdversaryController(timer, seed=13)
+    adv.set_pool(nodes)
+    sc = Scenario(timer, nodes, adversary=adv,
+                  honest=[n.name for n in nodes if n is not primary])
+    submit(nodes, 0, 500)
+    sc.run(2)
+    adv.corrupt(primary, SilentNode())
+    submit(nodes, 1, 501)
+    honest = sc.honest
+    base = {n.name: n.last_ordered[1] for n in honest}
+
+    def recovered():
+        return all(n.view_no >= 1
+                   and not n.replica.data.waiting_for_new_view
+                   and n.last_ordered[1] > base[n.name]
+                   for n in honest)
+
+    latency = sc.measure(recovered, within=90,
+                         desc="failover + ordering resumes")
+    sc.check_slo("failover", latency, Config.RECOVERY_FAILOVER_SLO_S)
+    assert all(n.master_primary_name != primary.name for n in honest)
+    assert live_roots_agree(honest)
+
+
+def test_stale_new_view_escalates_timeout_until_recovery():
+    """A byzantine next-primary replaying stale NEW_VIEWs: nobody can
+    complete the view change under it, the NEW_VIEW timeout fires and
+    ESCALATES (doubling), the pool votes past the liar, and the
+    escalation resets once a view change finally completes."""
+    timer, net, nodes, sinks = build_pool(64)
+    # round-robin: the view-1 primary is the one to corrupt
+    next_primary_name = nodes[0].replica.view_changer \
+        ._selector.select_master_primary(1)
+    liar = next(n for n in nodes if n.name == next_primary_name)
+    adv = AdversaryController(timer, seed=14)
+    adv.set_pool(nodes)
+    adv.corrupt(liar, EquivocatingNewView(mode="stale"))
+    sc = Scenario(timer, nodes, adversary=adv)
+    honest = sc.honest
+    base_timeout = nodes[0].config.NEW_VIEW_TIMEOUT
+    max_failed = [0]
+    max_timeout = [0.0]
+
+    def recovered():
+        for n in honest:
+            vc = n.replica.view_changer
+            max_failed[0] = max(max_failed[0],
+                                vc.consecutive_failed_view_changes)
+            max_timeout[0] = max(max_timeout[0], vc.new_view_timeout())
+        return all(n.view_no >= 2
+                   and not n.replica.data.waiting_for_new_view
+                   for n in honest)
+
+    for n in nodes:
+        n.replica.start_view_change()
+    sc.run_until(recovered, timeout=120,
+                 desc="escalate past the stale-NEW_VIEW primary")
+    # the escalation was observable: at least one failed view change
+    # doubled the window...
+    assert max_failed[0] >= 1
+    assert max_timeout[0] >= 2 * base_timeout
+    # ...and completing a view change de-escalated back to the base
+    for n in honest:
+        assert n.replica.view_changer.consecutive_failed_view_changes \
+            == 0
+        assert n.replica.view_changer.new_view_timeout() == base_timeout
+    # the pool still orders under the post-escalation primary
+    submit(nodes, 2, 510)
+    sc.await_ordering_resumes(extra_batches=1, within=30)
+    sc.run_until(lambda: live_roots_agree(sc.honest), timeout=30,
+                 desc="honest roots converge after escalated recovery")
+
+
+def test_equivocating_new_view_detected_and_pool_recovers():
+    """NEW_VIEW equivocation (forged checkpoint digest to half the
+    pool): validators recompute the decision, detect the mismatch, and
+    drive another view change until an honest primary completes one."""
+    timer, net, nodes, sinks = build_pool(65)
+    next_primary_name = nodes[0].replica.view_changer \
+        ._selector.select_master_primary(1)
+    liar = next(n for n in nodes if n.name == next_primary_name)
+    adv = AdversaryController(timer, seed=15)
+    adv.set_pool(nodes)
+    adv.corrupt(liar, EquivocatingNewView(mode="equivocate",
+                                          real_count=0))
+    sc = Scenario(timer, nodes, adversary=adv)
+    for n in nodes:
+        n.replica.start_view_change()
+    honest = sc.honest
+    sc.run_until(
+        lambda: all(n.view_no >= 2
+                    and not n.replica.data.waiting_for_new_view
+                    for n in honest),
+        timeout=120, desc="converge past the equivocating NEW_VIEW")
+    submit(nodes, 3, 520)
+    sc.await_ordering_resumes(extra_batches=1, within=30)
+    assert live_roots_agree(sc.honest)
+
+
+def test_one_ahead_straggler_reaffirms_vote_and_pool_converges():
+    """The split-vote deadlock: the primary is mute, one node already
+    ADOPTED the view change to view 1 (its vote consumed), and the two
+    remaining nodes stall at n-f-1 votes forever while the adopted one
+    uselessly votes view 2. The straggler must re-affirm its vote for
+    the PENDING view when it sees peers still gathering, so the pool
+    assembles the quorum and completes the view change."""
+    from plenum_tpu.common.messages.internal_messages import (
+        NeedViewChange, VoteForViewChange)
+    timer, net, nodes, sinks = build_pool(74)
+    sc = Scenario(timer, nodes)
+    submit(nodes, 0, 580)
+    sc.run(5)
+    primary = next(n for n in nodes if n.replica.data.is_primary)
+    others = [n for n in nodes if n is not primary]
+    net.disconnect(primary.name)  # mute: no vote will ever come from it
+    ahead = others[0]
+    # put one node unilaterally INTO the view-1 view change (the state
+    # a node reaches when it counted a quorum the others' caches lost)
+    ahead.replica.internal_bus.send(NeedViewChange(view_no=1))
+    assert ahead.view_no == 1
+    assert ahead.replica.data.waiting_for_new_view
+    sc_live = Scenario(timer, others)
+    sc_live.run(1)
+    # the two behind nodes vote for view 1: 2 of 3 needed — without
+    # the re-affirm this stalls forever
+    for n in others[1:]:
+        n.replica.internal_bus.send(VoteForViewChange(
+            suspicion="TEST_SPLIT", view_no=1))
+    sc_live.run_until(
+        lambda: all(n.view_no == 1
+                    and not n.replica.data.waiting_for_new_view
+                    for n in others),
+        timeout=40, desc="straggler re-affirm completes the view change")
+    # and the pool orders again in the new view
+    submit(others, 1, 581)
+    sc_live.await_ordering_resumes(extra_batches=1, within=30)
+    assert live_roots_agree(others)
+
+
+def test_missed_new_view_absorbed_from_catchup_evidence():
+    """A node that enters the view change with the pool, then misses
+    the NEW_VIEW (disconnected): the pool completes the change and
+    orders new batches. NEW_VIEW is never retransmitted and MessageReq
+    is disabled mid view change, so catchup is the ONLY healing path —
+    the audit evidence (a batch ordered in the awaited view) must
+    complete the pending view change, release the pinned read roots,
+    and return the node to ordering instead of leaving it wedged."""
+    timer, net, nodes, sinks = build_pool(75)
+    sc = Scenario(timer, nodes)
+    submit(nodes, 0, 590)
+    sc.run(5)
+    from plenum_tpu.common.messages.internal_messages import (
+        NeedViewChange)
+    primary = next(n for n in nodes if n.replica.data.is_primary)
+    next_primary_name = nodes[0].replica.view_changer \
+        ._selector.select_master_primary(1)
+    straggler = next(n for n in nodes if n is not primary
+                     and n.name != next_primary_name)
+    # the straggler enters the view change, then drops before any
+    # NEW_VIEW can reach it; the live trio votes and completes the
+    # view change among themselves (n-f = 3 of 4)
+    net.disconnect(straggler.name)
+    straggler.replica.internal_bus.send(NeedViewChange(view_no=1))
+    assert straggler.replica.data.waiting_for_new_view
+    assert straggler.db_manager.reads_degraded, "roots pinned at VC start"
+    live = [n for n in nodes if n is not straggler]
+    for n in live:
+        n.replica.start_view_change()
+    sc_live = Scenario(timer, live)
+    sc_live.run_until(
+        lambda: all(n.view_no == 1
+                    and not n.replica.data.waiting_for_new_view
+                    for n in live),
+        timeout=60, desc="pool completes the VC without the straggler")
+    # the pool orders NEW batches in view 1 — the catchup evidence
+    # (re-ordered old-view batches would NOT count: audit records the
+    # original view)
+    submit(live, 1, 591)
+    sc_live.await_ordering_resumes(extra_batches=1, within=30)
+    assert straggler.replica.data.waiting_for_new_view, "still wedged"
+    net.reconnect(straggler.name)
+    straggler.start_catchup()
+    sc.await_catchup_done(straggler, within=60)
+    assert not straggler.replica.data.waiting_for_new_view, \
+        "pending view change absorbed from audit evidence"
+    assert straggler.view_no >= 1
+    assert not straggler.db_manager.reads_degraded, "pins released"
+    # and the node participates in new ordering again
+    submit(nodes, 2, 592)
+    sc.await_ordering_resumes(extra_batches=1, within=40)
+    sc.run_until(lambda: live_roots_agree(nodes), timeout=30,
+                 desc="roots agree after the straggler rejoins")
+
+
+def test_pool_view_retarget_rearms_new_view_timeout():
+    """Catchup can re-target a pending view change to a HIGHER view
+    (f+1 pool evidence) without audit proof that any view change
+    completed: the running NEW_VIEW timer was scheduled under the old
+    view and its view guard would never match again — it must be
+    re-armed for the adopted view so the node keeps escalating and
+    voting instead of wedging silently with reads still pinned."""
+    from plenum_tpu.common.messages.internal_messages import (
+        NeedViewChange)
+    timer, net, nodes, sinks = build_pool(76)
+    sc = Scenario(timer, nodes)
+    submit(nodes, 0, 600)
+    sc.run(5)
+    node = nodes[0]
+    net.disconnect(node.name)
+    node.replica.internal_bus.send(NeedViewChange(view_no=1))
+    assert node.replica.data.waiting_for_new_view
+    vc = node.replica.view_changer
+    # catchup evidence: pool at view 3, but no batch ordered there yet
+    node._adopt_3pc_from_audit(pool_view=3)
+    assert node.replica.data.view_no == 3
+    assert node.replica.data.waiting_for_new_view, "VC still pending"
+    before = vc.consecutive_failed_view_changes
+    sc_alone = Scenario(timer, [node])
+    sc_alone.run(float(node.config.NEW_VIEW_TIMEOUT) * 2 + 1)
+    assert vc.consecutive_failed_view_changes > before, \
+        "re-armed timeout still fires and escalates at the new view"
+
+
+# ===================================================== catchup faults
+
+
+def test_lying_seeder_convicted_and_catchup_completes():
+    """A seeder garbling reps (with honest-looking audit paths): the
+    leecher rejects each chunk at rep time, convicts the peer, routes
+    around it, and still completes catchup with the honest root."""
+    timer, net, nodes, sinks = build_pool(66)
+    sc = Scenario(timer, nodes)
+    for i in range(3):
+        submit(nodes, i, 530 + i)
+    sc.run(8)
+    assert all(n.domain_ledger.size == 3 for n in nodes)
+    laggard = nodes[3]
+    net.disconnect(laggard.name)
+    live = nodes[:3]
+    sc_live = Scenario(timer, live)
+    submit(live, 3, 533)
+    sc_live.run(6)
+    target = live[0].domain_ledger.size
+    assert target == 4
+
+    adv = AdversaryController(timer, seed=16)
+    adv.set_pool(nodes)
+    liar = live[1]
+    adv.corrupt(liar, LyingCatchupSeeder())
+    net.reconnect(laggard.name)
+    laggard.start_catchup()
+    sc2 = Scenario(timer, nodes, adversary=adv,
+                   honest=[n.name for n in nodes if n is not liar])
+    latency = sc2.measure(
+        lambda: not laggard.leecher.in_progress
+        and laggard.domain_ledger.size == target,
+        within=120, desc="catchup under a lying seeder")
+    sc2.check_slo("catchup_lying_seeder", latency,
+                  Config.RECOVERY_CATCHUP_SLO_S)
+    assert laggard.domain_ledger.root_hash == \
+        live[0].domain_ledger.root_hash
+    assert liar.name in laggard.leecher.bad_peers
+    assert any("lying-seeder" in e for _, e in adv.trace)
+
+
+# ============================================ partition + membership
+
+
+def test_partition_blocks_ordering_and_heal_recovers():
+    """A 2/2 split leaves no side with a quorum — ordering MUST stall
+    (safety before liveness); healing restores ordering and identical
+    roots. One soak round through the Scenario API (the tier-1 variant
+    of the slow partition soak)."""
+    timer, net, nodes, sinks = build_pool(67)
+    adv = AdversaryController(timer, seed=17)
+    adv.set_pool(nodes)
+    sc = Scenario(timer, nodes, adversary=adv,
+                  honest=[n.name for n in nodes])
+    submit(nodes, 0, 540)
+    sc.run(4)
+    assert all(n.domain_ledger.size == 1 for n in nodes)
+    behaviors = adv.partition(nodes[:2], nodes[2:])
+    submit(nodes, 1, 541)
+    sc.run(8)
+    assert all(n.domain_ledger.size == 1 for n in nodes), \
+        "no partition side may order without a quorum"
+
+    def fault(_round):
+        adv.heal_partition(behaviors)
+        return ("heal 2/2 partition",
+                lambda: all(n.domain_ledger.size >= 2 for n in nodes),
+                None)
+
+    results = sc.soak(rounds=1, fault=fault, settle=2.0, within=90,
+                      slo=Config.RECOVERY_FAILOVER_SLO_S,
+                      slo_name="partition_heal")
+    assert len(results) == 1 and results[0]["recovery_s"] > 0
+    assert live_roots_agree(nodes)
+
+
+def test_node_leave_and_rejoin_mid_load_soak_round():
+    """One tier-1 soak round of membership churn: a node drops
+    mid-load, the pool keeps ordering, the node rejoins via catchup
+    (await_catchup_done) and converges."""
+    timer, net, nodes, sinks = build_pool(68)
+    sc = Scenario(timer, nodes)
+    submit(nodes, 0, 550)
+    sc.run(4)
+    churner = nodes[3]
+    live = nodes[:3]
+
+    def fault(_round):
+        net.disconnect(churner.name)
+        submit(live, 1, 551)
+        sc_live = Scenario(timer, live)
+        sc_live.run_until(
+            lambda: all(n.domain_ledger.size >= 2 for n in live),
+            timeout=30, desc="ordering continues without the churner")
+        net.reconnect(churner.name)
+        churner.start_catchup()
+        return ("node left and rejoined mid-load",
+                lambda: not churner.leecher.in_progress
+                and churner.domain_ledger.size ==
+                live[0].domain_ledger.size,
+                None)
+
+    results = sc.soak(rounds=1, fault=fault, settle=3.0, within=90,
+                      slo=Config.RECOVERY_CATCHUP_SLO_S,
+                      slo_name="rejoin")
+    assert len(results) == 1
+    # freshness batches may still be landing on the rejoined node —
+    # converge, then prove it participates in new ordering
+    sc.run_until(lambda: live_roots_agree(nodes), timeout=30,
+                 desc="pool converges after rejoin")
+    submit(nodes, 2, 552)
+    sc.await_ordering_resumes(extra_batches=1, within=30)
+    sc.run_until(lambda: live_roots_agree(nodes), timeout=30,
+                 desc="roots agree after post-rejoin ordering")
+
+
+# ============================================ graceful read degradation
+
+
+def test_reads_serve_pinned_signed_root_during_catchup():
+    """While a node catches up, GET_NYM replies keep serving the last
+    committed (BLS-signed) root instead of unsigned mid-catchup
+    intermediates; after recovery reads move to the live root."""
+    timer, net, nodes, sinks = build_pool(69, bls=True)
+    sc = Scenario(timer, nodes)
+    client = SimpleSigner(seed=b"\x71" * 32)
+    submit_to_all(nodes, signed_nym_request(client, req_id=560))
+    sc.run(6)
+    laggard = nodes[3]
+    signed_root = laggard.write_manager.request_handlers["1"] \
+        .state.committedHeadHash
+    from plenum_tpu.common.serializers.base58 import b58encode
+    assert laggard.bls_bft_replica.bls_store.get(
+        b58encode(signed_root)) is not None, "setup: root is BLS-signed"
+    net.disconnect(laggard.name)
+    live = nodes[:3]
+    sc_live = Scenario(timer, live)
+    client2 = SimpleSigner(seed=b"\x72" * 32)
+    for n in live:
+        n.process_client_request(
+            dict(signed_nym_request(client2, req_id=561)), "c2")
+    sc_live.run(6)
+    assert live[0].domain_ledger.size == 2
+
+    net.reconnect(laggard.name)
+    laggard.start_catchup()
+    assert laggard.db_manager.reads_degraded
+    assert laggard.db_manager.pinned_read_root(DOMAIN_LEDGER_ID) \
+        == signed_root
+    # a read served mid-catchup answers from the pinned signed root
+    sink = sinks[laggard.name]
+    sink.messages.clear()
+    read = {"identifier": client.identifier, "reqId": 9001,
+            "protocolVersion": 2,
+            "operation": {"type": "105", "dest": client.identifier}}
+    laggard.process_client_request(read, "reader")
+    from plenum_tpu.common.messages.node_messages import Reply
+    reply, = sink.of_type(Reply)
+    proof = reply.result["state_proof"]
+    assert proof["root_hash"] == b58encode(signed_root)
+    assert proof.get("multi_signature"), \
+        "degraded reads must stay BLS-verifiable"
+    # recovery unpins: reads move to the live committed root
+    sc.await_catchup_done(laggard, within=60)
+    assert not laggard.db_manager.reads_degraded
+    sink.messages.clear()
+    laggard.process_client_request(dict(read, reqId=9002), "reader")
+    reply2, = sink.of_type(Reply)
+    assert reply2.result["state_proof"]["root_hash"] != \
+        b58encode(signed_root)
+
+
+def test_pin_survives_mid_recovery_repin_and_pending_view_change():
+    """Two pin-lifecycle hazards: (a) a view change starting MID-
+    catchup must not overwrite the pre-recovery signed pin with an
+    unsigned intermediate root; (b) catchup finishing while a view
+    change is still pending must keep the pin until NewViewAccepted."""
+    timer, net, nodes, sinks = build_pool(73)
+    sc = Scenario(timer, nodes)
+    submit(nodes, 0, 570)
+    sc.run(5)
+    node = nodes[0]
+    signed_root = node.db_manager.get_state(DOMAIN_LEDGER_ID) \
+        .committedHeadHash
+    node.start_catchup()
+    assert node.db_manager.pinned_read_root(DOMAIN_LEDGER_ID) \
+        == signed_root
+    # (a) simulate catchup having advanced the committed root, then a
+    # view change re-pinning: the ORIGINAL pin must survive
+    state = node.db_manager.get_state(DOMAIN_LEDGER_ID)
+    state.set(b"mid-catchup-key", b"v")
+    state.commit()
+    assert state.committedHeadHash != signed_root
+    node.db_manager.pin_read_roots()  # what ViewChangeStarted triggers
+    assert node.db_manager.pinned_read_root(DOMAIN_LEDGER_ID) \
+        == signed_root
+    # (b) catchup finishes while waiting_for_new_view: pin persists
+    # (drive the real completion path so in_progress clears first)
+    node.replica.data.waiting_for_new_view = True
+    node.leecher._finish()
+    assert not node.leecher.in_progress
+    assert node.db_manager.reads_degraded
+    # NewViewAccepted with no catchup in flight finally unpins
+    node.replica.data.waiting_for_new_view = False
+    from plenum_tpu.common.messages.internal_messages import (
+        NewViewAccepted)
+    node.replica.internal_bus.send(NewViewAccepted(
+        view_no=1, view_changes=[], checkpoint=None, batches=[]))
+    assert not node.db_manager.reads_degraded
+
+
+# =============================================== SLO artifact contract
+
+
+def test_slo_violation_embeds_latency_in_dump_and_text(tmp_path,
+                                                       monkeypatch):
+    """A violated SLO must be triageable from the artifact alone: the
+    dumped filename and the assertion text both carry the measured
+    latency and the threshold."""
+    monkeypatch.setenv("PLENUM_TPU_TRACE_DIR", str(tmp_path))
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15, TRACING_ENABLED=True,
+                  STATE_FRESHNESS_UPDATE_INTERVAL=3)
+    timer, net, nodes, sinks = build_pool(70, conf=conf)
+    sc = Scenario(timer, nodes)
+    sc.run(1)
+    with pytest.raises(SLOViolation) as exc:
+        sc.check_slo("failover", 12.345, 10.0)
+    text = str(exc.value)
+    assert "12.35s" in text and "10.00s" in text
+    assert "failover" in text
+    dumps = [f for f in os.listdir(str(tmp_path)) if f.endswith(".json")]
+    assert len(dumps) == 1
+    assert "slo_failover_12.35s_gt_10.00s" in dumps[0]
+    assert dumps[0] in text  # the artifact path rides the assertion
+
+
+# ================================================== slow soak variants
+
+
+def _pool(n_nodes, seed, tracing=False):
+    timer = MockTimer()
+    timer.set_time(SIM_EPOCH)
+    net = SimNetwork(timer, DefaultSimRandom(seed),
+                     min_latency=0.001, max_latency=0.01)
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15, ToleratePrimaryDisconnection=4,
+                  NEW_VIEW_TIMEOUT=8, STATE_FRESHNESS_UPDATE_INTERVAL=3,
+                  CATCHUP_TXN_TIMEOUT=2, TRACING_ENABLED=tracing,
+                  HEARTBEAT_FREQ=10 ** 6)
+    names = ["R%02d" % i for i in range(n_nodes)]
+    nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
+             for name in names]
+    return timer, net, nodes
+
+
+def _submit_to(nodes, i, req_id):
+    client = SimpleSigner(seed=bytes([0x20 + i % 90]) * 32)
+    req = signed_nym_request(client, req_id=req_id)
+    for n in nodes:
+        n.process_client_request(dict(req), "soak-client")
+
+
+@pytest.mark.slow
+def test_soak_view_change_storm_25_nodes():
+    """Three consecutive primary crashes on a 25-node pool: every
+    failover measured against the SLO, safety invariants checked every
+    tick throughout."""
+    timer, net, nodes = _pool(25, seed=71)
+    adv = AdversaryController(timer, seed=18)
+    adv.set_pool(nodes)
+    sc = Scenario(timer, nodes, adversary=adv,
+                  honest=[n.name for n in nodes])
+    _submit_to(nodes, 0, 600)
+    sc.run(4)
+
+    def fault(r):
+        # the POOL's primary, not whichever stale node still claims the
+        # role from an old view (a healed ex-primary does until its
+        # catchup adopts the new view)
+        ref = max(nodes, key=lambda n: n.view_no)
+        primary = next(n for n in nodes
+                       if n.name == ref.master_primary_name)
+        sc.honest_names.remove(primary.name)
+        behavior = SilentNode()
+        adv.corrupt(primary, behavior)
+        _submit_to([n for n in nodes if n is not primary], r + 1,
+                   601 + r)
+        honest = sc.honest
+        base_view = max(n.view_no for n in honest)
+        base = {n.name: n.last_ordered[1] for n in honest}
+
+        def recovered():
+            return all(n.view_no >= base_view + 1
+                       and not n.replica.data.waiting_for_new_view
+                       and n.last_ordered[1] > base[n.name]
+                       for n in honest)
+
+        def heal():
+            # a crashed-then-restarted node comes back via catchup
+            # (what _recover_from_storage does on a real restart)
+            adv.release(primary, behavior)
+            primary.start_catchup()
+            sc.honest_names.append(primary.name)
+
+        return ("crash primary %s" % primary.name, recovered, heal)
+
+    results = sc.soak(rounds=3, fault=fault, settle=4.0, within=120,
+                      slo=Config.RECOVERY_FAILOVER_SLO_S,
+                      slo_name="failover_storm")
+    assert len(results) == 3
+    assert sc.checker.checks > 100
+    assert live_roots_agree(sc.honest)
+
+
+@pytest.mark.slow
+def test_soak_catchup_churn_with_lying_seeder():
+    """Repeated catchup rounds on a 7-node pool: the laggard re-syncs
+    under a lying seeder while load continues — completion gated per
+    round against the catchup SLO."""
+    timer, net, nodes = _pool(7, seed=72)
+    adv = AdversaryController(timer, seed=19)
+    adv.set_pool(nodes)
+    liar = nodes[1]
+    adv.corrupt(liar, LyingCatchupSeeder())
+    sc = Scenario(timer, nodes, adversary=adv,
+                  honest=[n.name for n in nodes if n is not liar])
+    _submit_to(nodes, 0, 700)
+    sc.run(4)
+    churner = nodes[-1]
+
+    def fault(r):
+        net.disconnect(churner.name)
+        live = [n for n in nodes if n is not churner]
+        _submit_to(live, r + 1, 701 + r)
+        Scenario(timer, live, adversary=adv,
+                 honest=[n.name for n in live if n is not liar]) \
+            .run(6)
+        net.reconnect(churner.name)
+        churner.start_catchup()
+        target = [n for n in nodes if n is not churner][0]
+
+        def recovered():
+            return (not churner.leecher.in_progress
+                    and churner.domain_ledger.size
+                    == target.domain_ledger.size)
+
+        return ("churn + catchup round %d" % r, recovered, None)
+
+    results = sc.soak(rounds=3, fault=fault, settle=3.0, within=120,
+                      slo=Config.RECOVERY_CATCHUP_SLO_S,
+                      slo_name="catchup_churn")
+    assert len(results) == 3
+    assert churner.domain_ledger.root_hash == \
+        nodes[0].domain_ledger.root_hash
+    assert liar.name in churner.leecher.bad_peers
