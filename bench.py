@@ -440,8 +440,10 @@ def _drive_mp_client(base_dir, reqs, procs):
     return asyncio.run(drive())
 
 
-def run_pool(reqs, verifier_name, tracing=False):
-    """→ (elapsed_wall_seconds, ordered_count) for ordering all reqs.
+def run_pool(reqs, verifier_name, tracing=False, return_nodes=False):
+    """→ (elapsed_wall_seconds, ordered_count) for ordering all reqs
+    (+ the pool's nodes when return_nodes — the traced run hands its
+    ring buffers to the per-stage budget aggregation).
 
     Chunk intake is PIPELINED: chunk i+1's verification is dispatched
     (async device launch / deferred CPU work) before chunk i's consensus
@@ -466,6 +468,8 @@ def run_pool(reqs, verifier_name, tracing=False):
             break
     elapsed = time.perf_counter() - t0
     ordered = min(nd.domain_ledger.size for nd in nodes)
+    if return_nodes:
+        return elapsed, ordered, nodes
     return elapsed, ordered
 
 
@@ -481,10 +485,20 @@ def tracing_overhead():
 
     n = int(os.environ.get("BENCH_TRACE_REQS", str(min(POOL_REQS, 2000))))
     reqs = make_requests(n, SimpleSigner(seed=b"\x52" * 32))
+    from plenum_tpu.observability.budget import budget_from_tracers
+    from plenum_tpu.observability.export import pool_tracers
     off_runs, on_runs = [], []
-    for _ in range(2):
+    traced_nodes = None
+    for i in range(2):
         off_runs.append(run_pool(reqs, "cpu", tracing=False))
-        on_runs.append(run_pool(reqs, "cpu", tracing=True))
+        on_elapsed_i, on_ordered_i, traced_nodes = run_pool(
+            reqs, "cpu", tracing=True, return_nodes=True)
+        on_runs.append((on_elapsed_i, on_ordered_i))
+    # per-stage host-ms budget from the LAST traced run's ring buffers
+    # — the same spans scripts/trace_budget reads from a dump, so a
+    # bench regression and an offline trace point at the same stage
+    budget = budget_from_tracers(pool_tracers(traced_nodes)) \
+        if traced_nodes is not None else None
     off_elapsed, off_ordered = best_of_runs(off_runs, n, "trace-off")
     on_elapsed, on_ordered = best_of_runs(on_runs, n, "trace-on")
     off_rate = off_ordered / off_elapsed
@@ -496,6 +510,11 @@ def tracing_overhead():
         # positive = tracing costs throughput; can come out slightly
         # negative on a noisy box (within run-to-run jitter)
         "overhead_pct": round(100.0 * (1.0 - on_rate / off_rate), 2),
+        # stage-attributable money-path budget (host ms one ordered
+        # request costs one node, by stage)
+        "host_ms_per_ordered_req": (budget or {}).get(
+            "host_ms_per_ordered_req"),
+        "budget_ordered_reqs": (budget or {}).get("ordered_reqs"),
     }
 
 
@@ -917,6 +936,13 @@ def pool25_backlog(provider=None, mesh=True):
     deadline = t0 + wall_budget
     primary = nodes[0]
     ri_state = [0]
+    # (wall_s, min_ordered) samples per chunk: when a run does NOT
+    # drain, honest throughput is ordered/wall over the DRAINED PREFIX
+    # — the window that ends at the last observed ordering progress —
+    # not ordered over the whole wall budget (which silently averages
+    # in any stalled tail and understates a saturated-but-slow pool,
+    # or overstates one that collapsed early)
+    progress = [(0.0, 0)]
 
     def serve_reads():
         # reads answer from any single node, no consensus round
@@ -925,21 +951,79 @@ def pool25_backlog(provider=None, mesh=True):
         for r in rchunk:
             primary.process_client_request(dict(r), "p25-read")
             reads_served[0] += 1
+        progress.append((time.perf_counter() - t0,
+                         min(nd.domain_ledger.size for nd in nodes)))
 
     wchunks = [writes[i:i + batch] for i in range(0, len(writes), batch)]
     pipelined_intake(nodes, timer, wchunks, client_id="p25",
                      deadline=deadline, per_chunk=serve_reads)
     elapsed = time.perf_counter() - t0
     ordered = min(nd.domain_ledger.size for nd in nodes)
+    progress.append((elapsed, ordered))
+    drained = ordered >= len(writes)
+    # drained prefix: the last sample where ordering still advanced
+    prefix_t, prefix_n = elapsed, ordered
+    for (t, n_ord) in reversed(progress):
+        if n_ord < ordered:
+            break
+        prefix_t, prefix_n = t, n_ord
+    rate_window = prefix_t if not drained and prefix_n else elapsed
+    rate_count = prefix_n if not drained else ordered
     return {
         "nodes": n_nodes,
         "backlog": backlog,
         "wall_s": round(elapsed, 1),
         "ordered_writes": ordered,
         "reads_served": reads_served[0],
-        "write_req_per_s": round(ordered / elapsed, 1),
-        "mixed_req_per_s": round((ordered + reads_served[0]) / elapsed, 1),
-        "drained": ordered >= len(writes),
+        "write_req_per_s": round(rate_count / max(1e-9, rate_window), 1),
+        "mixed_req_per_s": round(
+            (rate_count + reads_served[0]) / max(1e-9, rate_window), 1),
+        "drained": drained,
+        # seconds of wall with NO ordering progress at the end of a
+        # partial drain (0.0 on a drained run) — the stall a naive
+        # ordered/wall average would have hidden
+        "stalled_tail_s": round(max(0.0, elapsed - rate_window), 1)
+        if not drained else 0.0,
+    }
+
+
+def merkle_regression_flags(mk):
+    """Non-gating tripwire for the r05 Merkle regression (ROADMAP item
+    3): compare this run's device-vs-CPU hash ratios against the BEST
+    prior recorded bench round (BENCH_r*.json tails in the repo root)
+    and emit warn flags when they drop. Warns, never gates — the Pallas
+    SHA-256 follow-up owns the recovery; until it lands the regression
+    must stay visible in every headline instead of silently becoming
+    the new normal."""
+    import glob
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = {}
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                tail = json.load(f).get("tail", "")
+        except (OSError, ValueError):
+            continue
+        for field in ("vs_hashlib", "vs_cpu_audit_paths"):
+            m = re.search(r'"%s":\s*([0-9.]+)' % field, tail)
+            if m:
+                value = float(m.group(1))
+                if value > best.get(field, (0.0, ""))[0]:
+                    best[field] = (value, os.path.basename(path))
+    warns = []
+    for field in ("vs_hashlib", "vs_cpu_audit_paths"):
+        current = mk.get(field)
+        prior = best.get(field)
+        if current is None or prior is None:
+            continue
+        if current < prior[0]:
+            warns.append("%s %.2f < best prior %.2f (%s)"
+                         % (field, current, prior[0], prior[1]))
+    return {
+        "best_prior": {f: {"value": v, "round": r}
+                       for f, (v, r) in sorted(best.items())},
+        "warn": warns or None,
     }
 
 
@@ -963,8 +1047,15 @@ def pool25_both():
     tpu["cpu_write_req_per_s"] = cpu["write_req_per_s"]
     tpu["cpu_mixed_req_per_s"] = cpu["mixed_req_per_s"]
     tpu["cpu_drained"] = cpu["drained"]
+    tpu["cpu_stalled_tail_s"] = cpu.get("stalled_tail_s", 0.0)
     tpu["vs_cpu"] = round(
         tpu["write_req_per_s"] / max(1e-9, cpu["write_req_per_s"]), 2)
+    # the ratio only compares like with like when BOTH sides finished
+    # the identical workload; a partial CPU drain makes vs_cpu a
+    # sustained-rate comparison over different prefixes — still
+    # reported (both sides now use honest drained-prefix rates), but
+    # flagged so the headline can't read it as a completed-run ratio
+    tpu["vs_cpu_comparable"] = bool(tpu["drained"] and cpu["drained"])
     return tpu
 
 
@@ -1416,6 +1507,7 @@ def main():
     (device_rate, device_rate_median, ed_single_shot, ed_single_shot_med,
      openssl_rate, python_rate, ed_sweep) = micro_ed25519()
     mk = micro_merkle()
+    mk_regression = merkle_regression_flags(mk)
     mesh_res = micro_mesh()
     bls_results = micro_bls()
     state_res = micro_state()
@@ -1459,6 +1551,7 @@ def main():
             },
             "vs_openssl_core": round(device_rate / openssl_rate, 2),
             "merkle": mk,
+            "merkle_regression": mk_regression,
             "mesh": mesh_res,
             "bls": bls_results,
             "state": state_res,
@@ -1480,6 +1573,7 @@ def main():
             "ed25519_per_chip": round(device_rate, 1),
             "merkle_paths_pipelined": mk["audit_paths_pipelined_per_s"],
             "merkle_vs_cpu_audit_paths": mk["vs_cpu_audit_paths"],
+            "merkle_regression": mk_regression["warn"],
             "bls_n100_aggregate": (bls_results.get("by_n", {})
                                    .get("100", {})
                                    .get("aggregate_per_s")),
@@ -1488,7 +1582,17 @@ def main():
             "state_vs_python_apply": state_res["vs_python_apply"],
             "pool25_mixed_req_per_s": p25.get("mixed_req_per_s")
             if isinstance(p25, dict) else None,
+            "pool25_write_req_per_s": p25.get("write_req_per_s")
+            if isinstance(p25, dict) else None,
+            "pool25_drained": p25.get("drained")
+            if isinstance(p25, dict) else None,
+            "pool25_vs_cpu": p25.get("vs_cpu")
+            if isinstance(p25, dict) else None,
+            "pool25_vs_cpu_comparable": p25.get("vs_cpu_comparable")
+            if isinstance(p25, dict) else None,
             "tracing_overhead_pct": tracing["overhead_pct"],
+            "host_ms_per_ordered_req": tracing.get(
+                "host_ms_per_ordered_req"),
             "mesh_devices": mesh_res["devices"],
             "mesh_overhead_pct": mesh_res.get(
                 "single_device_overhead_pct"),

@@ -21,6 +21,8 @@ from plenum_tpu.analysis.rules.pt006_broad_except import (
     BroadExceptOnDevicePathRule)
 from plenum_tpu.analysis.rules.pt007_fixed_retry_timer import (
     FixedRetryTimerRule)
+from plenum_tpu.analysis.rules.pt008_per_item_hot_loop import (
+    PerItemHotLoopRule)
 
 RULE_CLASSES = (
     BlockingCallRule,
@@ -30,6 +32,7 @@ RULE_CLASSES = (
     ConfigLiteralDriftRule,
     BroadExceptOnDevicePathRule,
     FixedRetryTimerRule,
+    PerItemHotLoopRule,
 )
 
 

@@ -159,6 +159,36 @@ class Commit(MessageBase):
     )
 
 
+class ThreePCBatch(MessageBase):
+    """One sender's whole tick of broadcast 3PC votes — PRE-PREPAREs,
+    PREPAREs and COMMITs across ALL of its protocol instances — in ONE
+    wire message (no reference equivalent; the reference sends each vote
+    separately and amortizes only at the ZMQ frame layer). At n nodes
+    with f+1 RBFT instances every 3PC phase is otherwise its own
+    broadcast per instance per in-flight batch; coalescing at the
+    MESSAGE level amortizes serialization (one msgpack pack for the
+    whole batch), transport delivery, and receive-side dispatch — and
+    hands the receiver a COLUMN of same-sender votes for the columnar
+    `process_prepare_batch` / `process_commit_batch` intake.
+
+    `messages` entries are the inner messages' `to_dict()` wire form
+    (op field included) in SEND ORDER — FIFO per sender preserves the
+    PP-before-PREPARE-before-COMMIT causality the per-message wire had.
+    In-process transports (SimNetwork) deliver live MessageBase objects
+    instead; `as_dict` normalizes to wire form only when a real
+    transport serializes the envelope."""
+
+    typename = "THREE_PC_BATCH"
+    schema = (
+        ("messages", IterableField(AnyField(), min_length=1)),
+    )
+
+    def as_dict(self):
+        return {"messages": [
+            m.to_dict() if isinstance(m, MessageBase) else m
+            for m in self.messages]}
+
+
 class Ordered(MessageBase):
     typename = "ORDERED"
     schema = (

@@ -108,6 +108,13 @@ class BlsBftReplica:
         # share may delay a state proof but never suppress it for good.
         # (view_no, pp_seq_no) -> True; values live in _pp_values.
         self._pending_backfill: Dict[tuple, bool] = {}
+        # candidate shares for pending backfills, accumulated ACROSS
+        # retry calls: a view change clears the ordering service's
+        # commit store for the superseded view, so each late COMMIT may
+        # arrive alone — the aggregation quorum is over everything seen.
+        # key -> {sender: Commit} (first share per sender wins, matching
+        # first-verified semantics on the arrival path).
+        self._backfill_commits: Dict[tuple, Dict[str, "Commit"]] = {}
 
     def warm_pool_keys(self, validators) -> None:
         """Front-load the verifier's key-dependent work (G2 subgroup
@@ -208,8 +215,11 @@ class BlsBftReplica:
         (and was counted for consensus) before its PrePrepare was never
         checked, so it is verified now. The aggregate is only persisted
         with a bls_signatures (n-f) quorum of valid shares, so stored
-        proofs always verify."""
-        value = self._pp_values.get((pp.viewNo, pp.ppSeqNo))
+        proofs always verify. `key` is the batch's ORIGINAL
+        (view, seq) — `pp` is unused here (backfill retries after a
+        view change may no longer hold the PrePrepare, only the
+        key)."""
+        value = self._pp_values.get(key)
         if value is None:
             return
         signed = value.as_single_value()
@@ -223,7 +233,7 @@ class BlsBftReplica:
             if pk is None:
                 continue
             checked = self._verified_shares.get(
-                (pp.viewNo, pp.ppSeqNo, sender)) == sig
+                (key[0], key[1], sender)) == sig
             if not checked and not self._defer_share_verify:
                 if not self._verifier.verify_sig(sig, signed, pk):
                     logger.warning(
@@ -260,7 +270,7 @@ class BlsBftReplica:
                     self._pending_backfill.pop(key, None)
                 else:
                     self._pending_backfill[key] = True
-                self._gc(pp.ppSeqNo)
+                self._gc(key[1])
                 return
             keep = []
             for i, (sig, sender, pk) in enumerate(
@@ -283,7 +293,7 @@ class BlsBftReplica:
                 # max(): a backfill retry for an OLD batch must never
                 # REWIND a window armed by later abuse.
                 self._strict_until_seq = max(self._strict_until_seq,
-                                             pp.ppSeqNo + 100)
+                                             key[1] + 100)
                 logger.warning(
                     "%s: deferred BLS share verification abused at %s —"
                     " strict arrival checks until seq %d", self._name,
@@ -301,7 +311,7 @@ class BlsBftReplica:
             value=value)
         self.bls_store.put(multi)
         self._pending_backfill.pop(key, None)
-        self._gc(pp.ppSeqNo)
+        self._gc(key[1])
 
     # ----------------------------------------------------------- backfill
 
@@ -316,20 +326,25 @@ class BlsBftReplica:
         now accumulated. → True once a multi-sig got stored."""
         if key not in self._pending_backfill:
             return False
-        if (pp.viewNo, pp.ppSeqNo) not in self._pp_values:
+        if key not in self._pp_values:
             # value GC'd — the proof window for this batch has passed
             del self._pending_backfill[key]
+            self._backfill_commits.pop(key, None)
             return False
+        pool = self._backfill_commits.setdefault(key, {})
+        for sender, commit in commits.items():
+            if getattr(commit, "blsSig", None) is not None:
+                pool.setdefault(sender, commit)
         candidates = sum(
-            1 for sender, commit in commits.items()
-            if getattr(commit, "blsSig", None) is not None
-            and self._keys.get_key_by_name(sender) is not None)
+            1 for sender in pool
+            if self._keys.get_key_by_name(sender) is not None)
         if quorums is not None \
                 and not quorums.bls_signatures.is_reached(candidates):
             return False    # still short — wait for more late shares
-        self._process_order(key, commits, pp, quorums)
+        self._process_order(key, pool, pp, quorums)
         done = key not in self._pending_backfill
         if done:
+            self._backfill_commits.pop(key, None)
             logger.info("%s: backfilled BLS multi-sig for %s from late "
                         "COMMIT shares", self._name, key)
         return done
@@ -343,3 +358,6 @@ class BlsBftReplica:
         for k in [k for k in self._pending_backfill
                   if k[1] < below_seq - 10]:
             del self._pending_backfill[k]
+        for k in [k for k in self._backfill_commits
+                  if k[1] < below_seq - 10]:
+            del self._backfill_commits[k]

@@ -126,6 +126,10 @@ class ReplicaService:
         """Feed a finalized (quorum-propagated) request digest."""
         self.ordering.add_finalized_request(digest, ledger_id)
 
+    def submit_requests(self, digests, ledger_id: int = 1):
+        """Feed a whole finalized batch in one call (one stash replay)."""
+        self.ordering.add_finalized_requests(digests, ledger_id)
+
     def service(self):
         """One prod tick: send batches if primary."""
         return self.ordering.send_3pc_batch()
@@ -197,6 +201,8 @@ class ReplicaService:
         o.prePrepares.clear()
         o.prepares.clear()
         o.commits.clear()
+        o._prepare_vote_count.clear()
+        o._commit_vote_count.clear()
         o.batches.clear()
         o.ordered.clear()
         o.old_view_preprepares.clear()

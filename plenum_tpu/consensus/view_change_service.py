@@ -27,7 +27,8 @@ from plenum_tpu.common.messages.internal_messages import (
     NeedMasterCatchup, NeedViewChange, NewViewAccepted,
     NewViewCheckpointsApplied, VoteForViewChange, ViewChangeStarted)
 from plenum_tpu.common.messages.node_messages import (
-    Checkpoint, NewView, ViewChange, ViewChangeAck)
+    Checkpoint, MessageRep, MessageReq, NewView, ViewChange,
+    ViewChangeAck)
 from plenum_tpu.common.serializers.serialization import serialize_msg_for_signing
 from plenum_tpu.consensus.batch_id import BatchID, batch_id_from
 from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
@@ -203,12 +204,43 @@ class ViewChangeService:
         # view_no -> frm -> ViewChange
         self._view_changes: Dict[int, Dict[str, ViewChange]] = \
             defaultdict(dict)
+        # future-view VIEW_CHANGE senders (join-on-f+1 evidence)
+        self._future_vc_votes: Dict[int, set] = defaultdict(set)
         # view_no -> (frm, digest) -> set of ack senders
         self._acks: Dict[int, Dict[Tuple[str, str], set]] = \
             defaultdict(lambda: defaultdict(set))
         self._new_view: Optional[NewView] = None
         self._new_view_timer: Optional[RepeatingTimer] = None
         self._resend_timer: Optional[RepeatingTimer] = None
+        # ---- view-change self-heal (MessageReq): a node that loses the
+        # NEW_VIEW (or the referenced VIEW_CHANGEs it needs to validate
+        # one) on a lossy wire has NO retransmission path — the 3PC
+        # MessageReq gap scan is disabled mid view change, and without
+        # re-requests the NEW_VIEW timeout just escalates into a vote
+        # for view+1 that splits the pool further (found by the seeded
+        # loss fuzz once the coalesced wire shifted which messages the
+        # seed drops). While waiting_for_new_view a slow timer re-sends
+        # our own VIEW_CHANGE and re-requests what's missing; peers
+        # answer from their stores.
+        network.subscribe(MessageReq, self.process_message_req)
+        network.subscribe(MessageRep, self.process_message_rep)
+        # solicited-reply guard: (msg_type, view_no, name) -> digest|""
+        self._rep_requested: Dict[Tuple, str] = {}
+        # a NEW_VIEW learned from a MESSAGE_RESPONSE is only trusted if
+        # our own recomputation matches it — on mismatch it is dropped
+        # (not escalated): the answerer, unlike the primary, proved
+        # nothing by sending it
+        self._nv_from_rep = False
+        # staleness latch for a rep-learned NEW_VIEW: a forged one can
+        # reference VIEW_CHANGE digests that exist NOWHERE, so it never
+        # even reaches the recompute gate (the referenced-set quorum in
+        # _finish_view_change stays unreachable) — without an expiry the
+        # victim holds the forgery forever, re-requesting unobtainable
+        # VIEW_CHANGEs instead of the real NEW_VIEW. A rep-learned
+        # NEW_VIEW that fails to complete within one full re-request
+        # period is discarded and the NEW_VIEW re-requested afresh: a
+        # byzantine answer costs one period, not the view.
+        self._nv_rep_stale = False
 
     # ------------------------------------------------------------ trigger
 
@@ -230,6 +262,11 @@ class ViewChangeService:
         self._data.primary_name = self._selector.select_master_primary(
             proposed_view_no)
         self._new_view = None
+        self._nv_from_rep = False
+        self._nv_rep_stale = False
+        for v in [v for v in self._future_vc_votes
+                  if v <= proposed_view_no]:
+            del self._future_vc_votes[v]
         logger.info("%s starting view change %d → %d (new primary %s)",
                     self._data.name, old_view, proposed_view_no,
                     self._data.primary_name)
@@ -313,11 +350,164 @@ class ViewChangeService:
 
         self._new_view_timer = RepeatingTimer(
             self._timer, self.new_view_timeout(), on_timeout)
+        self._resend_timer = RepeatingTimer(
+            self._timer,
+            getattr(self._config, "VIEW_CHANGE_REREQUEST_INTERVAL",
+                    Config.VIEW_CHANGE_REREQUEST_INTERVAL),
+            self._rerequest_missing)
 
     def _cancel_timers(self):
         if self._new_view_timer is not None:
             self._new_view_timer.stop()
             self._new_view_timer = None
+        if self._resend_timer is not None:
+            self._resend_timer.stop()
+            self._resend_timer = None
+        self._rep_requested.clear()
+
+    def _rerequest_missing(self, from_timer: bool = True):
+        """Periodic self-heal while waiting_for_new_view: re-send our
+        own VIEW_CHANGE (peers and the new primary may have lost it)
+        and re-request whatever blocks completion — the NEW_VIEW itself
+        while we hold none, or the referenced VIEW_CHANGE messages we
+        still miss once we do. Only PERIODIC (timer) invocations touch
+        the rep-NEW_VIEW staleness latch: the inline call right after
+        accepting a rep answer must not arm it, or a reply landing just
+        before a timer tick would be discarded moments after it was
+        learned instead of after the documented full period."""
+        if not self._data.waiting_for_new_view:
+            return
+        view_no = self._data.view_no
+        own = self._view_changes[view_no].get(self._data.name)
+        if own is not None:
+            self._network.send(own)
+        inst_id = self._data.inst_id
+        if from_timer and self._new_view is not None \
+                and self._nv_from_rep:
+            if self._nv_rep_stale:
+                # the rep-learned NEW_VIEW survived a full re-request
+                # period without completing — its references may be
+                # fabrications nobody can serve. Discard and start over
+                # from the NEW_VIEW request (honest answers re-land in
+                # one round trip; a liar costs one more period).
+                logger.warning(
+                    "%s rep-learned NEW_VIEW for view %d stalled a full "
+                    "re-request period — discarded, re-requesting",
+                    self._data.name, view_no)
+                self._new_view = None
+                self._nv_from_rep = False
+                self._nv_rep_stale = False
+            else:
+                self._nv_rep_stale = True
+        if self._new_view is None:
+            self._rep_requested[("NEW_VIEW", view_no, "")] = ""
+            self._network.send(MessageReq(
+                msg_type="NEW_VIEW",
+                params={"instId": inst_id, "viewNo": view_no}))
+            return
+        have = self._view_changes[view_no]
+        for frm, digest in {tuple(x) for x in self._new_view.viewChanges}:
+            if frm in have \
+                    and view_change_digest(have[frm]) == digest:
+                continue
+            self._rep_requested[("VIEW_CHANGE", view_no, frm)] = digest
+            self._network.send(MessageReq(
+                msg_type="VIEW_CHANGE",
+                params={"instId": inst_id, "viewNo": view_no,
+                        "name": frm}))
+
+    def process_message_req(self, req: MessageReq, frm: str):
+        """Answer a peer's view-change re-request from our stores. Any
+        node that holds the accepted NEW_VIEW (we keep it after
+        finishing) or the asked-for VIEW_CHANGE can answer — not just
+        the primary."""
+        params = req.params or {}
+        if params.get("instId") != self._data.inst_id:
+            return
+        view_no = params.get("viewNo")
+        if view_no is None:
+            return
+        msg = None
+        if req.msg_type == "NEW_VIEW":
+            # never relay a rep-learned NEW_VIEW that has not passed our
+            # own recomputation yet (_nv_from_rep clears on completion):
+            # serving it would propagate a byzantine answerer's forgery
+            # to every other node still missing the real one
+            if self._new_view is not None \
+                    and self._new_view.viewNo == view_no \
+                    and not self._nv_from_rep:
+                msg = self._new_view.as_dict()
+        elif req.msg_type == "VIEW_CHANGE":
+            vc = self._view_changes.get(view_no, {}).get(
+                params.get("name"))
+            if vc is not None:
+                msg = vc.as_dict()
+        if msg is not None:
+            self._network.send(
+                MessageRep(msg_type=req.msg_type, params=params, msg=msg),
+                [frm])
+
+    def process_message_rep(self, rep: MessageRep, frm: str):
+        """A peer's answer to a view-change re-request. Only solicited
+        replies are accepted, and a VIEW_CHANGE reply only counts when
+        its content digest equals the digest the NEW_VIEW referenced
+        for that node — a fabricated vote cannot match (the digest
+        covers the whole message), so attribution to `name` is safe."""
+        if rep.msg_type not in ("NEW_VIEW", "VIEW_CHANGE") \
+                or rep.msg is None:
+            return
+        params = rep.params or {}
+        if params.get("instId") != self._data.inst_id \
+                or not self._data.waiting_for_new_view:
+            return
+        view_no = params.get("viewNo")
+        if view_no != self._data.view_no:
+            return
+        # only message RECONSTRUCTION and digest validation live inside
+        # the guard — attacker-controlled bytes can raise anything
+        # there. Real processing runs outside it: an internal error in
+        # our own view-change machinery must surface, not be swallowed
+        # and blamed on the answering peer.
+        nv = vc = vc_name = None
+        try:
+            if rep.msg_type == "NEW_VIEW":
+                if ("NEW_VIEW", view_no, "") not in self._rep_requested:
+                    return
+                candidate = NewView(**rep.msg)
+                if candidate.viewNo != view_no \
+                        or self._new_view is not None:
+                    return
+                nv = candidate
+            else:
+                name = params.get("name")
+                digest = self._rep_requested.get(
+                    ("VIEW_CHANGE", view_no, name))
+                if digest is None:
+                    return
+                candidate = ViewChange(**rep.msg)
+                if candidate.viewNo != view_no \
+                        or view_change_digest(candidate) != digest:
+                    return
+                vc, vc_name = candidate, name
+        except Exception as e:   # malformed reply from a byzantine peer
+            logger.warning("%s bad view-change MESSAGE_RESPONSE from "
+                           "%s: %s", self._data.name, frm, e)
+            return
+        if nv is not None:
+            self._new_view = nv
+            self._nv_from_rep = True
+            self._nv_rep_stale = False
+            del self._rep_requested[("NEW_VIEW", view_no, "")]
+            logger.info("%s recovered NEW_VIEW for view %d from %s",
+                        self._data.name, view_no, frm)
+            # pull the referenced VIEW_CHANGEs we miss right away
+            # instead of waiting a whole re-request period (inline call:
+            # must not arm the staleness latch)
+            self._rerequest_missing(from_timer=False)
+        else:
+            del self._rep_requested[("VIEW_CHANGE", view_no, vc_name)]
+            self.process_view_change_message(vc, vc_name)
+        self._try_finish()
 
     # ----------------------------------------------------------- messages
 
@@ -325,6 +515,25 @@ class ViewChangeService:
         if vc.viewNo < self._data.view_no:
             return (DISCARD, "old view change")
         if vc.viewNo > self._data.view_no:
+            # f+1 distinct senders proposing the same higher view carry
+            # at least one honest vote — join them (classic PBFT
+            # liveness: a node whose own INSTANCE_CHANGE quorum never
+            # formed must not ignore a view change the rest of the pool
+            # is visibly running, or it wedges at the old view whenever
+            # ordering resumes below the next checkpoint boundary)
+            self._future_vc_votes[vc.viewNo].add(frm)
+            if self._data.quorums.weak.is_reached(
+                    len(self._future_vc_votes[vc.viewNo])):
+                view_no = vc.viewNo
+                logger.info(
+                    "%s joining view change to %d on f+1 VIEW_CHANGE "
+                    "evidence", self._data.name, view_no)
+                self._bus.send(NeedViewChange(view_no=view_no))
+                if self._data.view_no == view_no:
+                    # adopted: fall through to normal processing (the
+                    # stash replay inside ran before THIS message was
+                    # stashed, so stashing it now would lose the vote)
+                    return self.process_view_change_message(vc, frm)
             return (STASH_FUTURE_VIEW, "future view change")
         self._view_changes[vc.viewNo][frm] = vc
         # ack to the new primary (they may not have received it directly)
@@ -356,6 +565,8 @@ class ViewChangeService:
         if not self._data.waiting_for_new_view:
             return (DISCARD, "not in view change")
         self._new_view = nv
+        self._nv_from_rep = False
+        self._nv_rep_stale = False
         self._try_finish()
         return None
 
@@ -418,6 +629,8 @@ class ViewChangeService:
             batches=[list(b) for b in batches],
         )
         self._new_view = nv
+        self._nv_from_rep = False
+        self._nv_rep_stale = False
         self._network.send(nv)
 
     def _finish_view_change(self, nv: NewView):
@@ -436,6 +649,18 @@ class ViewChangeService:
         if checkpoint != nv.checkpoint or \
                 [list(b) for b in (batches or [])] != \
                 [list(batch_id_from(b)) for b in nv.batches]:
+            if self._nv_from_rep:
+                # a relayed NEW_VIEW that fails our recomputation is
+                # evidence against the ANSWERER, not the primary: drop
+                # it and keep waiting (the primary's direct NEW_VIEW —
+                # or another answer — can still complete this view)
+                logger.warning("%s relayed NEW_VIEW for view %d fails "
+                               "recompute — discarded", self._data.name,
+                               view_no)
+                self._new_view = None
+                self._nv_from_rep = False
+                self._nv_rep_stale = False
+                return
             logger.warning("%s NEW_VIEW mismatch — voting next view",
                            self._data.name)
             if self._mismatch_counted_view != view_no:
@@ -445,6 +670,10 @@ class ViewChangeService:
                 suspicion="NEW_VIEW_MISMATCH", view_no=view_no + 1))
             return
         self._data.waiting_for_new_view = False
+        # the NEW_VIEW just passed our recomputation — wherever it came
+        # from, it is now validated and servable to peers' re-requests
+        self._nv_from_rep = False
+        self._nv_rep_stale = False
         self._cancel_timers()
         # a COMPLETED view change de-escalates: the next one starts
         # from the base NEW_VIEW_TIMEOUT again

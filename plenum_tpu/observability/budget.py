@@ -1,0 +1,169 @@
+"""Per-stage host-millisecond budget from flight-recorder spans.
+
+The columnar 3PC refactor's contract is attributability: every
+host-side millisecond on the ordering money path belongs to a named
+stage, so a throughput regression shows up as ONE stage's budget
+moving, not a vague end-to-end slowdown. This module turns a set of
+recorded spans — either live ``Tracer`` ring buffers or an exported
+Chrome trace document — into ``host-ms per ordered request`` per
+stage:
+
+* ``intake``    — client batch auth dispatch/conclude + read batches
+* ``propagate`` — PROPAGATE flush + quorum bookkeeping
+* ``3pc``       — PRE-PREPARE build/process, columnar prepare/commit
+                  intake, ordering, the per-tick vote flush
+* ``dispatch_wait`` — device seams (fused per-batch window, verifier
+                  hub flush/collect, BLS aggregation)
+* ``execute``   — batch apply/commit MINUS the device window nested
+                  inside it (exclusive time: nested spans are charged
+                  to their own stage exactly once)
+* ``reply``     — reply construction + audit paths
+
+Span time is EXCLUSIVE: a ``fused_dispatch`` nested inside
+``batch_apply`` counts toward ``dispatch_wait``, and only the
+remaining apply time counts toward ``execute`` — stages sum to real
+host time, double counting nothing. Ordered-request volume is taken
+from the master executor's ``batch_apply`` spans (``batch_size``
+arg), the one span family that fires exactly once per applied batch
+per node.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# stage order is the money-path order; reports preserve it
+STAGES = ("intake", "propagate", "3pc", "dispatch_wait", "execute",
+          "reply")
+
+# span names whose category alone would misfile them: the intake auth
+# seams are device dispatches, but they are the INTAKE stage's cost
+_INTAKE_NAMES = frozenset({"auth_dispatch", "auth_conclude",
+                           "read_batch"})
+_CAT_TO_STAGE = {
+    "intake": "intake",
+    "propagate": "propagate",
+    "3pc": "3pc",
+    "device": "dispatch_wait",
+    "bls": "dispatch_wait",
+    "execute": "execute",
+    "reply": "reply",
+}
+
+
+def stage_of(name: str, cat: str) -> Optional[str]:
+    """Stage for one span; None = unbudgeted (recovery, counters)."""
+    if name in _INTAKE_NAMES:
+        return "intake"
+    return _CAT_TO_STAGE.get(cat)
+
+
+def _exclusive_ms(spans: List[Tuple[float, float, str]]) -> Dict[str, float]:
+    """(t0, t1, stage) spans from ONE single-threaded recorder →
+    per-stage EXCLUSIVE milliseconds. Nested spans (device windows
+    inside an apply, batch intakes inside a flush) are charged to their
+    own stage and subtracted from the enclosing span's stage."""
+    out: Dict[str, float] = {s: 0.0 for s in STAGES}
+    # parents sort before their children; among equal starts the longer
+    # span is the parent
+    spans = sorted(spans, key=lambda s: (s[0], -s[1]))
+    stack: List[List] = []   # [t0, t1, stage, child_time]
+    def _close(entry):
+        t0, t1, stage, child = entry
+        if stage is not None:
+            out[stage] += max(0.0, (t1 - t0) - child) * 1e3
+        if stack:
+            stack[-1][3] += t1 - t0
+    for t0, t1, stage in spans:
+        while stack and t0 >= stack[-1][1]:
+            _close(stack.pop())
+        stack.append([t0, t1, stage, 0.0])
+    while stack:
+        _close(stack.pop())
+    return out
+
+
+def budget_from_tracers(tracers: Iterable) -> dict:
+    """Live ``Tracer`` buffers (one per node) → the budget report (see
+    :func:`_report`)."""
+    per_node: List[Dict[str, float]] = []
+    ordered: List[int] = []
+    for tracer in tracers:
+        if tracer is None:
+            continue
+        spans, n_ordered = [], 0
+        for kind, name, cat, t0, t1, key, args in tracer.spans():
+            if kind != "X":
+                continue
+            spans.append((t0, t1, stage_of(name, cat)))
+            if name == "batch_apply" and args:
+                n_ordered += int(args.get("batch_size", 0))
+        if spans:
+            per_node.append(_exclusive_ms(spans))
+            ordered.append(n_ordered)
+    return _report(per_node, ordered)
+
+
+def budget_from_chrome(doc: dict) -> dict:
+    """Exported Chrome trace document (``trace_view`` / scenario
+    dumps) → the budget report. Timestamps are microseconds."""
+    by_pid: Dict[int, List[Tuple[float, float, Optional[str]]]] = {}
+    ordered_by_pid: Dict[int, int] = {}
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid", 0)
+        t0 = e.get("ts", 0) * 1e-6
+        t1 = t0 + e.get("dur", 0) * 1e-6
+        name = e.get("name", "")
+        by_pid.setdefault(pid, []).append(
+            (t0, t1, stage_of(name, e.get("cat", ""))))
+        if name == "batch_apply":
+            ordered_by_pid[pid] = ordered_by_pid.get(pid, 0) + \
+                int((e.get("args") or {}).get("batch_size", 0))
+    per_node = [_exclusive_ms(spans) for spans in by_pid.values()]
+    ordered = [ordered_by_pid.get(pid, 0) for pid in by_pid]
+    return _report(per_node, ordered)
+
+
+def _report(per_node: List[Dict[str, float]], ordered: List[int]) -> dict:
+    """Merge per-node stage totals into the budget report:
+
+    * ``ordered_reqs`` — requests applied (max across nodes: every
+      node applies every batch, stragglers just show fewer),
+    * ``stage_ms_per_node`` — average total host-ms per stage per node,
+    * ``host_ms_per_ordered_req`` — per-stage average host-ms one
+      ordered request costs ONE node, plus ``total``.
+    """
+    n_nodes = len(per_node)
+    n_ordered = max(ordered) if ordered else 0
+    totals = {s: sum(node[s] for node in per_node) for s in STAGES} \
+        if per_node else {s: 0.0 for s in STAGES}
+    avg = {s: totals[s] / n_nodes for s in STAGES} if n_nodes else totals
+    per_req = {s: (avg[s] / n_ordered if n_ordered else 0.0)
+               for s in STAGES}
+    per_req["total"] = sum(per_req[s] for s in STAGES)
+    return {
+        "nodes": n_nodes,
+        "ordered_reqs": n_ordered,
+        "stage_ms_per_node": {s: round(avg[s], 2) for s in STAGES},
+        "host_ms_per_ordered_req": {
+            s: round(v, 4) for s, v in per_req.items()},
+    }
+
+
+def format_table(report: dict) -> str:
+    """Human-readable per-stage table (the ``trace_budget`` CLI)."""
+    lines = ["%-14s %14s %18s %6s" % (
+        "stage", "host-ms/node", "ms/ordered-req", "share")]
+    per_req = report["host_ms_per_ordered_req"]
+    total = per_req.get("total") or 0.0
+    for stage in STAGES:
+        share = (per_req[stage] / total * 100.0) if total else 0.0
+        lines.append("%-14s %14.2f %18.4f %5.1f%%" % (
+            stage, report["stage_ms_per_node"][stage], per_req[stage],
+            share))
+    lines.append("%-14s %14s %18.4f" % (
+        "total", "", total))
+    lines.append("nodes=%d ordered_reqs=%d" % (
+        report["nodes"], report["ordered_reqs"]))
+    return "\n".join(lines)

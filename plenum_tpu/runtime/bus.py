@@ -8,6 +8,30 @@ send handler is the transport (or the SimNetwork in tests).
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Type
 
 
+def _unwrap_three_pc_batch(message) -> Optional[list]:
+    """Inner votes of a coalesced THREE_PC_BATCH envelope, or None when
+    `message` is not one. Lazy import: the runtime layer must stay
+    importable without the message schema module loaded. Dict entries
+    (a real-transport envelope) are reconstructed through the message
+    factory so the tap always sees typed votes; an unreconstructable
+    entry is dropped here exactly as the node's own intake would drop
+    it."""
+    from plenum_tpu.common.messages.node_messages import ThreePCBatch
+    if not isinstance(message, ThreePCBatch):
+        return None
+    from plenum_tpu.common.messages.message_factory import (
+        node_message_factory)
+    out = []
+    for entry in message.messages:
+        if isinstance(entry, dict):
+            try:
+                entry = node_message_factory.get_instance(**entry)
+            except Exception:
+                continue
+        out.append(entry)
+    return out
+
+
 class Router:
     """Maps message type → list of handlers; dispatch is synchronous."""
 
@@ -72,6 +96,14 @@ class ExternalBus(Router):
     def clear_tap(self) -> None:
         self._tap = None
 
+    @property
+    def has_tap(self) -> bool:
+        """True while a fault-injection tap is installed — coalescing
+        senders (ThreePCOutbox) fall back to per-message sends so the
+        tap keeps seeing the per-type wire granularity its behaviors
+        match on."""
+        return self._tap is not None
+
     def send(self, message: Any, dst=None) -> None:
         """dst None = broadcast; str = single peer; list = multiple peers."""
         if self._tap is not None:
@@ -90,6 +122,17 @@ class ExternalBus(Router):
     def process_incoming(self, message: Any, frm: str):
         if self._tap is not None and not isinstance(
                 message, (self.Connected, self.Disconnected)):
+            # coalesced 3PC envelopes from honest (untapped) senders
+            # unwrap BEFORE the tap: behaviors match on per-type 3PC
+            # votes, and an envelope passed through whole would smuggle
+            # every inner vote past them — the receive-side mirror of
+            # the ThreePCOutbox per-message degrade on the send side
+            inner = _unwrap_three_pc_batch(message)
+            if inner is not None:
+                result = None
+                for entry in inner:
+                    result = self.process_incoming(entry, frm)
+                return result
             routed = self._tap.on_incoming(message, frm)
             if routed is not None:
                 result = None

@@ -133,6 +133,26 @@ class StashingRouter:
     def discard(self, message, reason):
         pass  # subclass/metric hook
 
+    # ------------------------------------------------- batch-intake seams
+
+    def stash(self, code: int, message, *args):
+        """Stash one message directly under `code` WITHOUT running its
+        handler first — the columnar 3PC intake decides whole-batch
+        verdicts up front and routes the must-wait items here; replay
+        goes through the normal subscribed per-message handler."""
+        handler = self._handlers.get(type(message))
+        self._stash(code, handler, message, *args)
+
+    def route(self, message, *args) -> bool:
+        """Run the subscribed handler for `message` with full verdict
+        processing (stash/discard), exactly as a bus delivery would —
+        used by batch intake paths to feed individual messages through
+        the same machinery as singles. → True if processed/discarded."""
+        handler = self._handlers.get(type(message))
+        if handler is None:
+            return True
+        return self._process(handler, message, *args)
+
     def stash_size(self, code: int = None) -> int:
         return sum(len(s) for (t, c), s in self._stashes.items()
                    if code is None or c == code)

@@ -14,7 +14,8 @@ from plenum_tpu.common.constants import AUDIT_LEDGER_ID
 from plenum_tpu.common.messages.node_messages import Ordered
 from plenum_tpu.common.request import Request
 from plenum_tpu.consensus.ordering_service import BatchExecutor
-from plenum_tpu.observability.tracing import CAT_EXECUTE, NullTracer
+from plenum_tpu.observability.tracing import (
+    CAT_DEVICE, CAT_EXECUTE, NullTracer)
 from plenum_tpu.server.three_pc_batch import ThreePcBatch
 from plenum_tpu.server.write_request_manager import WriteRequestManager
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
@@ -30,7 +31,9 @@ class NodeBatchExecutor(BatchExecutor):
                  get_pp_seq_no: Callable[[], int] = None,
                  on_batch_committed: Callable = None,
                  on_request_rejected: Callable[[str, str, int],
-                                               None] = None):
+                                               None] = None,
+                 fused_dispatch: bool = True,
+                 device_kick: Callable[[], None] = None):
         """requests_source(digest) → Request (the propagator's store).
         get_pp_seq_no() → seq of the batch being applied NOW (the
         ordering service's apply position + 1) — must survive catchup
@@ -50,6 +53,14 @@ class NodeBatchExecutor(BatchExecutor):
         self._on_batch_committed = on_batch_committed
         self._on_request_rejected = on_request_rejected or \
             (lambda d, r, s: None)
+        # fused per-3PC-batch device dispatch (Config.FUSED_BATCH_
+        # DISPATCH): the batch's ledger leaf-hash launch, a verifier-hub
+        # kick, and the MPT pending-apply share ONE overlapped device
+        # window per applied batch instead of serialized round trips.
+        # device_kick() flushes whatever verify generation is queued
+        # (CoalescingVerifierHub) into that same window.
+        self._fused = fused_dispatch
+        self._device_kick = device_kick
         # staged batches by apply order (mirrors write manager staging)
         self._staged: List[ThreePcBatch] = []
 
@@ -109,13 +120,37 @@ class NodeBatchExecutor(BatchExecutor):
                 seq_base[handler_lid] + len(group) + 1)
             group.append(txn)
             valid.append(digest)
-        for lid, txns in staged.items():
-            self.db.get_ledger(lid).appendTxns(txns)
+        if self._fused and staged:
+            # FUSED per-batch device window: launch every ledger group's
+            # leaf-hash dispatch, kick the verifier hub's queued
+            # generation into the same window, run the MPT pending-apply
+            # (the state head read flushes the batch's buffered writes
+            # through the device trie engine) WHILE those launches are
+            # in flight, then collect the staged hashes — one overlapped
+            # round trip where the per-message path serialized them.
+            # Results are bit-identical: the three streams touch
+            # disjoint structures and each collect point is unchanged.
+            with self.tracer.span(
+                    "fused_dispatch", CAT_DEVICE, key=pp_digest or None,
+                    groups=len(staged), batch_size=len(valid)):
+                in_flight = [
+                    (lid, self.db.get_ledger(lid).stage_txns_dispatch(
+                        txns))
+                    for lid, txns in staged.items()]
+                if self._device_kick is not None:
+                    self._device_kick()
+                state_root = ledger.hashToStr(state.headHash) \
+                    if state else ""
+                for lid, handle in in_flight:
+                    self.db.get_ledger(lid).stage_txns_collect(handle)
+        else:
+            for lid, txns in staged.items():
+                self.db.get_ledger(lid).appendTxns(txns)
+            state_root = ledger.hashToStr(state.headHash) if state else ""
         if self._get_pp_seq_no is not None:
             self._pp_seq_no = self._get_pp_seq_no()
         else:
             self._pp_seq_no += 1
-        state_root = ledger.hashToStr(state.headHash) if state else ""
         txn_root = ledger.hashToStr(ledger.uncommitted_root_hash)
         view_no = self._get_view_no()
         ov = original_view_no if original_view_no is not None else view_no

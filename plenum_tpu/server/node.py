@@ -26,9 +26,10 @@ from plenum_tpu.common.constants import (
     NODE, NYM, POOL_LEDGER_ID, VERKEY)
 from plenum_tpu.common.exceptions import InvalidClientMessageException
 from plenum_tpu.common.messages.client_request import ClientMessageValidator
+from plenum_tpu.common.messages.message_factory import node_message_factory
 from plenum_tpu.common.messages.node_messages import (
-    Ordered, Propagate, PropagateBatch, Reject, Reply, RequestAck,
-    RequestNack)
+    Commit, Ordered, Prepare, PrePrepare, Propagate, PropagateBatch,
+    Reject, Reply, RequestAck, RequestNack, ThreePCBatch)
 from plenum_tpu.common.request import Request
 from plenum_tpu.common.txn_util import (
     get_payload_data, get_seq_no, get_txn_time)
@@ -276,7 +277,13 @@ class Node:
             get_pp_seq_no=lambda:
                 self.replica.ordering._last_applied_seq + 1,
             on_batch_committed=self._on_batch_committed,
-            on_request_rejected=self._on_request_rejected)
+            on_request_rejected=self._on_request_rejected,
+            fused_dispatch=getattr(self.config, "FUSED_BATCH_DISPATCH",
+                                   True),
+            # the authnr's verifier may have a whole intake generation
+            # queued — flush it into the fused window so the device
+            # verifies while the host applies
+            device_kick=lambda: self.authnr.flush())
         # ---- freshness: stale ledgers get empty batches so BLS-signed
         # state roots never age past the timeout (reference
         # replica_freshness_checker.py)
@@ -333,6 +340,21 @@ class Node:
             on_backup_ordered=self._on_backup_ordered,
             on_backup_pp_sent=self.last_sent_pp_store.store_last_sent)
 
+        # ---- columnar 3PC wire path: every instance's broadcast votes
+        # coalesce into ONE THREE_PC_BATCH per tick (flushed at the end
+        # of service()); inbound envelopes route into the columnar
+        # process_*_batch intake per instance. Incoming batches are
+        # always understood (peers may coalesce regardless of our own
+        # sending config).
+        from plenum_tpu.server.three_pc_outbox import ThreePCOutbox
+        self._outbox_3pc = None
+        self._outbox_flush_armed = False
+        if getattr(self.config, "THREE_PC_BATCH_WIRE", True):
+            self._outbox_3pc = ThreePCOutbox(
+                network, msg_len_limit=self.config.MSG_LEN_LIMIT)
+            self.replicas.set_outbox(self._outbox_3pc)
+        network.subscribe(ThreePCBatch, self._process_three_pc_batch)
+
         # ---- propagation
         # gate for peer-relayed requests (client-intake requests were
         # authenticated at intake): a node must not vote for content
@@ -353,7 +375,8 @@ class Node:
         self.propagator = Propagator(
             name, self.replica.data.quorums, network,
             forward_handler=self._forward_finalised,
-            authenticator=authenticate_propagated)
+            authenticator=authenticate_propagated,
+            forward_batch_handler=self._forward_finalised_batch)
         network.subscribe(Propagate, self.propagator.process_propagate)
         network.subscribe(PropagateBatch,
                           self.propagator.process_propagate_batch)
@@ -409,6 +432,7 @@ class Node:
         # after construction below)
         for _traced in (self.propagator, self.executor, self.replica,
                         self.replica.ordering, bls_bft_replica,
+                        self._outbox_3pc,
                         getattr(self.replica, "view_changer", None)):
             if _traced is not None:
                 _traced.tracer = self.tracer
@@ -542,7 +566,24 @@ class Node:
                                     network.Disconnected)) \
                     and self.blacklister.is_blacklisted(frm):
                 return None
-            return orig_incoming(msg, frm)
+            result = orig_incoming(msg, frm)
+            # votes provoked by inbound deliveries (PREPAREs for landed
+            # PPs, COMMITs on fresh quorums) accumulate in the outbox
+            # until the next prod tick's flush in service(). Flushing
+            # per delivery here was measured to defeat coalescing
+            # entirely: each instance's PP arrives from a DIFFERENT
+            # primary node, so every provoked vote shipped alone (18
+            # singles per node per 3PC round at 25 validators, 0
+            # envelopes). The deferred flush below only covers the
+            # pathological case of deliveries arriving while the prod
+            # loop is starved — votes never wait past one timer turn.
+            if self._outbox_3pc is not None and len(self._outbox_3pc) \
+                    and not self._outbox_flush_armed:
+                self._outbox_flush_armed = True
+                self.timer.schedule(
+                    getattr(self.config, "THREE_PC_FLUSH_WINDOW", 0.002),
+                    self._deferred_outbox_flush)
+            return result
         network.process_incoming = filtering_incoming
         self.mode_participating = True
 
@@ -1093,6 +1134,81 @@ class Node:
             lid = DOMAIN_LEDGER_ID
         self.replicas.submit_request(request.key, lid)
 
+    def _forward_finalised_batch(self, requests: List[Request]):
+        """A whole propagate batch finalised at once: digests stay one
+        contiguous column per ledger into every instance's proposal
+        queue (one stash-replay per instance per batch, not per
+        request)."""
+        by_ledger: Dict[int, List[str]] = {}
+        type_to_lid = self.write_manager.type_to_ledger_id
+        for request in requests:
+            lid = type_to_lid(request.txn_type)
+            if lid is None:
+                lid = DOMAIN_LEDGER_ID
+            by_ledger.setdefault(lid, []).append(request.key)
+        for lid, digests in by_ledger.items():
+            self.replicas.submit_requests(digests, lid)
+
+    def _deferred_outbox_flush(self):
+        """Timer-armed flush covering votes provoked by deliveries:
+        armed on the FIRST provoked vote and fired one
+        THREE_PC_FLUSH_WINDOW later, so a burst of deliveries jittered
+        across a few ms (per-message wire latency draws) accumulates
+        into ONE envelope of everything it provoked — without the
+        window every provoked vote shipped alone, because each
+        instance's PP arrives from a different primary at a different
+        instant. A few ms of extra vote latency is invisible next to
+        consensus timeouts, and the prod-tick flush in service() still
+        bounds the wait when the timer is starved."""
+        self._outbox_flush_armed = False
+        if self._outbox_3pc is not None:
+            self._outbox_3pc.flush()
+
+    def _process_three_pc_batch(self, msg: ThreePCBatch, frm: str):
+        """Inbound coalesced 3PC envelope: reconstruct wire entries,
+        split by protocol instance, and feed each instance's columnar
+        intake — PRE-PREPAREs first, then PREPAREs, then COMMITs (a
+        sender's envelope is FIFO, and no sender emits a vote before
+        its own earlier-phase vote for the same key, so phase-major
+        processing preserves per-sender causality)."""
+        groups: Dict[int, Tuple[list, list, list]] = {}
+        for entry in msg.messages:
+            if isinstance(entry, dict):
+                try:
+                    entry = node_message_factory.get_instance(**entry)
+                except Exception as e:
+                    logger.warning(
+                        "%s: bad entry in THREE_PC_BATCH from %s: %s",
+                        self.name, frm, e)
+                    continue
+            if isinstance(entry, PrePrepare):
+                idx = 0
+            elif isinstance(entry, Prepare):
+                idx = 1
+            elif isinstance(entry, Commit):
+                idx = 2
+            else:
+                logger.warning(
+                    "%s: non-3PC entry %s in THREE_PC_BATCH from %s "
+                    "— dropped", self.name, type(entry).__name__, frm)
+                continue
+            inst_id = entry.instId
+            group = groups.get(inst_id)
+            if group is None:
+                group = groups[inst_id] = ([], [], [])
+            group[idx].append(entry)
+        for inst_id, (pps, prepares, commits) in groups.items():
+            replica = self.replicas.get(inst_id)
+            if replica is None:
+                continue   # fewer instances here than at the sender
+            ordering = replica.ordering
+            if pps:
+                ordering.process_preprepare_batch(pps, frm)
+            if prepares:
+                ordering.process_prepare_batch(prepares, frm)
+            if commits:
+                ordering.process_commit_batch(commits, frm)
+
     def _get_finalised_request(self, digest: str) -> Optional[Request]:
         state = self.propagator.requests.get(digest)
         return state.request if state else None
@@ -1360,7 +1476,13 @@ class Node:
             # propagates queued this tick (intake + batch echoes) leave
             # as ONE PROPAGATE_BATCH before consensus work runs
             self.propagator.flush()
-            return self.replicas.service()
+            count = self.replicas.service()
+            # every instance's 3PC votes queued this tick (from
+            # send_3pc_batch above AND from inbound processing since the
+            # last tick) leave as ONE THREE_PC_BATCH
+            if self._outbox_3pc is not None:
+                self._outbox_3pc.flush()
+            return count
 
     # ------------------------------------------------------- inspection
 

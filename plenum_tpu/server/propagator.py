@@ -169,7 +169,8 @@ class Propagator:
 
     def __init__(self, name: str, quorums: Quorums, network,
                  forward_handler: Callable[[Request], None],
-                 authenticator: Callable[[Request], bool] = None):
+                 authenticator: Callable[[Request], bool] = None,
+                 forward_batch_handler: Callable[[list], None] = None):
         """network: ExternalBus; forward_handler: called exactly once per
         finalised request (feeds ordering queues). authenticator(request)
         → bool gates requests FIRST LEARNED from a peer's PROPAGATE: a
@@ -177,11 +178,16 @@ class Propagator:
         authenticate — otherwise a single byzantine relay plus the
         honest echo reaches the f+1 quorum with a forged payload (found
         by the TamperedPropagate adversary scenario). Requests from the
-        client intake path were authenticated there already."""
+        client intake path were authenticated there already.
+        forward_batch_handler(requests): optional columnar forward — all
+        requests finalised by ONE inbound PROPAGATE_BATCH go to the
+        ordering queues as one contiguous digest column (one downstream
+        stash-replay per batch instead of per request)."""
         self.name = name
         self.quorums = quorums
         self._network = network
         self._forward = forward_handler
+        self._forward_batch = forward_batch_handler
         self._authenticator = authenticator
         self.requests = Requests()
         self.metrics = NullMetricsCollector()   # node injects the real one
@@ -268,10 +274,22 @@ class Propagator:
                 "clients — discarded", self.name, frm,
                 len(msg.requests), len(clients))
             return
+        if self._forward_batch is None:
+            for payload, client in zip(msg.requests, clients):
+                self._process_one(payload, client or None, frm)
+            return
+        # columnar finalisation: requests that reach quorum inside this
+        # batch collect into one forward call — their digests stay a
+        # contiguous column all the way into the ordering queues
+        finalised: list = []
         for payload, client in zip(msg.requests, clients):
-            self._process_one(payload, client or None, frm)
+            self._process_one(payload, client or None, frm,
+                              finalise_sink=finalised)
+        if finalised:
+            self._forward_batch([s.request for s in finalised])
 
-    def _process_one(self, payload: dict, sender_client, frm: str):
+    def _process_one(self, payload: dict, sender_client, frm: str,
+                     finalise_sink=None):
         # ONE state lookup per propagate: at n validators this handler
         # runs (n-1) times per request per node — every extra dict hop
         # or digest-property access in here is multiplied by that
@@ -310,7 +328,7 @@ class Propagator:
             self._queue_out(payload, sender_client)
         if not state.forwarded and \
                 self.quorums.propagate.is_reached(len(propagates)):
-            self._finalise(state)
+            self._finalise(state, finalise_sink)
 
     def _try_finalise(self, req_key: str):
         state = self.requests.get(req_key)
@@ -319,13 +337,18 @@ class Propagator:
         if self.quorums.propagate.is_reached(len(state.propagates)):
             self._finalise(state)
 
-    def _finalise(self, state: ReqState):
+    def _finalise(self, state: ReqState, sink=None):
         """Quorum reached: mark, record the lifecycle marker, forward
         exactly once. The digest access is free here — forwarding hands
-        request.key to the ordering queues anyway."""
+        request.key to the ordering queues anyway. With a `sink` the
+        caller owns forwarding (batch path: one columnar forward per
+        inbound PROPAGATE_BATCH)."""
         state.finalised = True
         state.forwarded = True
         self.tracer.instant("propagate_quorum", CAT_PROPAGATE,
                             key=state.request.key,
                             votes=len(state.propagates))
-        self._forward(state.request)
+        if sink is not None:
+            sink.append(state)
+        else:
+            self._forward(state.request)

@@ -53,6 +53,7 @@ class Replicas:
         self._on_backup_ordered = on_backup_ordered or (lambda o: None)
         self._on_backup_pp_sent = on_backup_pp_sent
         self._suspicion_handlers: List[Callable] = []
+        self._outbox = None
         self._replicas: Dict[int, ReplicaService] = {0: master}
         master.internal_bus.subscribe(NewViewAccepted,
                                       self._on_master_new_view)
@@ -108,6 +109,7 @@ class Replicas:
             RaisedSuspicion)
         for handler in self._suspicion_handlers:
             replica.internal_bus.subscribe(RaisedSuspicion, handler)
+        replica.ordering.outbox = self._outbox
         self._replicas[inst_id] = replica
         logger.info("%s: added backup instance %d (primary %s)",
                     self._node_name, inst_id, replica.data.primary_name)
@@ -135,9 +137,29 @@ class Replicas:
 
     # --------------------------------------------------------- fan-out
 
+    def set_outbox(self, outbox) -> None:
+        """Attach one node-wide coalescing 3PC outbox to every protocol
+        instance — current AND future backups (all instances' broadcast
+        votes ride the same per-tick THREE_PC_BATCH)."""
+        self._outbox = outbox
+        for replica in self._replicas.values():
+            replica.ordering.outbox = outbox
+
+    def get(self, inst_id: int) -> Optional[ReplicaService]:
+        """Instance by id, None when this node runs fewer instances than
+        the sender (membership skew) — batch routing drops those."""
+        return self._replicas.get(inst_id)
+
     def submit_request(self, digest: str, ledger_id: int = 1):
         for replica in self._replicas.values():
             replica.submit_request(digest, ledger_id)
+
+    def submit_requests(self, digests, ledger_id: int = 1):
+        """One finalized propagate batch into every instance's proposal
+        queue — the stash replay inside runs once per (instance, batch)
+        instead of once per (instance, request)."""
+        for replica in self._replicas.values():
+            replica.submit_requests(digests, ledger_id)
 
     def service(self) -> int:
         return sum(r.service() for r in list(self._replicas.values()))

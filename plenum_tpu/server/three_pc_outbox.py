@@ -1,0 +1,113 @@
+"""ThreePCOutbox — per-node coalescing of broadcast 3PC votes.
+
+One node broadcasts a PRE-PREPARE (primary), a PREPARE and a COMMIT per
+in-flight batch PER PROTOCOL INSTANCE (f+1 RBFT instances); before this
+every vote was its own ExternalBus send — its own transport delivery and
+its own receive-side handler dispatch on every peer. The outbox collects
+every instance's broadcast votes during a prod tick and flushes them as
+ONE `ThreePCBatch` wire message (one msgpack pack on the socket path,
+one SimNetwork delivery per peer in tests), which the receiving node
+routes into the columnar `process_*_batch` intake.
+
+Correctness notes:
+
+* FIFO send order is preserved inside the envelope — a sender enqueues
+  PRE-PREPARE before its own PREPARE before its own COMMIT, so per-
+  sender causality on the wire is identical to the per-message path.
+* Only BROADCAST sends coalesce (3PC votes are always broadcast);
+  directed messages (OldViewPrePrepareReply, MessageRep, ...) never
+  enter the outbox.
+* While a fault-injection tap is installed on the bus
+  (testing/adversary), flush degrades to per-message sends: the
+  adversary behaviors match and rewrite individual Prepare/Commit/
+  PrePrepare messages, and hiding them inside an envelope would blind
+  the fault injector — per-message wire granularity IS the seam there.
+* Batches are chunked under a serialized-size budget so a full tick of
+  votes can never build a frame the transport would drop wholesale
+  (same rule as Propagator.BATCH_SIZE_BUDGET).
+"""
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from plenum_tpu.common.messages.node_messages import (
+    Commit, PrePrepare, ThreePCBatch)
+from plenum_tpu.observability.tracing import CAT_3PC, NullTracer
+
+logger = logging.getLogger(__name__)
+
+# conservative serialized-size estimates per vote type (bytes): roots +
+# digests dominate a PREPARE; a PRE-PREPARE adds ~72 wire bytes per
+# request digest (see OrderingService's frame clamp, which bounds the
+# reqIdr contribution a single PP can carry)
+_PREPARE_EST = 640
+_COMMIT_EST = 384
+_PP_BASE_EST = 1024
+_PP_PER_DIGEST_EST = 72
+
+
+def _estimate(msg) -> int:
+    if isinstance(msg, PrePrepare):
+        return _PP_BASE_EST + _PP_PER_DIGEST_EST * len(msg.reqIdr)
+    if isinstance(msg, Commit):
+        return _COMMIT_EST
+    return _PREPARE_EST
+
+
+class ThreePCOutbox:
+    # entry-count cap per envelope; the size budget is the real guard
+    BATCH_LIMIT = 300
+
+    def __init__(self, network, msg_len_limit: int = 128 * 1024):
+        self._network = network
+        # generous envelope/AEAD headroom, like the propagator's budget
+        self._size_budget = msg_len_limit - 8 * 1024
+        self._out: List = []
+        self.tracer = NullTracer()   # node injects the real one
+        self.flushed_batches = 0
+        self.flushed_msgs = 0
+
+    def queue(self, msg) -> None:
+        """Collect one broadcast 3PC vote for the next flush."""
+        self._out.append(msg)
+
+    def __len__(self) -> int:
+        return len(self._out)
+
+    def flush(self) -> int:
+        """Ship everything queued since the last flush. → votes sent."""
+        if not self._out:
+            return 0
+        out, self._out = self._out, []
+        with self.tracer.span("three_pc_flush", CAT_3PC, n=len(out)):
+            self._flush(out)
+        self.flushed_msgs += len(out)
+        return len(out)
+
+    def _flush(self, out: List) -> None:
+        send = self._network.send
+        if getattr(self._network, "has_tap", False):
+            # fault injection installed: keep per-message granularity
+            for m in out:
+                send(m)
+            return
+        if len(out) == 1:
+            send(out[0])
+            return
+        chunk, chunk_size = [], 0
+        for m in out:
+            size = _estimate(m)
+            if chunk and (len(chunk) >= self.BATCH_LIMIT
+                          or chunk_size + size > self._size_budget):
+                send(ThreePCBatch(messages=chunk))
+                self.flushed_batches += 1
+                chunk, chunk_size = [], 0
+            chunk.append(m)
+            chunk_size += size
+        if chunk:
+            if len(chunk) == 1:
+                send(chunk[0])
+            else:
+                send(ThreePCBatch(messages=chunk))
+                self.flushed_batches += 1

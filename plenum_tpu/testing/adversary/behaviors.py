@@ -16,8 +16,8 @@ import logging
 from typing import List, Optional, Tuple
 
 from plenum_tpu.common.messages.node_messages import (
-    CatchupRep, Commit, ConsistencyProof, NewView, PrePrepare, Prepare,
-    Propagate, PropagateBatch)
+    CatchupRep, Commit, ConsistencyProof, MessageRep, NewView,
+    PrePrepare, Prepare, Propagate, PropagateBatch)
 
 logger = logging.getLogger(__name__)
 
@@ -304,6 +304,22 @@ class EquivocatingNewView(Behavior):
         return NewView(**params)
 
     def on_send(self, msg, dst):
+        # a NEW_VIEW answer to a peer's re-request (MessageRep) is the
+        # same message on a different path — a byzantine primary lies
+        # there too, or the self-heal re-request would fetch the honest
+        # NEW_VIEW straight out of the liar's own store
+        if isinstance(msg, MessageRep) and msg.msg_type == "NEW_VIEW" \
+                and msg.msg is not None:
+            if self._mode == "stale":
+                # swallowing is the stale liar's reply-path analogue:
+                # `_last` already holds the CURRENT honest NEW_VIEW, so
+                # replaying it here would heal the victims
+                self.record("NEW_VIEW rep swallowed")
+                return []
+            forged = self._forge(NewView(**msg.msg))
+            self.record("NEW_VIEW rep forged")
+            return [(MessageRep(msg_type=msg.msg_type, params=msg.params,
+                                msg=forged.as_dict()), dst)]
         if not isinstance(msg, NewView):
             return None
         if self._mode == "stale":

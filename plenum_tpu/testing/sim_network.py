@@ -106,6 +106,13 @@ class Delay(Processor):
         return True
 
 
+def _is_three_pc_batch(message) -> bool:
+    # local import: the sim network must stay importable without the
+    # full message schema module loaded first
+    from plenum_tpu.common.messages.node_messages import ThreePCBatch
+    return isinstance(message, ThreePCBatch)
+
+
 class SimNetwork:
     def __init__(self, timer: MockTimer, random: Optional[SimRandom] = None,
                  serialize_deserialize: Callable[[Any], Any] = None,
@@ -203,15 +210,25 @@ class SimNetwork:
                 dsts = [dst]
             else:
                 dsts = list(dst)
+            # fault injection needs per-message wire granularity: while
+            # processors are installed, coalesced 3PC envelopes unwrap
+            # into their constituent votes so drop/delay/stash/tap
+            # filters (and per-message latency draws) behave exactly as
+            # on the legacy per-message wire. Uninstrumented pools keep
+            # the envelope whole — one delivery per peer per flush.
+            messages = [message]
+            if self.processors and _is_three_pc_batch(message):
+                messages = list(message.messages)
             for d in dsts:
                 if d == frm or d in self._down or frm in self._down:
                     continue
-                self.sent_count += 1
-                msg = PendingMessage(message, frm, d)
-                if self.processors and any(p.process(msg)
-                                           for p in self.processors):
-                    continue
-                self._schedule_delivery(msg)
+                for entry in messages:
+                    self.sent_count += 1
+                    msg = PendingMessage(entry, frm, d)
+                    if self.processors and any(p.process(msg)
+                                               for p in self.processors):
+                        continue
+                    self._schedule_delivery(msg)
         return handle
 
     def _schedule_delivery(self, msg: PendingMessage, extra: float = 0.0):

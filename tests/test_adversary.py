@@ -495,7 +495,13 @@ def test_view_change_during_catchup_with_flaky_replies():
     sc2 = Scenario(timer, nodes, adversary=adv)
     sc2.run_until(
         lambda: sleeper.domain_ledger.size == target
-        and sleeper.view_no == 1, 40, "sleeper caught up + adopted view")
+        and sleeper.view_no == 1
+        # the audit ledger trails the domain ledger during resume (the
+        # pool keeps ordering freshness batches while the sleeper's
+        # catchup drags through the delayed replies) — "caught up"
+        # means the audit tip converged too, not just the domain txns
+        and sleeper.audit_ledger.size == live[0].audit_ledger.size,
+        40, "sleeper caught up + adopted view")
     assert sleeper.master_primary_name == live[0].master_primary_name
     assert live_roots_agree(nodes)
     # and the rejoined node participates in new ordering (run_until on

@@ -114,17 +114,27 @@ def test_freshness_monitor_votes_vc_when_primary_shirks(pool):
     sending freshness batches gets voted out: block its PrePrepares so
     state signatures go stale, and the pool moves to view 1 (reference
     freshness_monitor_service.py)."""
-    from plenum_tpu.common.messages.node_messages import PrePrepare
+    from plenum_tpu.common.messages.node_messages import (
+        PrePrepare, ThreePCBatch)
     nodes, timer = pool
     primary = nodes[0].master_primary_name
     # the primary's PRE-PREPAREs vanish at every receiver: no batches
-    # ordered, so no freshness updates — but the primary stays connected
+    # ordered, so no freshness updates — but the primary stays connected.
+    # Votes ride coalesced THREE_PC_BATCH envelopes on the default wire,
+    # so the filter strips PrePrepares INSIDE the primary's envelopes too
     for n in nodes:
         orig = n.network.process_incoming
 
         def dropping(msg, frm, orig=orig):
-            if isinstance(msg, PrePrepare) and frm == primary:
-                return None
+            if frm == primary:
+                if isinstance(msg, PrePrepare):
+                    return None
+                if isinstance(msg, ThreePCBatch):
+                    kept = [m for m in msg.messages
+                            if not isinstance(m, PrePrepare)]
+                    if not kept:
+                        return None
+                    msg = ThreePCBatch(messages=kept)
             return orig(msg, frm)
         n.network.process_incoming = dropping
     # stale threshold = 3 * FRESHNESS = 90s; give it time to trip + VC

@@ -462,6 +462,63 @@ def test_pt007_out_of_scope_paths():
     assert rule.applies("plenum_tpu/client/client.py")
 
 
+# --------------------------------------------------------------- PT008
+
+# the PR-8 incident shape: _has_prepared re-counting the sender dict on
+# every inbound PREPARE (O(n) per message, O(n^2) per batch per node)
+PT008_BAD = """
+    class OrderingService:
+        def _has_prepared(self, key):
+            count = len([s for s in self.prepares[key]
+                         if s != self._data.primary_name])
+            return self._data.quorums.prepare.is_reached(count)
+
+        def process_commit(self, commit, frm):
+            for sender in self.commits[(commit.viewNo,
+                                        commit.ppSeqNo)].items():
+                self._check(sender)
+"""
+
+PT008_GOOD = """
+    class OrderingService:
+        def _has_prepared(self, key):
+            # incremental counter maintained at vote insert: one dict
+            # read per quorum check
+            return self._data.quorums.prepare.is_reached(
+                self._prepare_vote_count.get(key, 0))
+
+        def process_prepare_batch(self, prepares, frm):
+            # ONE loop per inbound wire batch is the columnar design,
+            # not the quadratic shape — batch handlers are exempt
+            for p in prepares:
+                self._add_prepare_vote((p.viewNo, p.ppSeqNo), frm, p)
+
+        def _gc_below(self, seq):
+            # non-handler housekeeping may walk the stores
+            for key in [k for k in self.commits if k[1] <= seq]:
+                del self.commits[key]
+"""
+
+
+def test_pt008_fires_on_per_item_loops_in_hot_handlers():
+    findings = check_snippet(rule_by_code("PT008"), PT008_BAD,
+                             "plenum_tpu/consensus/ordering2.py")
+    assert len(findings) == 2
+    assert all("columnar" in f.message for f in findings)
+
+
+def test_pt008_clean_on_counters_batch_handlers_and_housekeeping():
+    assert check_snippet(rule_by_code("PT008"), PT008_GOOD,
+                         "plenum_tpu/consensus/ordering2.py") == []
+
+
+def test_pt008_out_of_scope_paths():
+    rule = rule_by_code("PT008")
+    assert rule.applies("plenum_tpu/consensus/ordering_service.py")
+    assert not rule.applies("plenum_tpu/server/propagator.py")
+    assert not rule.applies("plenum_tpu/testing/sim_network.py")
+
+
 # -------------------------------------------------------------- pragmas
 
 def test_inline_pragma_suppresses_one_line():

@@ -278,3 +278,93 @@ def test_scenario_without_tracing_raises_plain(mock_timer):
     with pytest.raises(AssertionError) as exc:
         scenario.run(5.0)
     assert "flight recorder" not in str(exc.value)
+
+
+# ------------------------------------------------- per-stage budget
+
+def _manual_tracer(name="Alpha"):
+    """Tracer with a controllable clock for deterministic spans."""
+    t = [0.0]
+
+    def clock():
+        return t[0]
+    tracer = Tracer(name, clock=clock)
+    return tracer, t
+
+
+def _span(tracer, t, name, cat, t0, t1, **args):
+    t[0] = t0
+    ctx = tracer.span(name, cat, **args)
+    ctx.__enter__()
+    t[0] = t1
+    ctx.__exit__(None, None, None)
+
+
+def test_budget_exclusive_time_and_per_request_math():
+    """A device window nested inside an apply is charged to
+    dispatch_wait ONLY; stages sum to real host time."""
+    from plenum_tpu.observability.budget import budget_from_tracers
+    tracer, t = _manual_tracer()
+    # 100ms apply containing a 40ms fused device window
+    _span(tracer, t, "fused_dispatch", "device", 0.02, 0.06)
+    _span(tracer, t, "batch_apply", "execute", 0.0, 0.1,
+          batch_size=10)
+    # 10ms of columnar intake + 5ms reply
+    _span(tracer, t, "prepare_batch", "3pc", 0.2, 0.21)
+    _span(tracer, t, "reply", "reply", 0.3, 0.305)
+    # intake seam is device-cat but belongs to the intake stage
+    _span(tracer, t, "auth_dispatch", "device", 0.4, 0.42)
+    report = budget_from_tracers([tracer])
+    assert report["ordered_reqs"] == 10
+    ms = report["stage_ms_per_node"]
+    assert ms["execute"] == pytest.approx(60.0, abs=0.1)
+    assert ms["dispatch_wait"] == pytest.approx(40.0, abs=0.1)
+    assert ms["3pc"] == pytest.approx(10.0, abs=0.1)
+    assert ms["reply"] == pytest.approx(5.0, abs=0.1)
+    assert ms["intake"] == pytest.approx(20.0, abs=0.1)
+    per_req = report["host_ms_per_ordered_req"]
+    assert per_req["execute"] == pytest.approx(6.0, abs=0.01)
+    assert per_req["total"] == pytest.approx(13.5, abs=0.01)
+
+
+def test_budget_from_chrome_matches_live_tracers(tdir):
+    """The exported-file path (scripts/trace_budget) and the live
+    path (bench.py) agree on the same spans."""
+    from plenum_tpu.observability.budget import (
+        budget_from_chrome, budget_from_tracers)
+    tracer, t = _manual_tracer()
+    _span(tracer, t, "fused_dispatch", "device", 0.01, 0.02)
+    _span(tracer, t, "batch_apply", "execute", 0.0, 0.05, batch_size=4)
+    _span(tracer, t, "commit_batch", "3pc", 0.1, 0.12)
+    live = budget_from_tracers([tracer])
+    doc = chrome_trace([tracer])
+    from_file = budget_from_chrome(doc)
+    assert from_file == live
+
+
+def test_trace_budget_cli(tdir):
+    """scripts/trace_budget on an exported dump: table mode, --json
+    mode, and the metrics_stats missing-file convention."""
+    import subprocess
+    import sys as _sys
+    tracer, t = _manual_tracer()
+    _span(tracer, t, "batch_apply", "execute", 0.0, 0.05, batch_size=4)
+    path = export_chrome_trace([tracer], os.path.join(tdir, "t.json"))
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "trace_budget")
+    out = subprocess.run([_sys.executable, script, path],
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "execute" in out.stdout and "ordered_reqs=4" in out.stdout
+    outj = subprocess.run([_sys.executable, script, path, "--json"],
+                          capture_output=True, text=True)
+    assert outj.returncode == 0
+    report = json.loads(outj.stdout)
+    assert report["ordered_reqs"] == 4
+    assert report["host_ms_per_ordered_req"]["execute"] > 0
+    # missing file: clean exit with a message (metrics_stats convention)
+    miss = subprocess.run(
+        [_sys.executable, script, os.path.join(tdir, "nope.json"),
+         "--json"], capture_output=True, text=True)
+    assert miss.returncode == 0
+    assert "error" in json.loads(miss.stdout)

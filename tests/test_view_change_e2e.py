@@ -215,6 +215,71 @@ def test_audit_primaries_delta_resolution(pool):
         assert handler.primaries_at(seq) == first
 
 
+def test_forged_new_view_rep_cannot_wedge_or_propagate(pool):
+    """A byzantine answer to the NEW_VIEW re-request referencing
+    VIEW_CHANGE digests that exist NOWHERE never reaches the recompute
+    gate (the referenced-set quorum stays unreachable), so without an
+    expiry the victim would hold the forgery forever, re-requesting
+    unobtainable VIEW_CHANGEs instead of the real NEW_VIEW — and serve
+    the forgery onward to other nodes' re-requests. The staleness
+    latch bounds the damage to ONE re-request period, and an
+    unvalidated rep-learned NEW_VIEW is never relayed."""
+    from plenum_tpu.common.messages.node_messages import (
+        MessageReq, NewView)
+    nodes, sinks, net, timer = pool
+    victim = nodes[3]
+    # the victim loses the NEW_VIEW (and any honest rep answers) —
+    # the exact lossy-wire case the self-heal exists for
+    blocker = Discard(DefaultSimRandom(0), probability=1.1,
+                      dst=[victim.name],
+                      message_types=[NewView, MessageRep])
+    net.add_processor(blocker)
+    for n in nodes:
+        n.replica.start_view_change()
+    pump(timer, nodes, 12)
+    live = nodes[:3]
+    for n in live:
+        assert n.view_no == 1
+        assert not n.replica.data.waiting_for_new_view
+    vc = victim.replica.view_changer
+    assert vc._data.waiting_for_new_view
+    # byzantine answer to the pending re-request: a NEW_VIEW whose
+    # referenced VIEW_CHANGEs exist nowhere
+    vc._rep_requested[("NEW_VIEW", 1, "")] = ""
+    forged = NewView(viewNo=1,
+                     viewChanges=[["Mallory", "00" * 16]],
+                     checkpoint=None, batches=[])
+    vc.process_message_rep(
+        MessageRep(msg_type="NEW_VIEW",
+                   params={"instId": 0, "viewNo": 1},
+                   msg=forged.as_dict()), "Gamma")
+    assert vc._new_view is not None and vc._nv_from_rep
+    # the unvalidated forgery is never served to peers' re-requests
+    served = []
+    orig_send = vc._network.send
+    vc._network.send = lambda m, dst=None: served.append(m)
+    try:
+        vc.process_message_req(
+            MessageReq(msg_type="NEW_VIEW",
+                       params={"instId": 0, "viewNo": 1}), "Alpha")
+    finally:
+        vc._network.send = orig_send
+    assert not any(isinstance(m, MessageRep) for m in served)
+    # heal: one full re-request period discards the stalled forgery,
+    # the fresh NEW_VIEW request reaches honest completed nodes, and
+    # their (validated) answer passes the victim's recomputation
+    net.remove_processor(blocker)
+    pump(timer, nodes, 15)
+    assert victim.view_no == 1
+    assert not victim.replica.data.waiting_for_new_view
+    # and the healed node still orders with the pool
+    c = SimpleSigner(seed=b"\x79" * 32)
+    submit_to_all(nodes, signed_nym_request(c, req_id=990))
+    pump(timer, nodes, 10)
+    assert all(n.domain_ledger.size >= 1 for n in nodes)
+    assert live_roots_agree(nodes)
+
+
 def test_rejoining_old_primary_catches_up(pool):
     """The killed primary reconnects, sees it is behind, catches up via
     the leecher, and resumes participating in the new view."""
