@@ -753,6 +753,36 @@ def micro_merkle(n_leaves=None):
     floor_tree.extend_hashes(app_hashes)  # level-wise host bulk extend
     append_bulk_host_per_s = app_b / (time.perf_counter() - t0)
 
+    # ---- dispatches per append, counted from flight-recorder spans:
+    # the multi-level fusion gate (ROADMAP item 3 acceptance — one
+    # append on a 1M-leaf tree pays 1 + ceil(levels/K) device
+    # dispatches instead of 1 + levels; a 1M-leaf incremental build is
+    # n_leaves/app_b of these, so the per-append ratio IS the
+    # per-build ratio)
+    from plenum_tpu.common.config import Config as _Cfg
+    from plenum_tpu.observability.tracing import Tracer
+    tr = Tracer("bench_merkle")
+    inc.attach_tracer(tr)
+
+    def append_dispatch_spans(k):
+        prior_k = _Cfg.MERKLE_FUSED_LEVELS
+        _Cfg.MERKLE_FUSED_LEVELS = k
+        try:
+            # reset to the identical tree state for both K values: the
+            # level count an append touches depends on the leaf offset,
+            # so counting on a mutating tree would skew the ratio
+            inc.build_from_leaf_hashes(base)
+            tr.clear()
+            inc.append_leaf_hashes(app)
+            return sum(1 for r in tr.spans()
+                       if r[1] == "merkle_append_dispatch")
+        finally:
+            _Cfg.MERKLE_FUSED_LEVELS = prior_k
+
+    disp_fused = append_dispatch_spans(_Cfg.MERKLE_FUSED_LEVELS)
+    disp_unfused = append_dispatch_spans(1)
+    inc.attach_tracer(None)
+
     return {
         "leaves": n_leaves,
         "build_leaves_per_s": round(device_leaves_per_s, 1),
@@ -783,6 +813,11 @@ def micro_merkle(n_leaves=None):
             "device_leaves_per_s_median": round(append_rate_median, 1),
             "host_bulk_leaves_per_s": round(append_bulk_host_per_s, 1),
             "host_scalar_leaves_per_s": round(append_scalar_per_s, 1),
+            "fused_levels": _Cfg.MERKLE_FUSED_LEVELS,
+            "dispatches_per_append_fused": disp_fused,
+            "dispatches_per_append_unfused": disp_unfused,
+            "dispatch_reduction": round(
+                disp_unfused / max(1, disp_fused), 2),
         },
     }
 
@@ -987,14 +1022,42 @@ def pool25_backlog(provider=None, mesh=True):
     }
 
 
+# the hard floor for the device-vs-host merkle ratios: the device path
+# must never lose to the host floors it exists to beat (ROADMAP item 3
+# acceptance; merkle_regression_gate)
+MERKLE_RATIO_FLOOR = 1.0
+
+
+def merkle_regression_gate(mk, floor=None):
+    """HARD headline gate for the merkle hash race: vs_hashlib and
+    vs_cpu_audit_paths must hold at or above MERKLE_RATIO_FLOOR.
+    Returns the list of failures; main() records them in the headline
+    and exits nonzero, so the r03→r05 shape of regression (ratios
+    quietly sliding under 1.0 while a warn flag scrolled past) cannot
+    ship again. BENCH_MERKLE_GATE=warn downgrades to warn-only for
+    diagnostic runs on known-degraded hosts — the headline still
+    records the failures. Pure function of the micro_merkle dict, so
+    tier-1 gates the gate itself (tests/test_bench_gate.py) without
+    running a bench."""
+    floor = MERKLE_RATIO_FLOOR if floor is None else floor
+    failures = []
+    for field in ("vs_hashlib", "vs_cpu_audit_paths"):
+        value = mk.get(field)
+        if value is None:
+            failures.append("%s missing from micro_merkle" % field)
+        elif value < floor:
+            failures.append("%s %.2f < required %.2f"
+                            % (field, value, floor))
+    return failures
+
+
 def merkle_regression_flags(mk):
-    """Non-gating tripwire for the r05 Merkle regression (ROADMAP item
-    3): compare this run's device-vs-CPU hash ratios against the BEST
+    """Best-prior tripwire for the merkle ratios (ROADMAP item 3):
+    compare this run's device-vs-CPU hash ratios against the BEST
     prior recorded bench round (BENCH_r*.json tails in the repo root)
-    and emit warn flags when they drop. Warns, never gates — the Pallas
-    SHA-256 follow-up owns the recovery; until it lands the regression
-    must stay visible in every headline instead of silently becoming
-    the new normal."""
+    and emit warn flags when they drop. This half stays warn-only
+    (containers vary round to round); the absolute 1.0 floor is
+    merkle_regression_gate and hard-fails the headline."""
     import glob
     import re
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1259,7 +1322,7 @@ def micro_mesh():
     batch = min(MICRO_BATCH, 8192)
     msgs, sigs, vks = make_signed_batch(batch, seed=11, unique=256,
                                         msg_prefix=b"mesh")
-    prior = (m.enabled, m.shard_min, m.max_devices)
+    prior = (m.enabled, m.shard_min, m.max_devices, m.cpu_shard)
     try:
         # passthrough (mesh consulted, gate declines) vs mesh disabled:
         # interleaved best-of so box-load drift hits both sides
@@ -1293,8 +1356,12 @@ def micro_mesh():
             sweep = {}
             d = 1
             while d <= n_dev_all:
+                # cpu_shard: the sweep exists to measure the SHARDED
+                # dispatch path; on a virtual-CPU-device host the
+                # production gate would silently turn every point into
+                # the same passthrough
                 mesh_mod.configure(enabled=True, max_devices=d,
-                                   shard_min=1)
+                                   shard_min=1, cpu_shard=True)
                 m.reset_devices()
                 n = per_dev * d
                 sm, ss, sv = wm[:n], ws[:n], wv[:n]
@@ -1320,7 +1387,7 @@ def micro_mesh():
             out["weak_scaling"] = sweep
     finally:
         mesh_mod.configure(enabled=prior[0], shard_min=prior[1],
-                           max_devices=prior[2])
+                           max_devices=prior[2], cpu_shard=prior[3])
         m.reset_devices()
     return out
 
@@ -1508,6 +1575,7 @@ def main():
      openssl_rate, python_rate, ed_sweep) = micro_ed25519()
     mk = micro_merkle()
     mk_regression = merkle_regression_flags(mk)
+    mk_gate_failures = merkle_regression_gate(mk)
     mesh_res = micro_mesh()
     bls_results = micro_bls()
     state_res = micro_state()
@@ -1572,8 +1640,13 @@ def main():
             "sim_pool_tpu": round(tpu_rate, 1),
             "ed25519_per_chip": round(device_rate, 1),
             "merkle_paths_pipelined": mk["audit_paths_pipelined_per_s"],
+            "merkle_vs_hashlib": mk["vs_hashlib"],
             "merkle_vs_cpu_audit_paths": mk["vs_cpu_audit_paths"],
+            "merkle_dispatch_reduction": mk["incremental_append"][
+                "dispatch_reduction"],
             "merkle_regression": mk_regression["warn"],
+            "merkle_gate_ok": not mk_gate_failures,
+            "merkle_gate_failures": mk_gate_failures or None,
             "bls_n100_aggregate": (bls_results.get("by_n", {})
                                    .get("100", {})
                                    .get("aggregate_per_s")),
@@ -1603,6 +1676,13 @@ def main():
             "recovery_slo_ok": recovery.get("slo_ok"),
         }
     }, separators=(",", ":")))
+    # HARD merkle regression gate — after the headline print so the
+    # numbers always survive the driver's stdout truncation, but a
+    # failed gate still fails the run (merkle_regression_gate)
+    if mk_gate_failures and os.environ.get("BENCH_MERKLE_GATE") != "warn":
+        print("MERKLE REGRESSION GATE FAILED: "
+              + "; ".join(mk_gate_failures), file=sys.stderr)
+        sys.exit(2)
 
 
 if __name__ == "__main__":

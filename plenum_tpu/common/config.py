@@ -89,6 +89,20 @@ class Config:
     # ---- merkle hashing (TreeHasher TPU seam, ledger/tree_hasher.py)
     SHA256_BACKEND = "jax"       # "jax" (batched device kernel) | "scalar"
     SHA256_BATCH_THRESHOLD = 512  # below this, hashlib wins on latency
+    # CPU-backend cache tiling for the XLA SHA-256 expression
+    # (ops/sha256.py): without tiling every one of the ~1600 u32 ops
+    # per compression materializes a batch-wide temp that overflows
+    # L2, making the kernel memory-bound (~2.4x measured recovery at
+    # this tile). Batches below 2 tiles run untiled.
+    SHA256_CPU_TILE = 4096
+    # batch rows at which the Pallas SHA-256 kernel takes over from
+    # the XLA lowering on accelerators (one kernel block = 1024 rows)
+    SHA256_PALLAS_MIN_BATCH = 1024
+    # fused multi-level tree append (ops/merkle.py): hash K tree
+    # levels per device dispatch (pair in-kernel between levels),
+    # cutting dispatches-per-append from O(log n) to O(log n / K).
+    # 1 = the PR-2 level-at-a-time behavior (kept for A/B tests).
+    MERKLE_FUSED_LEVELS = 4
 
     # ---- device merkle proof engine (ops/merkle.py + ledger routing):
     # large reply-proof / catchup-proof batches are served from the
@@ -239,6 +253,14 @@ class Config:
     MESH_ENABLED = True
     MESH_MAX_DEVICES = 0         # 0 = all devices (rounded down to 2^k)
     MESH_SHARD_MIN = 2048        # below this one chip wins on latency
+    # shard over a multi-device CPU backend too. XLA's virtual CPU
+    # "devices" (xla_force_host_platform_device_count) share the same
+    # physical cores, so sharding over them is pure partition overhead
+    # (measured ~5x SLOWER on 1M-leaf merkle builds) — production
+    # keeps this off; tests / dryrun_multichip force it (env
+    # PLENUM_TPU_MESH_CPU_SHARD=1 or configure(cpu_shard=True)) to
+    # exercise the sharded code paths without TPU hardware.
+    MESH_CPU_SHARD = False
 
     # ---- device circuit breaker (utils/device_breaker.py, shared by
     # the merkle + MPT engine seams): after max_failures consecutive

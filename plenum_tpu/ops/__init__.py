@@ -7,6 +7,8 @@ behind pluggable provider seams (SURVEY.md §2.9).
 """
 import os
 
+import numpy as np
+
 
 def pow2_at_least(n: int) -> int:
     """Smallest power of two >= n — the shared bucket-rounding rule for
@@ -15,6 +17,35 @@ def pow2_at_least(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+def scatter_ragged_rows(msgs, width: int):
+    """Scatter variable-length messages into a zero-filled
+    ``[len(msgs), width]`` uint8 buffer with ONE flat vectorized
+    scatter — the shared core of the mixed-length host padding in
+    ``ops/sha256.pad_messages`` and ``ops/sha3.pad_sha3_messages``
+    (a per-message Python loop was the host bottleneck for large
+    mixed batches in both).
+
+    Returns ``(out, lens)``: the row buffer and the per-message byte
+    lengths as int64 — each hash pads its own domain/length markers on
+    top (SHA-2: 0x80 + 64-bit big-endian bit length; SHA-3: 0x06 +
+    final-byte 0x80 XOR).
+    """
+    n = len(msgs)
+    out = np.zeros((n, width), dtype=np.uint8)
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n)
+    joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    if joined.shape[0]:
+        flat = out.reshape(-1)
+        starts = np.zeros(n, dtype=np.int64)
+        np.cumsum(lens[:-1], out=starts[1:])
+        rows = np.arange(n, dtype=np.int64)
+        dst = np.repeat(rows * width, lens) \
+            + (np.arange(joined.shape[0], dtype=np.int64)
+               - np.repeat(starts, lens))
+        flat[dst] = joined
+    return out, lens
 
 
 def enable_persistent_compilation_cache(path: str = None) -> str:

@@ -695,23 +695,17 @@ def verify_batch_async(msgs: Sequence[bytes], sigs: Sequence[bytes],
 # filling a block on a TPU — ~2x the XLA expression at every block
 # count, see _dispatch_kernel; the XLA kernel otherwise (smaller
 # batches, CPU tests, or any Pallas failure → permanent fallback).
-_PALLAS_STATE = {"enabled": None}
+_ED25519_PALLAS_ENV = "PLENUM_TPU_ED25519_BACKEND"
 
 
 def _pallas_available() -> bool:
-    state = _PALLAS_STATE["enabled"]
-    if state is None:
-        import os
-        if os.environ.get("PLENUM_TPU_ED25519_BACKEND") == "xla":
-            state = False
-        else:
-            # ONE lazy, exception-guarded capability probe for the whole
-            # package (ops/mesh.py) — probing jax.devices()[0] here
-            # would force backend init and assume device 0
-            from plenum_tpu.ops import mesh as mesh_mod
-            state = mesh_mod.is_accelerator()
-        _PALLAS_STATE["enabled"] = state
-    return state
+    # ONE shared probe-backed availability gate for every Pallas
+    # kernel family (ops/mesh.pallas_backend_enabled) — probing
+    # jax.devices()[0] here would force backend init and assume
+    # device 0, and a private cache would escape dryrun_multichip's
+    # probe reset
+    from plenum_tpu.ops import mesh as mesh_mod
+    return mesh_mod.pallas_backend_enabled(_ED25519_PALLAS_ENV)
 
 
 _PALLAS_VALIDATED = set()      # grid sizes whose execution has completed
@@ -756,5 +750,6 @@ def _dispatch_kernel(ay, asign, ry, rsign, s_words, k_words):
                     edp.BLOCK_R)
                 continue
             logger.exception("pallas verify failed; falling back to XLA")
-            _PALLAS_STATE["enabled"] = False
+            from plenum_tpu.ops import mesh as mesh_mod
+            mesh_mod.disable_pallas_backend(_ED25519_PALLAS_ENV)
     return _verify_kernel(ay, asign, ry, rsign, s_words, k_words)

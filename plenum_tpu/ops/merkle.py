@@ -9,9 +9,17 @@ module keeps the WHOLE tree on device and serves production shapes:
    derived on device (node blocks are packed from digest pairs with pure
    uint32 shifts — no host byte juggling).
  - `append_leaf_hashes` is the incremental path: device-resident level
-   tails grow by ~2b hashes for b appended leaves (one small dispatch
-   per level) instead of a full rebuild — complete RFC 6962 nodes are
-   immutable, so an append only ever writes NEW rows.
+   tails grow by ~2b hashes for b appended leaves instead of a full
+   rebuild — complete RFC 6962 nodes are immutable, so an append only
+   ever writes NEW rows. Levels are hashed in FUSED groups of
+   Config.MERKLE_FUSED_LEVELS per dispatch (hash level i, pair
+   in-kernel, hash level i+1, …), so dispatches-per-append drop from
+   O(log n) to O(log n / K).
+ - the SHA-256 compression itself routes per batch size
+   (ops/sha256.select_backend): the fused Pallas kernel on
+   accelerators, the cache-tiled XLA expression on the CPU backend,
+   the plain expression for small levels — one static decision per
+   build/append jit, byte-identical outputs on every path.
  - `dispatch_proof_batch`/`collect_proof_batch` serve RFC 6962
    inclusion proofs for ANY tree size (ragged included): an inclusion
    proof decomposes into the leaf's path inside its full aligned
@@ -52,9 +60,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from plenum_tpu.observability.tracing import CAT_DEVICE
 from plenum_tpu.ops import pow2_at_least as _pow2_at_least
 from plenum_tpu.ops.sha256 import (
-    _sha256_blocks, digests_to_array, pad_messages)
+    _sha256_blocks, compress_blocks, digests_to_array, pad_messages,
+    select_backend)
 
 logger = logging.getLogger(__name__)
 
@@ -132,27 +142,36 @@ def _node_blocks(left, right):
     return words.reshape(words.shape[0], 2, 16)
 
 
-def _hash_pairs(cur):
-    """[2m, 8] u32 digests → [m, 8] parent digests (device)."""
+def _hash_pairs(cur, backend: str = "plain"):
+    """[2m, 8] u32 digests → [m, 8] parent digests (device). The
+    compression routes per-level: a batch big enough for the Pallas
+    kernel / CPU cache tiling takes it, the small top levels keep the
+    plain expression (compress_blocks re-checks the static shape)."""
     blocks = _node_blocks(cur[0::2], cur[1::2])
     nv = jnp.full((blocks.shape[0],), 2, dtype=jnp.int32)
-    return _sha256_blocks(blocks, nv, 2)
+    return compress_blocks(blocks, nv, 2, backend)
 
 
-@functools.partial(jax.jit, static_argnames=("nblocks", "depth"))
-def _build_levels(leaf_words, leaf_nvalid, nblocks: int, depth: int):
+@functools.partial(jax.jit, static_argnames=("nblocks", "depth",
+                                             "backend"))
+def _build_levels(leaf_words, leaf_nvalid, nblocks: int, depth: int,
+                  backend: str = "plain"):
     """leaf_words [P, nblocks, 16] → tuple of P, P/2, … 1 digest
-    arrays ([*, 8] u32), all resident on device."""
-    cur = _sha256_blocks(leaf_words, leaf_nvalid, nblocks)
+    arrays ([*, 8] u32), all resident on device. ONE jit covers leaf
+    hashing and every interior level — `backend` (static) decides the
+    compression lowering (Pallas kernel / CPU tiles / plain XLA) and
+    rides the mesh-sharded dispatch unchanged."""
+    cur = compress_blocks(leaf_words, leaf_nvalid, nblocks, backend)
     levels = [cur]
     for _ in range(depth):
-        cur = _hash_pairs(cur)
+        cur = _hash_pairs(cur, backend)
         levels.append(cur)
     return tuple(levels)
 
 
-@functools.partial(jax.jit, static_argnames=("depth",))
-def _build_levels_from_digest_bytes(arr_u8, depth: int):
+@functools.partial(jax.jit, static_argnames=("depth", "backend"))
+def _build_levels_from_digest_bytes(arr_u8, depth: int,
+                                    backend: str = "plain"):
     """[P, 32] u8 big-endian leaf DIGESTS → device level tuple (no leaf
     hashing — the resync path feeds hash-store contents straight in)."""
     w = arr_u8.reshape(arr_u8.shape[0], 8, 4).astype(jnp.uint32)
@@ -160,7 +179,7 @@ def _build_levels_from_digest_bytes(arr_u8, depth: int):
         | w[..., 3]
     levels = [cur]
     for _ in range(depth):
-        cur = _hash_pairs(cur)
+        cur = _hash_pairs(cur, backend)
         levels.append(cur)
     return tuple(levels)
 
@@ -195,6 +214,41 @@ def _append_level_step(child, parent, p0, cnt, bucket: int):
         jnp.full((bucket,), 2, dtype=jnp.int32), 2)
     idx = jnp.where(ar < cnt, pi, parent.shape[0])
     return parent.at[idx].set(dig, mode="drop"), dig
+
+
+@functools.partial(jax.jit, static_argnames=("buckets", "backend"))
+def _append_levels_fused(child, parents, p0s, cnts, buckets,
+                         backend: str = "plain"):
+    """Multi-level tree fusion: hash K=len(parents) consecutive tree
+    levels in ONE device dispatch — hash level i's new pairs, scatter
+    them into level i+1, pair THOSE in-kernel, hash level i+2, … —
+    instead of one dispatch per level. Node hashes are always exactly
+    2 blocks (65 bytes), so the whole chain is a fixed-shape uint32
+    program; dispatches-per-append drop from O(log n) to
+    O(log n / K) (the MTU tree-unit schedule, PAPERS.md).
+
+    child is the lowest (already-updated) level; parents are the K
+    levels above it, p0s/cnts the per-level write windows (dynamic —
+    one compile serves every append with these array shapes and
+    `buckets`). Per level the gather clamps / the scatter drops the
+    bucket-padding rows, exactly like _append_level_step, so the
+    padding rows never corrupt parent state even though their hashes
+    are garbage. Returns the updated parent arrays plus each level's
+    bucket-padded digests (for hash-store persistence / mirrors)."""
+    outs = []
+    digs = []
+    cur = child
+    for j, (parent, bucket) in enumerate(zip(parents, buckets)):
+        ar = jnp.arange(bucket, dtype=jnp.int32)
+        pi = p0s[j] + ar
+        dig = compress_blocks(
+            _node_blocks(cur[2 * pi], cur[2 * pi + 1]),
+            jnp.full((bucket,), 2, dtype=jnp.int32), 2, backend)
+        idx = jnp.where(ar < cnts[j], pi, parent.shape[0])
+        cur = parent.at[idx].set(dig, mode="drop")
+        outs.append(cur)
+        digs.append(dig)
+    return tuple(outs), tuple(digs)
 
 
 @functools.partial(jax.jit, static_argnames=("rows",))
@@ -244,6 +298,7 @@ class DeviceMerkleTree:
 
     def __init__(self, hasher=None):
         from plenum_tpu.ledger.tree_hasher import TreeHasher
+        from plenum_tpu.observability.tracing import NullTracer
         self.hasher = hasher or TreeHasher()
         self._levels: Optional[List] = None  # device arrays, leaves first
         self._size = 0
@@ -251,7 +306,28 @@ class DeviceMerkleTree:
         self._mirror = {}          # height -> host uint8 [cap>>h, 32]
         self._mirror_count = {}    # height -> mirrored complete prefix
         self._froot_cache = {}     # proof size n -> frontier root bytes
-        self._repl_cache = {}      # height -> (level array, mesh replica)
+        self._repl_cache = {}      # height -> (replica, snap rows, sharding)
+        self.tracer = NullTracer()
+        # cumulative device-IO counters (never reset with the tree):
+        # the flight-recorder spans carry the same events; these make
+        # "no re-materialization" assertable in tests/bench without a
+        # tracer attached
+        self.dispatch_stats = {
+            "build_dispatches": 0,        # fused build jits launched
+            "append_dispatches": 0,       # _place + level-group steps
+            "gather_dispatches": 0,       # per-proof-batch low gathers
+            "mirror_level_downloads": 0,  # full-level host downloads
+            "mirror_rows_downloaded": 0,
+            "replica_broadcasts": 0,      # mesh replications of a level
+            "row_reads": 0,               # single-row device reads
+        }
+
+    def attach_tracer(self, tracer) -> None:
+        """Feed this tree's dispatch spans to a flight recorder (the
+        serving ProofPipeline carries its own tracer; this one covers
+        builds/appends/mirror downloads)."""
+        from plenum_tpu.observability.tracing import NullTracer
+        self.tracer = tracer or NullTracer()
 
     # ------------------------------------------------------------ state
 
@@ -284,6 +360,43 @@ class DeviceMerkleTree:
         self._froot_cache = {}
 
     # ----------------------------------------------------------- builds
+
+    _BUILD_VALIDATED = set()   # (key..., backend) whose execution completed
+
+    def _run_build(self, launch, padded: int, key: tuple):
+        """Backend-routed build launch with the Pallas step-down chain
+        (ed25519_jax._dispatch_kernel precedent): `launch(backend)`
+        returns the level tuple; a Pallas backend is proven by ONE
+        block_until_ready per (key, backend) — dispatch is async, so a
+        runtime failure at an untested shape would otherwise surface
+        at a later np.asarray outside any except and the fallback
+        would never engage. Any Pallas failure steps down to the XLA
+        expression for the whole process (shared probe registry)."""
+        backend = select_backend(padded)
+        while True:
+            try:
+                levels = launch(backend)
+                if backend.startswith("pallas") \
+                        and key + (backend,) not in self._BUILD_VALIDATED:
+                    # deliberate ONE-TIME sync per build-shape family;
+                    # later builds stay fully async
+                    jax.block_until_ready(levels)  # plenum-lint: disable=PT002
+                    self._BUILD_VALIDATED.add(key + (backend,))
+                self.dispatch_stats["build_dispatches"] += 1
+                return levels
+            except Exception:  # pragma: no cover  # plenum-lint: disable=PT006
+                # the fallback engine itself: ANY Pallas failure must
+                # step down to the XLA expression, never fail a build
+                if not backend.startswith("pallas"):
+                    raise
+                logger.exception(
+                    "pallas sha256 build failed; falling back to XLA")
+                from plenum_tpu.ops import mesh as mesh_mod
+                from plenum_tpu.ops import sha256_pallas as sp
+                mesh_mod.disable_pallas_backend(sp.PALLAS_ENV)
+                backend = select_backend(padded)
+                if backend.startswith("pallas"):
+                    backend = "plain"
 
     def build(self, leaves: Sequence[bytes]) -> bytes:
         """Hash `leaves` and every interior level on device; → root."""
@@ -327,13 +440,17 @@ class DeviceMerkleTree:
             else:
                 words = jnp.asarray(host_words)
                 nvalid = jnp.asarray(host_nvalid)
-        if shard:
-            levels = _to_default_device(dm.dispatch(
-                lambda w, nv: _build_levels(w, nv, nblocks, depth),
-                [words, nvalid], n=padded))
-        else:
+        def launch(be):
+            if shard:
+                return _to_default_device(dm.dispatch(
+                    lambda w, nv: _build_levels(w, nv, nblocks, depth, be),
+                    [words, nvalid], n=padded))
             dm.note_passthrough(padded)
-            levels = _build_levels(words, nvalid, nblocks, depth)
+            with self.tracer.span("merkle_build_dispatch", CAT_DEVICE,
+                                  n=padded):
+                return _build_levels(words, nvalid, nblocks, depth, be)
+
+        levels = self._run_build(launch, padded, ("leaves", nblocks, depth))
         self._levels = list(levels)
         self._size, self._cap = n, padded
         self._mirror, self._mirror_count, self._froot_cache = {}, {}, {}
@@ -355,14 +472,20 @@ class DeviceMerkleTree:
                 [arr, np.zeros((padded - n, 32), dtype=np.uint8)])
         depth = padded.bit_length() - 1
         dm = _get_mesh()
-        if dm.should_shard(padded) and padded % dm.n_devices == 0:
-            levels = _to_default_device(dm.dispatch(
-                lambda a: _build_levels_from_digest_bytes(a, depth),
-                [arr], n=padded))
-        else:
+        shard = dm.should_shard(padded) and padded % dm.n_devices == 0
+
+        def launch(be):
+            if shard:
+                return _to_default_device(dm.dispatch(
+                    lambda a: _build_levels_from_digest_bytes(a, depth, be),
+                    [arr], n=padded))
             dm.note_passthrough(padded)
-            levels = _build_levels_from_digest_bytes(
-                jnp.asarray(arr), depth)
+            with self.tracer.span("merkle_build_dispatch", CAT_DEVICE,
+                                  n=padded):
+                return _build_levels_from_digest_bytes(
+                    jnp.asarray(arr), depth, be)
+
+        levels = self._run_build(launch, padded, ("digests", depth))
         self._levels = list(levels)
         self._size, self._cap = n, padded
         self._mirror, self._mirror_count, self._froot_cache = {}, {}, {}
@@ -398,18 +521,43 @@ class DeviceMerkleTree:
         for h in range(len(levels), new_cap.bit_length()):
             levels.append(jnp.zeros((new_cap >> h, 8), dtype=jnp.uint32))
         self._levels, self._cap = levels, new_cap
-        # mirror shapes changed: refill lazily on next proof batch
-        self._mirror, self._mirror_count = {}, {}
-        self._repl_cache = {}
+        # complete node rows are immutable, so growth PRESERVES the
+        # host mirrors: grow each kept level's array (zero rows for
+        # nodes not yet complete) and keep its mirrored-prefix count.
+        # Flushing here (the PR-2/PR-4 behavior) made the first proof
+        # batch after every capacity doubling re-download the whole
+        # mirrored top of the tree — and build() always fills capacity
+        # exactly, so the FIRST append after any build paid it (the
+        # r05 audit-path regression suspect). Levels that fall below
+        # the new _n_low() move to the per-batch device gather.
+        n_low = self._n_low()
+        for h in list(self._mirror):
+            if h < n_low:
+                del self._mirror[h]
+                self._mirror_count.pop(h, None)
+                continue
+            rows = new_cap >> h
+            old = self._mirror[h]
+            if old.shape[0] < rows:
+                grown = np.zeros((rows, 32), dtype=np.uint8)
+                grown[:old.shape[0]] = old
+                self._mirror[h] = grown
+        # replica snapshots survive too: _replicated_level re-checks
+        # row needs against each snapshot's complete prefix
 
     def append_leaf_hashes(self, digests, return_nodes: bool = False):
         """Append leaf DIGESTS incrementally: ~2b device hashes for b
-        leaves, one bucket-padded dispatch per level, no rebuild.
+        leaves, no rebuild. Levels are hashed in FUSED groups of
+        Config.MERKLE_FUSED_LEVELS per device dispatch
+        (_append_levels_fused: hash level i, pair in-kernel, hash
+        level i+1, …), so an append costs 1 + ceil(levels/K)
+        dispatches instead of 1 + levels.
 
         With return_nodes=True, returns [(height, first_node_index,
         uint8 [cnt, 32])] for every newly COMPLETE node — exactly the
         (start, height) entries a CompactMerkleTree hash store persists
         for the same append."""
+        from plenum_tpu.common.config import Config
         arr = self._digest_rows(digests)
         b = arr.shape[0]
         if b == 0:
@@ -423,20 +571,53 @@ class DeviceMerkleTree:
             arr_up[:b] = arr
         else:
             arr_up = arr
-        self._levels[0] = _place(self._levels[0],
-                                 _digest_words(jnp.asarray(arr_up)), n0, b)
+        with self.tracer.span("merkle_append_dispatch", CAT_DEVICE,
+                              levels=0, n=b):
+            self._levels[0] = _place(
+                self._levels[0], _digest_words(jnp.asarray(arr_up)),
+                n0, b)
+        self.dispatch_stats["append_dispatches"] += 1
         news = [(0, n0, b, None)]  # level-0 digests are the host input
+        fuse = max(1, int(getattr(Config, "MERKLE_FUSED_LEVELS", 1)))
         h = 0
         while True:
-            p0 = n0 >> (h + 1)
-            cnt = (n1 >> (h + 1)) - p0
-            if cnt == 0:
+            group = []   # [(level, p0, cnt)] for up to `fuse` levels
+            while len(group) < fuse:
+                level = h + len(group) + 1
+                p0 = n0 >> level
+                cnt = (n1 >> level) - p0
+                if cnt == 0:
+                    break
+                group.append((level, p0, cnt))
+            if not group:
                 break
-            self._levels[h + 1], dig = _append_level_step(
-                self._levels[h], self._levels[h + 1], p0, cnt,
-                _pow2_at_least(cnt))
-            news.append((h + 1, p0, cnt, dig))
-            h += 1
+            if len(group) == 1:
+                level, p0, cnt = group[0]
+                with self.tracer.span("merkle_append_dispatch",
+                                      CAT_DEVICE, levels=1, n=cnt):
+                    self._levels[level], dig = _append_level_step(
+                        self._levels[level - 1], self._levels[level],
+                        p0, cnt, _pow2_at_least(cnt))
+                digs = (dig,)
+            else:
+                parents = tuple(self._levels[lv] for lv, _, _ in group)
+                buckets = tuple(_pow2_at_least(c) for _, _, c in group)
+                p0s = jnp.asarray([p for _, p, _ in group],
+                                  dtype=jnp.int32)
+                cnts = jnp.asarray([c for _, _, c in group],
+                                   dtype=jnp.int32)
+                with self.tracer.span("merkle_append_dispatch",
+                                      CAT_DEVICE, levels=len(group),
+                                      n=int(group[0][2])):
+                    outs, digs = _append_levels_fused(
+                        self._levels[h], parents, p0s, cnts, buckets,
+                        select_backend(buckets[0]))
+                for (level, _, _), out_lv in zip(group, outs):
+                    self._levels[level] = out_lv
+            self.dispatch_stats["append_dispatches"] += 1
+            for (level, p0, cnt), dig in zip(group, digs):
+                news.append((level, p0, cnt, dig))
+            h += len(group)
         self._size = n1
         self._invalidate()
         out = []
@@ -462,9 +643,15 @@ class DeviceMerkleTree:
         for h in range(self._n_low(), self._depth() + 1):
             want = self._size >> h
             if self._mirror_count.get(h, 0) < want or h not in self._mirror:
-                self._mirror[h] = digests_to_array(
-                    np.asarray(self._levels[h]))
+                with self.tracer.span("merkle_mirror_download",
+                                      CAT_DEVICE, height=h,
+                                      rows=int(self._levels[h].shape[0])):
+                    self._mirror[h] = digests_to_array(
+                        np.asarray(self._levels[h]))
                 self._mirror_count[h] = want
+                self.dispatch_stats["mirror_level_downloads"] += 1
+                self.dispatch_stats["mirror_rows_downloaded"] += \
+                    int(self._levels[h].shape[0])
 
     # ------------------------------------------------------------- reads
 
@@ -472,6 +659,7 @@ class DeviceMerkleTree:
         mc = self._mirror_count.get(height, 0)
         if index < mc:
             return self._mirror[height][index].tobytes()
+        self.dispatch_stats["row_reads"] += 1
         row = np.asarray(_read_row(self._levels[height],
                                    jnp.int32(index)))
         return digests_to_array(row).tobytes()
@@ -504,36 +692,54 @@ class DeviceMerkleTree:
 
     # ------------------------------------------- proofs (any tree size)
 
-    def _replicated_level(self, h: int, dm):
-        """Mesh-replicated copy of level h, memoized by (level array,
-        mesh sharding) identity: appends/growth swap the arrays and a
-        mesh reconfiguration rebuilds the sharding object, so the memo
-        self-invalidates on either — a stale replica committed to the
-        OLD device set would make the jitted gather raise an
-        incompatible-devices error. Repeated proof batches between
-        appends reuse the replica (the replication broadcast is the
-        sharded gather's only cross-device traffic)."""
+    def _replicated_level(self, h: int, dm, need_rows: int):
+        """Mesh-replicated copy of level h, memoized as a SNAPSHOT:
+        complete node rows are immutable, so a replica broadcast when
+        the level held `snap` complete nodes serves ANY later gather
+        whose sibling rows stay inside that prefix — appends no longer
+        invalidate it. (The PR-4 memo was keyed on array IDENTITY,
+        and appends swap every level array, so serving under a write
+        load re-broadcast the whole bottom of the tree across the mesh
+        after every append — the read-path re-materialization the r05
+        numbers flagged.) A gather needing rows beyond the snapshot
+        re-broadcasts and advances it; a mesh reconfiguration rebuilds
+        the sharding object, which misses the identity check."""
         import jax
-        lv = self._levels[h]
         sh = dm.replicated()
         cached = self._repl_cache.get(h)
-        if cached is not None and cached[0] is lv and cached[2] is sh:
-            return cached[1]
-        repl = jax.device_put(lv, sh)
-        self._repl_cache[h] = (lv, repl, sh)
+        if cached is not None and cached[2] is sh \
+                and cached[1] >= need_rows:
+            return cached[0]
+        repl = jax.device_put(self._levels[h], sh)
+        self.dispatch_stats["replica_broadcasts"] += 1
+        self._repl_cache[h] = (repl, self._size >> h, sh)
         return repl
 
-    def _gather_low(self, idx_np: np.ndarray, g: int):
+    def _gather_low(self, idx_np: np.ndarray, g: int, n: int):
         """Fused sibling-gather+pack of the bottom g levels for one
-        proof batch. Batches clearing the mesh gate (ops/mesh.py) shard
-        the INDEX axis over every chip — each proof row is an
-        independent gather — against replicated level operands; smaller
-        batches keep the single-device dispatch."""
+        proof batch against the size-`n` prefix. Batches clearing the
+        mesh gate (ops/mesh.py) shard the INDEX axis over every chip —
+        each proof row is an independent gather — against replicated
+        level operands; smaller batches keep the single-device
+        dispatch."""
         dm = _get_mesh()
         k = int(idx_np.shape[0])
+        self.dispatch_stats["gather_dispatches"] += 1
         if dm.should_shard(k):
-            levels = tuple(self._replicated_level(h, dm)
-                           for h in range(g))
+            levels = []
+            for h in range(g):
+                # rows this gather USES at level h: every sibling a
+                # leaf's path actually keeps lies inside its full
+                # aligned subtree, hence inside the size-n prefix's
+                # complete nodes (RFC 6962). The raw sibling max can
+                # exceed that — collect discards entries at or above a
+                # leaf's subtree height — so clamp to the complete
+                # count or the snapshot memo could never satisfy a
+                # batch touching the ragged tail.
+                need = min(int(np.max((idx_np >> h) ^ 1)) + 1,
+                           max(1, n >> h))
+                levels.append(self._replicated_level(h, dm, need))
+            levels = tuple(levels)
             kp = dm.padded_size(k, min_per_device=1)
             idx_p = idx_np if kp == k else np.concatenate(
                 [idx_np, np.repeat(idx_np[:1], kp - k)])
@@ -568,7 +774,7 @@ class DeviceMerkleTree:
         g = min(self._n_low(), h0)
         low = None
         if g and idx_np.size:
-            low = self._gather_low(idx_np, g)
+            low = self._gather_low(idx_np, g, n)
             _start_async_copy(low)
         return (idx_np, low, n, g, fr, roots)
 
@@ -643,7 +849,7 @@ class DeviceMerkleTree:
         g = min(self._n_low(), self._depth())
         low = None
         if g:
-            low = self._gather_low(idx_np, g)
+            low = self._gather_low(idx_np, g, self._size)
             _start_async_copy(low)
         return (idx_np, low)
 

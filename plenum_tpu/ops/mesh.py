@@ -43,6 +43,7 @@ batched aggregate path, and ``ops/merkle`` builds + proof gathers.
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Callable, List, Optional, Sequence
 
@@ -89,10 +90,48 @@ def probed() -> bool:
 
 
 def _reset_probe() -> None:
-    """Test hook: forget the cached probe result."""
+    """Test hook: forget the cached probe result. Also clears the
+    Pallas-backend availability cache below — both derive from the
+    same platform probe, so a caller that re-probes (dryrun_multichip
+    after un-pinning JAX_PLATFORMS) must re-decide Pallas too, or a
+    stale "cpu" answer would disable the Pallas kernels process-wide
+    on a real TPU."""
     with _PROBE_LOCK:
         _PROBE["platform"] = None
         _PROBE["device_count"] = None
+        _PALLAS_BACKENDS.clear()
+
+
+# ---------------------------------------------- pallas kernel availability
+
+# env-var name -> bool; ONE probe-backed decision per kernel family
+# (ed25519, sha256). Guarded by _PROBE_LOCK like the probe itself.
+_PALLAS_BACKENDS = {}
+
+
+def pallas_backend_enabled(env_var: str) -> bool:
+    """THE availability gate every Pallas kernel consults (the ed25519
+    whole-verify kernel and the SHA-256 compression kernel): enabled
+    exactly when device 0 is a real accelerator, unless the kernel's
+    env var pins ``"xla"``. Cached per kernel family so a permanent
+    runtime failure (``disable_pallas_backend``) sticks; the cache is
+    cleared together with the platform probe (``_reset_probe``)."""
+    with _PROBE_LOCK:
+        state = _PALLAS_BACKENDS.get(env_var)
+    if state is None:
+        state = (os.environ.get(env_var) != "xla") and is_accelerator()
+        with _PROBE_LOCK:
+            state = _PALLAS_BACKENDS.setdefault(env_var, state)
+    return state
+
+
+def disable_pallas_backend(env_var: str) -> None:
+    """Permanent step-down for one kernel family — the fallback engine
+    (ops/ed25519_jax._dispatch_kernel, ops/sha256 routing) calls this
+    after an unrecoverable Pallas failure so every later dispatch goes
+    straight to the XLA expression."""
+    with _PROBE_LOCK:
+        _PALLAS_BACKENDS[env_var] = False
 
 
 def default_device():
@@ -150,13 +189,16 @@ class DeviceMesh:
     def __init__(self, enabled: Optional[bool] = None,
                  max_devices: Optional[int] = None,
                  shard_min: Optional[int] = None,
-                 min_per_device: int = 8):
+                 min_per_device: int = 8,
+                 cpu_shard: Optional[bool] = None):
         from plenum_tpu.common.config import Config
         self.enabled = Config.MESH_ENABLED if enabled is None else enabled
         self.max_devices = (Config.MESH_MAX_DEVICES
                             if max_devices is None else max_devices)
         self.shard_min = (Config.MESH_SHARD_MIN
                           if shard_min is None else shard_min)
+        self.cpu_shard = (Config.MESH_CPU_SHARD
+                          if cpu_shard is None else cpu_shard)
         self.min_per_device = min_per_device
         self.tracer = NullTracer()
         self._lock = threading.Lock()
@@ -234,11 +276,20 @@ class DeviceMesh:
 
     def should_shard(self, n: int) -> bool:
         """The passthrough gate: shard only when the mesh is enabled,
-        more than one chip is present, and the batch clears
-        MESH_SHARD_MIN (below it, sharding overhead exceeds the win)."""
+        more than one chip is present, the batch clears MESH_SHARD_MIN
+        (below it, sharding overhead exceeds the win), AND the devices
+        are real accelerators — XLA's virtual CPU devices share the
+        physical cores, so sharding over them only adds partition
+        overhead (the BENCH_r05 merkle-build collapse). Tests and
+        dryrun_multichip force the CPU-sharded paths via cpu_shard /
+        PLENUM_TPU_MESH_CPU_SHARD=1 (env, so spawned node processes
+        inherit it)."""
         if not self.enabled or n < self.shard_min:
             return False
-        return self.n_devices > 1
+        if self.n_devices <= 1:
+            return False
+        return (is_accelerator() or self.cpu_shard
+                or os.environ.get("PLENUM_TPU_MESH_CPU_SHARD") == "1")
 
     def padded_size(self, n: int, min_per_device: Optional[int] = None
                     ) -> int:
@@ -299,6 +350,7 @@ class DeviceMesh:
             "enabled": self.enabled,
             "max_devices": self.max_devices,
             "shard_min": self.shard_min,
+            "cpu_shard": self.cpu_shard,
             "dispatches": self.dispatches,
             "sharded_dispatches": self.sharded_dispatches,
             "passthrough_dispatches": self.passthrough_dispatches,
@@ -373,7 +425,8 @@ def get_mesh() -> DeviceMesh:
 def configure(enabled: Optional[bool] = None,
               max_devices: Optional[int] = None,
               shard_min: Optional[int] = None,
-              tracer=None) -> DeviceMesh:
+              tracer=None,
+              cpu_shard: Optional[bool] = None) -> DeviceMesh:
     """Reconfigure the process-wide mesh. Changing the device cap resets
     the enumeration (and compiled-sharding cache) so the next dispatch
     sees the new mesh shape."""
@@ -382,6 +435,8 @@ def configure(enabled: Optional[bool] = None,
         m.enabled = enabled
     if shard_min is not None:
         m.shard_min = shard_min
+    if cpu_shard is not None:
+        m.cpu_shard = cpu_shard
     if max_devices is not None and max_devices != m.max_devices:
         m.max_devices = max_devices
         m.reset_devices()
@@ -395,7 +450,8 @@ def configure_from(config) -> DeviceMesh:
     return configure(
         enabled=getattr(config, "MESH_ENABLED", None),
         max_devices=getattr(config, "MESH_MAX_DEVICES", None),
-        shard_min=getattr(config, "MESH_SHARD_MIN", None))
+        shard_min=getattr(config, "MESH_SHARD_MIN", None),
+        cpu_shard=getattr(config, "MESH_CPU_SHARD", None))
 
 
 def mesh_stats() -> dict:

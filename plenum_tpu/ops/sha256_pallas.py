@@ -1,0 +1,168 @@
+"""Pallas TPU kernel: batched SHA-256 compression entirely in VMEM.
+
+Why: the XLA expression of the compression (ops/sha256._sha256_blocks)
+lowers op-by-op — every one of the ~1600 uint32 ops per compression
+materializes a batch-wide temp, so the kernel round-trips its working
+set through HBM once per op and the tree-hash workload is bandwidth-
+bound (the MTU and zkSpeed hash accelerators in PAPERS.md win exactly
+by fusing the message schedule and the rounds into one unit). Here the
+whole compression lives in VMEM: the 8 state words and the rolling
+16-word schedule window are [BLOCK_R, 128] uint32 register tiles, the
+64 rounds are fully unrolled (rotations become static shift/or pairs
+on the VPU), and the only HBM traffic per grid step is the kernel's
+padded message words in and the 8 digest words out.
+
+Layout mirrors ops/ed25519_pallas.py: callers keep the XLA kernel's
+[B, nblocks, 16] uint32 convention; `sha256_blocks` relayouts to
+word-major [nblocks*16, nb8, 128] tiles, pads the batch to a BLOCK
+multiple, and runs one grid step per BLOCK messages. Outputs are
+byte-identical to ops/sha256._sha256_blocks (tests cross-check both
+against hashlib), so the merkle/ledger seams can route here above a
+batch threshold with no caller changes.
+
+Availability follows the ed25519 pattern: ONE shared probe
+(ops/mesh.pallas_backend_enabled, env PLENUM_TPU_SHA256_BACKEND) and
+interpret-mode execution for CPU tests, so tier-1 exercises the kernel
+byte-for-byte on hosts without a TPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from plenum_tpu.ops.sha256 import _IV, _K
+
+PALLAS_ENV = "PLENUM_TPU_SHA256_BACKEND"
+
+BLOCK_R = 8          # sublanes per batch block (8x128 = 1024 msgs)
+BLOCK_L = 128        # lanes
+BLOCK = BLOCK_R * BLOCK_L
+
+
+def _rotr(x, n: int):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def _compress_tiles(state, w):
+    """One SHA-256 compression on [BLOCK_R, BLOCK_L] uint32 tiles.
+    state: list of 8 tiles; w: list of 16 message-word tiles. Rounds
+    fully unrolled; the schedule extends the same list (w[t] for
+    t >= 16 is computed once and stays a VMEM register)."""
+    a, b, c, d, e, f, g, h = state
+    w = list(w)
+    for t in range(64):
+        if t >= 16:
+            w15 = w[t - 15]
+            w2 = w[t - 2]
+            sig0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
+            sig1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
+            w.append(w[t - 16] + sig0 + w[t - 7] + sig1)
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + jnp.uint32(int(_K[t])) + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    return [s + v for s, v in zip(state, (a, b, c, d, e, f, g, h))]
+
+
+def _sha256_kernel(nblocks: int):
+    """Kernel body for a fixed (static) block count per message."""
+
+    def kernel(w_ref, nv_ref, out_ref):
+        nv = nv_ref[0]
+        state = [jnp.full((BLOCK_R, BLOCK_L), jnp.uint32(int(v)))
+                 for v in _IV]
+        for blk in range(nblocks):
+            w = [w_ref[blk * 16 + j] for j in range(16)]
+            new = _compress_tiles(state, w)
+            # ragged block counts: rows whose message ended keep their
+            # state (blk 0 is always valid — nvalid >= 1 by padding)
+            if blk == 0:
+                state = new
+            else:
+                mask = jnp.int32(blk) < nv
+                state = [jnp.where(mask, n_, s_)
+                         for n_, s_ in zip(new, state)]
+        for j in range(8):
+            out_ref[j] = state[j]
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sha256(n_grid: int, nblocks: int, interpret: bool = False):
+    from jax.experimental import pallas as pl
+
+    nb8 = n_grid * BLOCK_R
+    word_spec = pl.BlockSpec((nblocks * 16, BLOCK_R, BLOCK_L),
+                             lambda i: (0, i, 0))
+    nv_spec = pl.BlockSpec((1, BLOCK_R, BLOCK_L), lambda i: (0, i, 0))
+    out_spec = pl.BlockSpec((8, BLOCK_R, BLOCK_L), lambda i: (0, i, 0))
+
+    def to_blocks(x_bt):
+        """[B, K] → [K, nb8, 128] (word-major, 8x128 tiles)."""
+        return jnp.transpose(x_bt, (1, 0)).reshape(
+            x_bt.shape[1], nb8, BLOCK_L)
+
+    # ONE jitted function does relayout + the pallas call + un-layout,
+    # so callers pay a single dispatch (ed25519_pallas precedent)
+    def run(words, nvalid):
+        wb = to_blocks(words.reshape(words.shape[0], nblocks * 16))
+        nvb = to_blocks(nvalid[:, None])
+        out = pl.pallas_call(
+            _sha256_kernel(nblocks),
+            grid=(n_grid,),
+            in_specs=[word_spec, nv_spec],
+            out_specs=out_spec,
+            out_shape=jax.ShapeDtypeStruct((8, nb8, BLOCK_L),
+                                           jnp.uint32),
+            interpret=interpret,
+        )(wb, nvb)
+        return jnp.transpose(out.reshape(8, nb8 * BLOCK_L), (1, 0))
+
+    return jax.jit(run)
+
+
+def sha256_blocks(words, nvalid, nblocks: int, interpret: bool = False):
+    """Drop-in equivalent of ops/sha256._sha256_blocks (same
+    [B, nblocks, 16] u32 + [B] i32 arguments, same [B, 8] u32 digests)
+    running the single-launch Pallas kernel. The batch is padded to a
+    BLOCK multiple internally (pad rows hash garbage that the slice
+    drops). Traceable: callers may invoke it inside their own jit
+    (ops/merkle's fused build does)."""
+    B = int(words.shape[0])
+    pad = (-B) % BLOCK
+    if pad:
+        words = jnp.pad(words, ((0, pad), (0, 0), (0, 0)))
+        nvalid = jnp.pad(nvalid, (0, pad), constant_values=1)
+    dig = _build_sha256((B + pad) // BLOCK, nblocks, interpret)(
+        words, nvalid.astype(jnp.int32))
+    return dig[:B] if pad else dig
+
+
+def pallas_available() -> bool:
+    """Availability of the production (compiled, non-interpret) kernel:
+    the shared accelerator probe gated by PLENUM_TPU_SHA256_BACKEND
+    (ops/mesh.pallas_backend_enabled — one decision per process,
+    cleared with the platform probe)."""
+    from plenum_tpu.ops import mesh as mesh_mod
+    return mesh_mod.pallas_backend_enabled(PALLAS_ENV)
+
+
+def sha256_many_pallas(msgs, interpret: bool = False) -> list:
+    """Batched SHA-256 over bytes through the Pallas kernel — the
+    byte-level test/bench entry (production routes through
+    ops/sha256.sha256_blocks_routed)."""
+    from plenum_tpu.ops.sha256 import digests_to_bytes, pad_messages
+    if not msgs:
+        return []
+    words, nvalid, nblocks = pad_messages(msgs)
+    dig = sha256_blocks(jnp.asarray(words), jnp.asarray(nvalid),
+                        nblocks, interpret)
+    return digests_to_bytes(np.asarray(dig))

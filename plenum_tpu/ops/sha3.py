@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from plenum_tpu.ops import scatter_ragged_rows
+
 RATE_BYTES = 136          # SHA3-256: r = 1088 bits
 RATE_LANES = RATE_BYTES // 8
 
@@ -174,9 +176,11 @@ def pad_sha3_messages(msgs: Sequence[bytes], nblocks: int = None
     assert maxb <= nblocks
     n = len(msgs)
     width = nblocks * RATE_BYTES
-    out = np.zeros((n, width), dtype=np.uint8)
     ln0 = len(msgs[0]) if msgs else 0
-    if msgs and all(len(m) == ln0 for m in msgs):
+    uniform = bool(msgs) and all(len(m) == ln0 for m in msgs)
+    if not msgs or uniform:
+        out = np.zeros((n, width), dtype=np.uint8)
+    if uniform:
         # uniform lengths (level batches of same-shape nodes): one
         # vectorized fill, no per-message loop
         if ln0:
@@ -185,21 +189,13 @@ def pad_sha3_messages(msgs: Sequence[bytes], nblocks: int = None
         out[:, ln0] = 0x06
         out[:, need[0] * RATE_BYTES - 1] ^= 0x80
     elif msgs:
-        # mixed lengths: one flat vectorized scatter (same shape as
-        # ops/sha256.pad_messages — the per-message loop was the host
-        # bottleneck for large mixed batches)
-        lens = np.fromiter((len(m) for m in msgs), dtype=np.int64,
-                           count=n)
+        # mixed lengths: one flat vectorized scatter (shared core in
+        # ops.scatter_ragged_rows, same as ops/sha256.pad_messages —
+        # the per-message loop was the host bottleneck for large mixed
+        # batches); only the Keccak domain/final markers differ
+        out, lens = scatter_ragged_rows(msgs, width)
         flat = out.reshape(-1)
-        starts = np.zeros(n, dtype=np.int64)
-        np.cumsum(lens[:-1], out=starts[1:])
-        joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
         rows = np.arange(n, dtype=np.int64)
-        if joined.shape[0]:
-            dst = np.repeat(rows * width, lens) \
-                + (np.arange(joined.shape[0], dtype=np.int64)
-                   - np.repeat(starts, lens))
-            flat[dst] = joined
         flat[rows * width + lens] = 0x06
         ends = np.asarray(need, dtype=np.int64) * RATE_BYTES
         last = rows * width + ends - 1

@@ -7,6 +7,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# production refuses to shard over virtual CPU devices (they share the
+# physical cores — pure partition overhead; ops/mesh.should_shard);
+# the suite exists to exercise the sharded code paths, so force them.
+# Env (not Config) so node subprocesses spawned by e2e tests inherit it.
+os.environ.setdefault("PLENUM_TPU_MESH_CPU_SHARD", "1")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,63 @@
+"""Gate-of-the-gate for the bench merkle regression gate (ISSUE 9
+tentpole part 4): merkle_regression_gate is a pure function of the
+micro_merkle dict, so tier-1 proves it actually FAILS on a synthetic
+sub-1.0 ratio — the same contract test_lint_clean gives the lint gate.
+Without this, a refactor could quietly turn the hard gate back into
+the PR-8 warn flag and nobody would notice until the next regression
+shipped."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _gate():
+    import bench
+    return bench
+
+
+def test_gate_passes_at_or_above_floor():
+    bench = _gate()
+    assert bench.merkle_regression_gate(
+        {"vs_hashlib": 1.0, "vs_cpu_audit_paths": 1.0}) == []
+    assert bench.merkle_regression_gate(
+        {"vs_hashlib": 1.56, "vs_cpu_audit_paths": 15.8}) == []
+
+
+def test_gate_fails_on_sub_floor_ratio():
+    bench = _gate()
+    failures = bench.merkle_regression_gate(
+        {"vs_hashlib": 0.81, "vs_cpu_audit_paths": 0.66})
+    assert len(failures) == 2
+    assert any("vs_hashlib 0.81" in f for f in failures)
+    assert any("vs_cpu_audit_paths 0.66" in f for f in failures)
+    # one side regressing is enough to fail
+    assert bench.merkle_regression_gate(
+        {"vs_hashlib": 1.2, "vs_cpu_audit_paths": 0.99}) != []
+
+
+def test_gate_fails_on_missing_field():
+    """A refactor that renames/drops a ratio must fail loudly, not
+    skip the check."""
+    bench = _gate()
+    failures = bench.merkle_regression_gate({"vs_hashlib": 1.5})
+    assert any("vs_cpu_audit_paths" in f for f in failures)
+
+
+def test_gate_floor_is_at_least_one():
+    bench = _gate()
+    assert bench.MERKLE_RATIO_FLOOR >= 1.0
+
+
+def test_best_prior_flags_stay_warn_only():
+    """The best-prior comparison (merkle_regression_flags) is the
+    warn-only half — it must keep returning a dict with a warn field,
+    not raise, even when the current run beats every prior round."""
+    bench = _gate()
+    flags = bench.merkle_regression_flags(
+        {"vs_hashlib": 99.0, "vs_cpu_audit_paths": 99.0})
+    assert flags["warn"] is None
+    flags = bench.merkle_regression_flags(
+        {"vs_hashlib": 0.01, "vs_cpu_audit_paths": 0.01})
+    assert flags["warn"]

@@ -431,3 +431,157 @@ def test_leecher_rejects_poisoned_rep_at_rep_time():
     assert leecher._buffer == {} and applied == []
     leecher.process_catchup_rep(honest_rep, "peer")
     assert len(applied) == 9  # verified, applied, and the range is done
+
+
+# ------------------------- multi-level fused appends (ISSUE 9 tentpole)
+
+def test_fused_multilevel_append_matches_level_at_a_time():
+    """K-level fused append dispatches (_append_levels_fused) produce
+    byte-identical roots AND hash-store node contents to the K=1
+    level-at-a-time path, across batch sizes that exercise partial
+    groups, single-level tails and capacity growth."""
+    from plenum_tpu.common.config import Config
+    rng = np.random.RandomState(21)
+    base = rng.randint(0, 256, size=(3000, 32)).astype(np.uint8)
+    batches = [rng.randint(0, 256, size=(b, 32)).astype(np.uint8)
+               for b in (1, 5, 64, 700, 1000, 3)]
+    results = {}
+    prior = Config.MERKLE_FUSED_LEVELS
+    try:
+        for k in (1, 4):
+            Config.MERKLE_FUSED_LEVELS = k
+            t = DeviceMerkleTree()
+            t.build_from_leaf_hashes(base)
+            news = []
+            for b in batches:
+                news.append([
+                    (h, p, arr.tobytes())
+                    for h, p, arr in t.append_leaf_hashes(
+                        b, return_nodes=True)])
+            results[k] = (t.root_hash, news)
+    finally:
+        Config.MERKLE_FUSED_LEVELS = prior
+    assert results[1][0] == results[4][0]
+    assert results[1][1] == results[4][1]
+
+
+def test_fused_append_dispatch_count():
+    """One append on a deep tree costs 1 + ceil(levels/K) dispatches —
+    counted from the flight-recorder spans the bench gate uses."""
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.observability.tracing import Tracer
+    rng = np.random.RandomState(5)
+    base = rng.randint(0, 256, size=(1 << 14, 32)).astype(np.uint8)
+    app = rng.randint(0, 256, size=(256, 32)).astype(np.uint8)
+    prior = Config.MERKLE_FUSED_LEVELS
+    counts = {}
+    try:
+        for k in (1, 4):
+            Config.MERKLE_FUSED_LEVELS = k
+            t = DeviceMerkleTree()
+            t.build_from_leaf_hashes(base)
+            tr = Tracer("t")
+            t.attach_tracer(tr)
+            t.append_leaf_hashes(app)
+            counts[k] = sum(1 for r in tr.spans()
+                            if r[1] == "merkle_append_dispatch")
+    finally:
+        Config.MERKLE_FUSED_LEVELS = prior
+    # 2^14 tree + 256 leaves: ~9 levels gain nodes. K=1 pays one
+    # dispatch per level (+1 for the leaf placement); K=4 fuses them.
+    assert counts[1] >= 2 * counts[4], counts
+    assert counts[4] <= 1 + (counts[1] - 1 + 3) // 4, counts
+
+
+# ------------------- mirror / replica re-materialization (ISSUE 9 bug)
+
+def test_no_mirror_rematerialization_after_append():
+    """The PR-4 growth path flushed every host mirror on capacity
+    doubling — and build() fills capacity exactly, so the FIRST append
+    after any build re-downloaded the whole mirrored top of the tree
+    on the next proof batch. Growth now grows the mirror arrays in
+    place (complete rows are immutable); only levels created by the
+    growth itself may download."""
+    t = DeviceMerkleTree()
+    t._TOP_CACHE = 256           # keep real device-gathered bottom levels
+    leaves = [b"txn-%08d" % i for i in range(1 << 12)]
+    t.build(leaves)
+    idx = list(range(0, 1 << 12, 4))
+    t.audit_path_batch(idx[:64])                 # warm mirrors
+    warm = t.dispatch_stats["mirror_level_downloads"]
+    rng = np.random.RandomState(0)
+    t.append_leaf_hashes(
+        rng.randint(0, 256, size=(100, 32)).astype(np.uint8))
+    t.audit_path_batch(idx[:64])
+    after = t.dispatch_stats["mirror_level_downloads"]
+    # capacity doubled: at most the NEW top level(s) download, never
+    # the preserved interior mirrors (was: the full mirrored top)
+    assert after - warm <= 2, (warm, after)
+    t.audit_path_batch(idx[:64])
+    assert t.dispatch_stats["mirror_level_downloads"] == after
+    # steady state: repeated proof batches cost exactly one gather
+    # dispatch each and zero mirror traffic
+    g0 = t.dispatch_stats["gather_dispatches"]
+    for _ in range(3):
+        t.audit_path_batch(idx[:64])
+    assert t.dispatch_stats["gather_dispatches"] == g0 + 3
+    assert t.dispatch_stats["mirror_level_downloads"] == after
+
+
+def test_proofs_correct_across_preserved_mirror_growth():
+    """Roots and verified proofs stay right after append-with-growth
+    serves from preserved (grown-in-place) mirrors."""
+    t = DeviceMerkleTree()
+    leaves = [b"txn-%08d" % i for i in range(1 << 10)]
+    t.build(leaves)
+    idx = list(range(0, 1 << 10, 3))
+    t.audit_path_batch(idx[:32])                 # warm mirrors
+    extra = [b"extra-%04d" % i for i in range(37)]
+    t.append_leaf_hashes([H.hash_leaf(d) for d in extra])
+    host = host_tree(leaves + extra)
+    assert t.root_hash == host.root_hash
+    n = t.tree_size
+    all_leaves = leaves + extra
+    check = idx[:32] + [n - 1, n - 37]
+    paths = t.inclusion_proofs(check, n)
+    assert paths == host.inclusion_proofs_batch(check, n)
+    for m, p in zip(check, paths):
+        assert V.verify_leaf_inclusion(all_leaves[m], m, p, n,
+                                       t.root_hash)
+
+
+def test_replica_snapshot_survives_appends_under_mesh():
+    """Sharded proof gathers memoize mesh replicas as SNAPSHOTS:
+    appends must not re-broadcast the bottom levels for historical
+    proofs (the PR-4 identity memo re-materialized them every
+    append/proof cycle); a gather that needs the new rows
+    re-broadcasts once."""
+    from plenum_tpu.ops import mesh as mesh_mod
+    dm = mesh_mod.get_mesh()
+    if dm.n_devices <= 1:
+        pytest.skip("needs the virtual multi-device mesh")
+    rng = np.random.RandomState(3)
+    t = DeviceMerkleTree()
+    t._TOP_CACHE = 1024          # force device-gathered bottom levels
+    # slack capacity so appends do not grow (growth legitimately adds
+    # one newly-low level's broadcast)
+    base = rng.randint(0, 256, size=((1 << 14) + 50, 32)) \
+        .astype(np.uint8)
+    t.build_from_leaf_hashes(base)
+    idx = list(range(0, 1 << 13, 2))     # >= MESH_SHARD_MIN proofs
+    ref = t.inclusion_proofs(idx, 1 << 13)
+    r0 = t.dispatch_stats["replica_broadcasts"]
+    assert r0 > 0
+    for _ in range(3):
+        t.append_leaf_hashes(
+            rng.randint(0, 256, size=(16, 32)).astype(np.uint8))
+        assert t.inclusion_proofs(idx, 1 << 13) == ref
+    assert t.dispatch_stats["replica_broadcasts"] == r0
+    # proofs over the appended region need rows past the snapshot:
+    # exactly one fresh broadcast round, then steady again
+    n = t.tree_size
+    new_idx = list(range(n - 2048, n))
+    t.inclusion_proofs(new_idx, n)
+    r1 = t.dispatch_stats["replica_broadcasts"]
+    t.inclusion_proofs(new_idx, n)
+    assert t.dispatch_stats["replica_broadcasts"] == r1

@@ -1,0 +1,196 @@
+"""The fused Pallas SHA-256 kernel (ops/sha256_pallas.py) and the
+backend routing seam (ops/sha256.select_backend / compress_blocks).
+
+The kernel runs in INTERPRET mode here — the suite pins
+JAX_PLATFORMS=cpu, and interpret mode executes the exact kernel program
+(same tiles, same unrolled rounds, same masking) through the
+interpreter, so every digest is byte-for-byte the kernel's output.
+bench.py exercises the compiled kernel on real TPU runs.
+"""
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from plenum_tpu.ops import scatter_ragged_rows
+from plenum_tpu.ops import sha256 as sha_mod
+from plenum_tpu.ops import sha256_pallas as sp
+from plenum_tpu.ops.sha256 import (
+    _sha256_blocks, _sha256_blocks_tiled, pad_messages, sha256_many)
+
+# NIST CAVP / FIPS 180-2 known-answer vectors (SHA256ShortMsg.rsp +
+# the FIPS appendix examples) — constants, not recomputed, so a wrong
+# kernel AND a wrong reference cannot cancel out.
+CAVP = [
+    (b"",
+     "e3b0c44298fc1c149afbf4c8996fb924"
+     "27ae41e4649b934ca495991b7852b855"),
+    (bytes.fromhex("d3"),
+     "28969cdfa74a12c82f3bad960b0b000a"
+     "ca2ac329deea5c2328ebc6f2ba9802c1"),
+    (bytes.fromhex("11af"),
+     "5ca7133fa735326081558ac312c620ee"
+     "ca9970d1e70a4b95533d956f072d1f98"),
+    (bytes.fromhex("b4190e"),
+     "dff2e73091f6c05e528896c4c831b944"
+     "8653dc2ff043528f6769437bc7b975c2"),
+    (b"abc",
+     "ba7816bf8f01cfea414140de5dae2223"
+     "b00361a396177a9cb410ff61f20015ad"),
+    (b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq",
+     "248d6a61d20638b8e5c026930c3e6039"
+     "a33ce45964ff2167f6ecedd419db06c1"),
+    (b"abcdefghbcdefghicdefghijdefghijkefghijklfghijklmghijklmn"
+     b"hijklmnoijklmnopjklmnopqklmnopqrlmnopqrsmnopqrstnopqrstu",
+     "cf5b16a778af8380036ce59e7b049237"
+     "0b249b11e8f07a51afac45037afee9d1"),
+]
+
+
+def test_cavp_vectors_pallas_interpret():
+    msgs = [m for m, _ in CAVP]
+    got = sp.sha256_many_pallas(msgs, interpret=True)
+    assert got == [bytes.fromhex(d) for _, d in CAVP]
+
+
+def test_cavp_vectors_xla_reference():
+    msgs = [m for m, _ in CAVP]
+    assert sha256_many(msgs) == [bytes.fromhex(d) for _, d in CAVP]
+
+
+def test_randomized_ragged_byte_equality():
+    """Pallas-interpret vs XLA vs hashlib across ragged lengths —
+    including the block-boundary lengths (55/56/63/64/65) and the
+    65-byte RFC 6962 node-hash shape."""
+    rng = random.Random(42)
+    lengths = [0, 1, 54, 55, 56, 63, 64, 65, 119, 120, 127, 128, 129,
+               200, 300]
+    msgs = [bytes(rng.randrange(256) for _ in range(rng.choice(lengths)))
+            for _ in range(257)]
+    msgs += [b"\x01" + bytes(64)]  # the node-hash message: 65 bytes
+    want = [hashlib.sha256(m).digest() for m in msgs]
+    assert sp.sha256_many_pallas(msgs, interpret=True) == want
+    assert sha256_many(msgs) == want
+
+
+@pytest.mark.parametrize("n", [sp.BLOCK - 1, sp.BLOCK, sp.BLOCK + 1])
+def test_block_boundary_batches(n):
+    """2^k±1 around the kernel's grid block: the internal pad rows
+    must never leak into real digests."""
+    msgs = [b"txn-%07d" % i for i in range(n)]
+    want = [hashlib.sha256(m).digest() for m in msgs]
+    assert sp.sha256_many_pallas(msgs, interpret=True) == want
+
+
+def test_node_pair_shape_matches_tree_hasher():
+    """65-byte H(0x01||l||r) node messages through the kernel equal
+    the scalar RFC 6962 node hash."""
+    rng = random.Random(7)
+    pairs = [(bytes(rng.randrange(256) for _ in range(32)),
+              bytes(rng.randrange(256) for _ in range(32)))
+             for _ in range(64)]
+    msgs = [b"\x01" + l + r for l, r in pairs]
+    got = sp.sha256_many_pallas(msgs, interpret=True)
+    from plenum_tpu.ledger.tree_hasher import TreeHasher
+    th = TreeHasher()
+    assert got == [th.hash_children(l, r) for l, r in pairs]
+
+
+def test_tiled_xla_matches_plain():
+    """The CPU cache-tiled lowering is the same math: byte-equal
+    states for pow2 and padded batch sizes."""
+    from plenum_tpu.common.config import Config
+    tile = Config.SHA256_CPU_TILE
+    msgs = [b"m%d" % i for i in range(2 * tile)]
+    words, nvalid, nb = pad_messages(msgs)
+    wj, nvj = jnp.asarray(words), jnp.asarray(nvalid)
+    plain = np.asarray(_sha256_blocks(wj, nvj, nb))
+    tiled = np.asarray(_sha256_blocks_tiled(wj, nvj, nb, tile))
+    assert (plain == tiled).all()
+
+
+def test_routed_dispatch_pads_non_tile_multiple():
+    """sha256_many on a batch that is NOT a tile multiple still routes
+    through the tiled path (internal pad rows) and matches hashlib."""
+    from plenum_tpu.common.config import Config
+    n = 2 * Config.SHA256_CPU_TILE + 321
+    msgs = [b"x%06d" % i for i in range(n)]
+    assert sha256_many(msgs) == [hashlib.sha256(m).digest()
+                                 for m in msgs]
+
+
+def test_select_backend_cpu_routing():
+    from plenum_tpu.common.config import Config
+    # the suite runs on the CPU backend: pallas stays off, big batches
+    # tile, small batches stay plain
+    assert sha_mod.select_backend(2 * Config.SHA256_CPU_TILE) == "tiled"
+    assert sha_mod.select_backend(16) == "plain"
+
+
+def test_select_backend_interp_override(monkeypatch):
+    monkeypatch.setenv(sp.PALLAS_ENV, "pallas_interp")
+    assert sha_mod.select_backend(sp.BLOCK) == "pallas_interp"
+    # below a kernel block the override does not apply
+    assert sha_mod.select_backend(sp.BLOCK - 1) != "pallas_interp"
+
+
+def test_interp_override_end_to_end(monkeypatch):
+    """The full sha256_many production path with the kernel forced via
+    env — the integration seam a TPU host takes, byte-for-byte."""
+    monkeypatch.setenv(sp.PALLAS_ENV, "pallas_interp")
+    msgs = [b"leaf-%05d" % i for i in range(sp.BLOCK)]
+    assert sha256_many(msgs) == [hashlib.sha256(m).digest()
+                                 for m in msgs]
+
+
+def test_pallas_probe_registry_shared_reset():
+    """The availability registry (satellite: ONE probe for ed25519 +
+    sha256) is cleared together with the platform probe — the
+    dryrun_multichip reset contract."""
+    from plenum_tpu.ops import mesh as mesh_mod
+    # on this suite's CPU backend the kernel reads unavailable
+    assert sp.pallas_available() is False
+    mesh_mod.disable_pallas_backend(sp.PALLAS_ENV)
+    assert sp.pallas_available() is False
+    with mesh_mod._PROBE_LOCK:
+        assert sp.PALLAS_ENV in mesh_mod._PALLAS_BACKENDS
+    mesh_mod._reset_probe()
+    with mesh_mod._PROBE_LOCK:
+        assert sp.PALLAS_ENV not in mesh_mod._PALLAS_BACKENDS
+    # re-probe repopulates (and stays off on CPU)
+    assert sp.pallas_available() is False
+
+
+def test_ed25519_probe_routes_through_registry():
+    from plenum_tpu.ops import ed25519_jax as edj
+    from plenum_tpu.ops import mesh as mesh_mod
+    assert edj._pallas_available() is False  # CPU suite
+    with mesh_mod._PROBE_LOCK:
+        assert edj._ED25519_PALLAS_ENV in mesh_mod._PALLAS_BACKENDS
+    mesh_mod._reset_probe()
+
+
+def test_scatter_ragged_rows_shared_helper():
+    msgs = [b"", b"a", b"bc" * 40, b"d" * 7]
+    out, lens = scatter_ragged_rows(msgs, 128)
+    assert out.shape == (4, 128)
+    assert list(lens) == [0, 1, 80, 7]
+    for i, m in enumerate(msgs):
+        assert out[i, :len(m)].tobytes() == m
+        assert not out[i, len(m):].any()
+
+
+def test_sha3_and_sha256_mixed_padding_share_scatter():
+    """Both pad paths ride scatter_ragged_rows: ragged batches through
+    each hash still match hashlib exactly."""
+    from plenum_tpu.ops.sha3 import sha3_256_many
+    rng = random.Random(9)
+    msgs = [bytes(rng.randrange(256) for _ in range(n))
+            for n in (0, 1, 63, 64, 65, 135, 136, 137, 272, 273)]
+    assert sha256_many(msgs) == [hashlib.sha256(m).digest()
+                                 for m in msgs]
+    assert sha3_256_many(msgs) == [hashlib.sha3_256(m).digest()
+                                   for m in msgs]
