@@ -97,13 +97,16 @@ def make_requests(n, signer):
 
 
 def make_sim_pool(names, verifier_name, seed=7, batch=None,
-                  tracing=False, mesh=True):
+                  tracing=False, mesh=True, telemetry=True):
     """Build an n-node sim pool with the given verification provider
     (shared scaffolding for the 4-node headline and 25-node backlog
     configs — one drain/hub wiring to maintain). tracing=True turns on
     the flight recorder (observability/) for the overhead config;
     mesh=False pins the device-mesh dispatcher off (Node bootstrap
-    applies MESH_* to the process-wide mesh) for the on/off configs."""
+    applies MESH_* to the process-wide mesh) for the on/off configs;
+    telemetry=False pins the always-on telemetry plane off (its
+    overhead A/B config — every other config keeps it ON, the
+    production shape)."""
     from plenum_tpu.common.config import Config
     from plenum_tpu.crypto.batch_verifier import create_verifier
     from plenum_tpu.runtime.sim_random import DefaultSimRandom
@@ -118,7 +121,8 @@ def make_sim_pool(names, verifier_name, seed=7, batch=None,
     conf = Config(Max3PCBatchSize=batch or CLIENT_BATCH,
                   Max3PCBatchWait=0.05,
                   CHK_FREQ=10, LOG_SIZE=30, HEARTBEAT_FREQ=10 ** 6,
-                  TRACING_ENABLED=tracing, MESH_ENABLED=mesh)
+                  TRACING_ENABLED=tracing, MESH_ENABLED=mesh,
+                  TELEMETRY_ENABLED=telemetry)
     nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
              for name in names]
     if verifier_name == "tpu_hub":
@@ -440,7 +444,8 @@ def _drive_mp_client(base_dir, reqs, procs):
     return asyncio.run(drive())
 
 
-def run_pool(reqs, verifier_name, tracing=False, return_nodes=False):
+def run_pool(reqs, verifier_name, tracing=False, return_nodes=False,
+             telemetry=True):
     """→ (elapsed_wall_seconds, ordered_count) for ordering all reqs
     (+ the pool's nodes when return_nodes — the traced run hands its
     ring buffers to the per-stage budget aggregation).
@@ -451,7 +456,8 @@ def run_pool(reqs, verifier_name, tracing=False, return_nodes=False):
     consensus work instead of serializing with it — the same
     dispatch/conclude split the Node's intake API exposes for the
     production prod loop."""
-    nodes, timer = make_sim_pool(NAMES, verifier_name, tracing=tracing)
+    nodes, timer = make_sim_pool(NAMES, verifier_name, tracing=tracing,
+                                 telemetry=telemetry)
 
     target = len(reqs)
     t0 = time.perf_counter()
@@ -516,6 +522,110 @@ def tracing_overhead():
             "host_ms_per_ordered_req"),
         "budget_ordered_reqs": (budget or {}).get("ordered_reqs"),
     }
+
+
+def pool_latency_summary(nodes):
+    """Merge a pool's per-node telemetry hubs → (ordered_p50_ms,
+    ordered_p99_ms, e2e_count) from the intake→reply histograms; Nones
+    when telemetry was off or nothing ordered."""
+    from plenum_tpu.observability.export import pool_telemetry
+    from plenum_tpu.observability.telemetry import TM, merged_snapshot
+    hubs = pool_telemetry(nodes)
+    if not hubs:
+        return None, None, 0
+    snap = merged_snapshot(hubs)
+    h = (snap.get("histograms") or {}).get(TM.ORDERED_E2E_MS) or {}
+    return h.get("p50"), h.get("p99"), h.get("count", 0)
+
+
+def seam_lane_table(hub):
+    """Per-seam lane-occupancy table from a seam hub: {seam: occupancy}
+    plus launch counts — the padding-efficiency trajectory the headline
+    records each round."""
+    if hub is None or not getattr(hub, "enabled", False):
+        return {}
+    out = {}
+    for seam, s in (hub.snapshot().get("seams") or {}).items():
+        out[seam] = {
+            "occupancy": s.get("lane_occupancy"),
+            "launches": s.get("launches"),
+            "useful_rows": s.get("useful_rows"),
+            "lane_rows": s.get("lane_rows"),
+            "compile_events": s.get("compile_events"),
+        }
+    return out
+
+
+def telemetry_overhead():
+    """Telemetry-plane overhead gate: the IDENTICAL 4-node pool +
+    ordering workload with the always-on plane enabled vs disabled —
+    the tracing_overhead methodology (CPU verifier on both sides,
+    interleaved best-of-2). The plane ships ON by default, so this is
+    the number that must stay under 2% (telemetry_overhead_gate) for
+    "always-on" to be honest. The ON run also contributes the 4-node
+    ordered e2e tail (p50/p99)."""
+    from plenum_tpu.crypto.signer import SimpleSigner
+
+    n = int(os.environ.get("BENCH_TELEMETRY_REQS",
+                           str(min(POOL_REQS, 2000))))
+    reqs = make_requests(n, SimpleSigner(seed=b"\x53" * 32))
+    # the device seams record into the PROCESS-wide hub, not the node
+    # hubs — an honest off side must silence that too, or the A/B only
+    # measures the node-hub half of the plane
+    from plenum_tpu.observability.telemetry import (
+        NullTelemetryHub, TelemetryHub, set_seam_hub)
+    original_seam_hub = None
+    off_runs, on_runs = [], []
+    on_nodes = None
+    for _ in range(2):
+        prev = set_seam_hub(NullTelemetryHub(name="device-seams"))
+        if original_seam_hub is None:
+            original_seam_hub = prev
+        off_runs.append(run_pool(reqs, "cpu", telemetry=False))
+        set_seam_hub(TelemetryHub(name="device-seams"))
+        on_elapsed_i, on_ordered_i, on_nodes = run_pool(
+            reqs, "cpu", telemetry=True, return_nodes=True)
+        on_runs.append((on_elapsed_i, on_ordered_i))
+    set_seam_hub(original_seam_hub)
+    off_elapsed, off_ordered = best_of_runs(off_runs, n, "telemetry-off")
+    on_elapsed, on_ordered = best_of_runs(on_runs, n, "telemetry-on")
+    off_rate = off_ordered / off_elapsed
+    on_rate = on_ordered / on_elapsed
+    p50, p99, count = pool_latency_summary(on_nodes or [])
+    return {
+        "reqs": n,
+        "telemetry_req_per_s": round(on_rate, 1),
+        "no_telemetry_req_per_s": round(off_rate, 1),
+        # positive = telemetry costs throughput; slightly negative =
+        # run-to-run jitter on a loaded box
+        "overhead_pct": round(100.0 * (1.0 - on_rate / off_rate), 2),
+        "ordered_p50_ms": p50,
+        "ordered_p99_ms": p99,
+        "e2e_samples": count,
+    }
+
+
+# the always-on claim's hard ceiling: the telemetry plane must cost
+# less than this on the identical-pool A/B or the bench run fails
+TELEMETRY_OVERHEAD_MAX_PCT = 2.0
+
+
+def telemetry_overhead_gate(result, ceiling=None):
+    """HARD gate for the telemetry plane's always-on claim: the
+    measured on/off overhead must stay under TELEMETRY_OVERHEAD_MAX_PCT.
+    Pure function of the telemetry_overhead dict (tier-1 gates the
+    gate in tests/test_bench_gate.py, the merkle_regression_gate
+    precedent); → list of failures. BENCH_TELEMETRY_GATE=warn
+    downgrades main() to warn-only for diagnostic runs on noisy
+    hosts — the headline still records the failures."""
+    ceiling = TELEMETRY_OVERHEAD_MAX_PCT if ceiling is None else ceiling
+    value = result.get("overhead_pct")
+    if value is None:
+        return ["overhead_pct missing from telemetry_overhead"]
+    if value >= ceiling:
+        return ["telemetry_overhead_pct %.2f >= allowed %.2f"
+                % (value, ceiling)]
+    return []
 
 
 def micro_ed25519():
@@ -938,6 +1048,11 @@ def pool25_backlog(provider=None, mesh=True):
     # no client_reply_handler: the headline config skips Reply-payload
     # construction too, keeping the two pools comparable
     provider = provider or "tpu_hub"
+    # fresh process seam hub: this config's lane-occupancy table must
+    # cover THIS workload's launches, not everything since process start
+    from plenum_tpu.observability.telemetry import (
+        TelemetryHub, set_seam_hub)
+    prev_seam_hub = set_seam_hub(TelemetryHub(name="p25-seams"))
     nodes, timer = make_sim_pool(names, provider, seed=25, batch=batch,
                                  mesh=mesh)
     reads_served = [0]
@@ -1004,6 +1119,11 @@ def pool25_backlog(provider=None, mesh=True):
         prefix_t, prefix_n = t, n_ord
     rate_window = prefix_t if not drained and prefix_n else elapsed
     rate_count = prefix_n if not drained else ordered
+    # the serving-tier numbers: ordered-request latency tail (merged
+    # per-node telemetry histograms, wall-clock ms) + per-seam device
+    # lane occupancy for THIS workload (the isolated seam hub)
+    p50, p99, e2e_count = pool_latency_summary(nodes)
+    lanes = seam_lane_table(set_seam_hub(prev_seam_hub))
     return {
         "nodes": n_nodes,
         "backlog": backlog,
@@ -1019,6 +1139,10 @@ def pool25_backlog(provider=None, mesh=True):
         # ordered/wall average would have hidden
         "stalled_tail_s": round(max(0.0, elapsed - rate_window), 1)
         if not drained else 0.0,
+        "ordered_p50_ms": p50,
+        "ordered_p99_ms": p99,
+        "e2e_samples": e2e_count,
+        "lane_occupancy": lanes,
     }
 
 
@@ -1151,6 +1275,11 @@ def bench_recovery():
         "BENCH_REC_FAILOVER_SLO", str(Config.RECOVERY_FAILOVER_SLO_S)))
     catchup_slo = float(os.environ.get(
         "BENCH_REC_CATCHUP_SLO", str(Config.RECOVERY_CATCHUP_SLO_S)))
+
+    # isolated seam hub: recovery's lane table covers THIS scenario
+    from plenum_tpu.observability.telemetry import (
+        TelemetryHub, set_seam_hub)
+    prev_seam_hub = set_seam_hub(TelemetryHub(name="recovery-seams"))
 
     timer = MockTimer()
     timer.set_time(SIM_EPOCH)
@@ -1292,6 +1421,14 @@ def bench_recovery():
                if nd.tracer.stats().get("dropped", 0) > 0]
     if wrapped:
         out["trace_events"]["ring_wrapped_nodes"] = len(wrapped)
+    # recovery's serving numbers ride along: ordered-latency tail under
+    # failover/churn (what clients actually experienced) + the seam
+    # lane table for the scenario's device work
+    p50, p99, e2e_count = pool_latency_summary(nodes)
+    out["ordered_p50_ms"] = p50
+    out["ordered_p99_ms"] = p99
+    out["e2e_samples"] = e2e_count
+    out["lane_occupancy"] = seam_lane_table(set_seam_hub(prev_seam_hub))
     out["slo_ok"] = not violations
     if violations:
         out["violations"] = violations
@@ -1569,6 +1706,8 @@ def main():
     cpu_rate = cpu_ordered / cpu_elapsed
 
     tracing = tracing_overhead()
+    telemetry = telemetry_overhead()
+    telemetry_gate_failures = telemetry_overhead_gate(telemetry)
     recovery = bench_recovery()
 
     (device_rate, device_rate_median, ed_single_shot, ed_single_shot_med,
@@ -1625,6 +1764,7 @@ def main():
             "state": state_res,
             "pool25_backlog": p25,
             "tracing_overhead": tracing,
+            "telemetry_overhead": telemetry,
             "recovery": recovery,
         },
     }))
@@ -1666,6 +1806,22 @@ def main():
             "tracing_overhead_pct": tracing["overhead_pct"],
             "host_ms_per_ordered_req": tracing.get(
                 "host_ms_per_ordered_req"),
+            # serving-tier tail + device-efficiency trajectory (PR 10):
+            # p50/p99 from the 25-node backlog config's merged hubs,
+            # compact per-seam occupancy, and the always-on plane's
+            # hard-gated A/B cost
+            "ordered_p50_ms": p25.get("ordered_p50_ms")
+            if isinstance(p25, dict) else None,
+            "ordered_p99_ms": p25.get("ordered_p99_ms")
+            if isinstance(p25, dict) else None,
+            "lane_occupancy": {
+                seam: entry.get("occupancy")
+                for seam, entry in sorted(
+                    (p25.get("lane_occupancy") or {}).items())}
+            if isinstance(p25, dict) else None,
+            "telemetry_overhead_pct": telemetry["overhead_pct"],
+            "telemetry_gate_ok": not telemetry_gate_failures,
+            "telemetry_gate_failures": telemetry_gate_failures or None,
             "mesh_devices": mesh_res["devices"],
             "mesh_overhead_pct": mesh_res.get(
                 "single_device_overhead_pct"),
@@ -1676,12 +1832,17 @@ def main():
             "recovery_slo_ok": recovery.get("slo_ok"),
         }
     }, separators=(",", ":")))
-    # HARD merkle regression gate — after the headline print so the
-    # numbers always survive the driver's stdout truncation, but a
-    # failed gate still fails the run (merkle_regression_gate)
+    # HARD gates — after the headline print so the numbers always
+    # survive the driver's stdout truncation, but a failed gate still
+    # fails the run (merkle_regression_gate / telemetry_overhead_gate)
     if mk_gate_failures and os.environ.get("BENCH_MERKLE_GATE") != "warn":
         print("MERKLE REGRESSION GATE FAILED: "
               + "; ".join(mk_gate_failures), file=sys.stderr)
+        sys.exit(2)
+    if telemetry_gate_failures \
+            and os.environ.get("BENCH_TELEMETRY_GATE") != "warn":
+        print("TELEMETRY OVERHEAD GATE FAILED: "
+              + "; ".join(telemetry_gate_failures), file=sys.stderr)
         sys.exit(2)
 
 

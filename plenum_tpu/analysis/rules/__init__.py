@@ -23,6 +23,8 @@ from plenum_tpu.analysis.rules.pt007_fixed_retry_timer import (
     FixedRetryTimerRule)
 from plenum_tpu.analysis.rules.pt008_per_item_hot_loop import (
     PerItemHotLoopRule)
+from plenum_tpu.analysis.rules.pt009_metric_cardinality import (
+    UnboundedMetricCardinalityRule)
 
 RULE_CLASSES = (
     BlockingCallRule,
@@ -33,6 +35,7 @@ RULE_CLASSES = (
     BroadExceptOnDevicePathRule,
     FixedRetryTimerRule,
     PerItemHotLoopRule,
+    UnboundedMetricCardinalityRule,
 )
 
 

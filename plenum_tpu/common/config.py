@@ -289,6 +289,31 @@ class Config:
     TRACING_ENABLED = False
     TRACING_BUFFER_SPANS = 1 << 16   # ring slots per node; newest kept
 
+    # ---- telemetry plane (observability/telemetry.py): always-on
+    # latency histograms (p50/p95/p99/p999 on the ordered money path),
+    # device-efficiency lane accounting at every bucket-padding
+    # dispatch seam, and pool-health gauges. ON by default — bench.py
+    # telemetry_overhead A/Bs the identical pool with it off and gates
+    # the cost under 2% (BENCH_TELEMETRY_GATE).
+    TELEMETRY_ENABLED = True
+    TELEMETRY_FLUSH_INTERVAL_S = 10   # gauge sample + prom write period
+    # directory for per-node Prometheus text exposition files
+    # (<dir>/<node>.prom, rewritten atomically per flush); None = none
+    TELEMETRY_PROM_DIR = None
+    # log-linear histogram shape: `sub` linear sub-buckets per
+    # power-of-two octave bounds quantile relative error to 1/sub
+    # (6.25% at 16); 30 octaves from 1 µs cover ~18 minutes
+    TELEMETRY_HIST_LO_MS = 0.001
+    TELEMETRY_HIST_OCTAVES = 30
+    TELEMETRY_HIST_SUB_BUCKETS = 16
+    # intake-timestamp map cap: e2e latency tracking stops (and counts
+    # TM.E2E_DROPPED) past this many in-flight requests
+    TELEMETRY_PENDING_MAX = 1 << 17
+    # flush-history ring (Perfetto counter tracks) + per-seam distinct
+    # bucket-shape set cap (compile-event accounting)
+    TELEMETRY_FLUSH_HISTORY = 512
+    TELEMETRY_SHAPE_CAP = 4096
+
     # ---- plugins (reference plenum/config.py:164
     # notifierEventTriggeringConfig + SpikeEventsEnabled; plugin dirs
     # from plenum/server/plugin_loader.py usage)

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from plenum_tpu.common.config import Config
 from plenum_tpu.observability.tracing import CAT_3PC, NullTracer
+from plenum_tpu.observability.telemetry import TM, NullTelemetryHub
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 from plenum_tpu.common.constants import AUDIT_LEDGER_ID, DOMAIN_LEDGER_ID
 from plenum_tpu.common.messages.internal_messages import (
@@ -169,6 +170,11 @@ class OrderingService:
         self._config = config or Config()
         self.metrics = NullMetricsCollector()  # node injects the real one
         self.tracer = NullTracer()             # node injects the real one
+        self.telemetry = NullTelemetryHub()    # node injects the real one
+        # (view, ppSeqNo) -> perf_counter at first PP create/process:
+        # the 3PC-stage latency histogram's start marks (popped at
+        # order; cleared wholesale on view change / catchup)
+        self._tm_3pc_t0: Dict[Tuple[int, int], float] = {}
         # a PRE-PREPARE carries ~72 wire bytes per request digest; a
         # batch big enough to push it past the transport frame limit
         # would be dropped by the stack and wedge ordering at the first
@@ -363,6 +369,9 @@ class OrderingService:
         self.metrics.add_event(MetricsName.THREE_PC_BATCH_SIZE,
                                len(digests))
         pp_seq_no = self.lastPrePrepareSeqNo + 1
+        if self.telemetry.enabled:
+            self._tm_3pc_t0[(self.view_no, pp_seq_no)] = \
+                self.telemetry.clock()
         pp_time = self._get_time()
         pp_digest = self.generate_pp_digest(digests, self.view_no, pp_time)
         state_root, txn_root, audit_root = self._executor.apply_batch(
@@ -517,6 +526,13 @@ class OrderingService:
                 return (DISCARD, "audit root mismatch")
         self.prePrepares[key] = pp
         self.batches[key] = pp
+        # 3PC-stage start mark ONLY for a fully validated, accepted
+        # PRE-PREPARE (an earlier pre-validation stamp let any peer
+        # grow the map with garbage keys); the watermark-window cap is
+        # a backstop against a byzantine primary spraying future seqs
+        if self.telemetry.enabled and \
+                len(self._tm_3pc_t0) <= self._config.LOG_SIZE * 2:
+            self._tm_3pc_t0.setdefault(key, self.telemetry.clock())
         self.lastPrePrepareSeqNo = max(self.lastPrePrepareSeqNo, pp.ppSeqNo)
         if self.is_master and not already_ordered:
             self._last_applied_seq = pp.ppSeqNo
@@ -898,6 +914,10 @@ class OrderingService:
 
     def _order_inner(self, pp: PrePrepare):
         key = (pp.viewNo, pp.ppSeqNo)
+        t0 = self._tm_3pc_t0.pop(key, None)
+        if t0 is not None:
+            self.telemetry.observe(TM.STAGE_3PC_MS,
+                                   (self.telemetry.clock() - t0) * 1e3)
         self.ordered.add(key)
         self._data.last_ordered_3pc = key
         self._consume_from_queue(pp)
@@ -995,6 +1015,8 @@ class OrderingService:
         self._prepare_vote_count.clear()
         self._commit_vote_count.clear()
         self.batches.clear()
+        # stale 3PC-latency start marks die with the view's vote state
+        self._tm_3pc_t0.clear()
 
     def process_new_view_checkpoints_applied(
             self, msg: NewViewCheckpointsApplied):
@@ -1164,7 +1186,8 @@ class OrderingService:
                     self.add_finalized_request(digest, pp.ledgerId)
         for store in (self.sent_preprepares, self.prePrepares,
                       self.prepares, self.commits, self.batches,
-                      self._prepare_vote_count, self._commit_vote_count):
+                      self._prepare_vote_count, self._commit_vote_count,
+                      self._tm_3pc_t0):
             for k in [k for k in store if k[1] > last]:
                 del store[k]
         # the dropped batches must not be advertised as prepared evidence
@@ -1184,7 +1207,8 @@ class OrderingService:
         stable_seq = msg.last_stable_3pc[1]
         for store in (self.sent_preprepares, self.prePrepares,
                       self.prepares, self.commits, self.batches,
-                      self._prepare_vote_count, self._commit_vote_count):
+                      self._prepare_vote_count, self._commit_vote_count,
+                      self._tm_3pc_t0):
             for key in [k for k in store if k[1] <= stable_seq]:
                 del store[key]
         self.ordered = {k for k in self.ordered if k[1] > stable_seq}

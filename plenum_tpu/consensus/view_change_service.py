@@ -33,6 +33,7 @@ from plenum_tpu.common.serializers.serialization import serialize_msg_for_signin
 from plenum_tpu.consensus.batch_id import BatchID, batch_id_from
 from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
 from plenum_tpu.observability.tracing import CAT_RECOVERY, NullTracer
+from plenum_tpu.observability.telemetry import TM, NullTelemetryHub
 from plenum_tpu.consensus.primary_selector import (
     RoundRobinConstantNodesPrimariesSelector)
 from plenum_tpu.runtime.stashing_router import DISCARD, StashingRouter
@@ -177,6 +178,7 @@ class ViewChangeService:
         self._config = config or Config()
         self.metrics = NullMetricsCollector()  # node injects the real one
         self.tracer = NullTracer()             # node injects the real one
+        self.telemetry = NullTelemetryHub()    # node injects the real one
         # consecutive FAILED view changes (NEW_VIEW timeout or computed
         # mismatch) since the last completed one: each failure doubles
         # the next NEW_VIEW wait up to NEW_VIEW_TIMEOUT_MAX (PBFT-style
@@ -273,6 +275,9 @@ class ViewChangeService:
         self.tracer.instant("view_change_start", CAT_RECOVERY,
                             key=str(proposed_view_no),
                             timeout=self.new_view_timeout())
+        # pool-health bridge: view changes become a counted telemetry
+        # trajectory, not just recovery-lane instants
+        self.telemetry.count(TM.VIEW_CHANGES)
         # tell ordering to revert uncommitted + archive old-view PPs
         self._bus.send(ViewChangeStarted(view_no=proposed_view_no))
         vc = self._build_view_change_msg()

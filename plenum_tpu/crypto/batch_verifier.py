@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import List, Sequence, Tuple
 
 from plenum_tpu.observability.tracing import CAT_DEVICE, NullTracer
+from plenum_tpu.observability import telemetry as _telemetry
 
 VerifyItem = Tuple[bytes, bytes, bytes]  # (message, signature64, verkey32)
 
@@ -204,6 +205,8 @@ class _HubGeneration:
         self.pending = None
         self._results = None
         self._index = None  # per-item slot in the deduped launch
+        self._tm_device = False     # launched on the device path
+        self._tm_new_shape = False  # that launch compiled a new bucket
 
     def dedup(self) -> List[VerifyItem]:
         order, self._index = dedup_items(self.items)
@@ -211,7 +214,17 @@ class _HubGeneration:
 
     def results(self) -> List[bool]:
         if self._results is None:
-            res = self.pending.collect()
+            if self._tm_device:
+                # the materialization below IS this generation's
+                # dispatch→collect round trip as the host sees it
+                hub = _telemetry.get_seam_hub()
+                t0 = hub.clock()
+                res = self.pending.collect()
+                hub.record_roundtrip(
+                    _telemetry.SEAM_HUB, (hub.clock() - t0) * 1e3,
+                    first_call=self._tm_new_shape)
+            else:
+                res = self.pending.collect()
             idx = self._index
             self._results = res if idx is None \
                 else [res[i] for i in idx]
@@ -280,6 +293,16 @@ class CoalescingVerifierHub:
                 # rather than paying a full device launch
                 gen.pending = self._scalar.dispatch(launch_items)
             else:
+                # hub-seam lane accounting: unique items launched vs the
+                # bucket the async verify pads them into (the SAME
+                # pow2/mesh bucket math the launch pays — single-sourced
+                # in ed25519_jax.launch_lanes)
+                from plenum_tpu.ops.ed25519_jax import launch_lanes
+                lanes = launch_lanes(len(launch_items))
+                gen._tm_device = True
+                gen._tm_new_shape = _telemetry.get_seam_hub() \
+                    .record_launch(_telemetry.SEAM_HUB,
+                                   len(launch_items), lanes, shape=lanes)
                 gen.pending = self._batch.dispatch(launch_items)
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:

@@ -1,11 +1,18 @@
-"""Consensus flight recorder — span tracing + Perfetto export.
+"""Observability plane — accumulators ride utils/metrics; this package
+owns the other two instruments (docs/observability.md):
 
 `tracing` owns the per-node ring-buffer Tracer (and the free NullTracer
 the rest of the codebase holds by default); `export` turns any set of
 tracers into one Chrome trace-event (Perfetto-loadable) timeline with a
-"pid" row per node and a track per span category. docs/observability.md
-explains the span model and how to read the merged timeline.
+"pid" row per node and a track per span category; `telemetry` is the
+always-on plane — latency histograms (p50/p99 on the ordered money
+path), device-efficiency lane accounting at every bucket-padding
+dispatch seam, pool-health gauges, Prometheus exposition; `budget`
+turns recorded spans into per-stage host-ms budgets.
 """
 from plenum_tpu.observability.tracing import (  # noqa: F401
     CAT_3PC, CAT_BLS, CAT_DEVICE, CAT_EXECUTE, CAT_INTAKE, CAT_PROPAGATE,
     CAT_REPLY, NullTracer, Tracer)
+from plenum_tpu.observability.telemetry import (  # noqa: F401
+    TM, LogLinearHistogram, NullTelemetryHub, TelemetryHub,
+    get_seam_hub, merged_snapshot, prometheus_text, set_seam_hub)

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from plenum_tpu.observability.telemetry import TM as _TM
+
 # stage order is the money-path order; reports preserve it
 STAGES = ("intake", "propagate", "3pc", "dispatch_wait", "execute",
           "reply")
@@ -151,19 +153,66 @@ def _report(per_node: List[Dict[str, float]], ordered: List[int]) -> dict:
     }
 
 
-def format_table(report: dict) -> str:
-    """Human-readable per-stage table (the ``trace_budget`` CLI)."""
-    lines = ["%-14s %14s %18s %6s" % (
-        "stage", "host-ms/node", "ms/ordered-req", "share")]
+# telemetry stage-latency histogram feeding each budget stage's
+# measured-p99 column (observability/telemetry.py TM names): the
+# budget's exclusive-ms MEANS say where host time goes; the telemetry
+# p99 next to them says what the TAIL of that stage looks like — a
+# stage can be cheap on average and still own the latency SLO miss
+_STAGE_TELEMETRY = {
+    "propagate": _TM.STAGE_PROPAGATE_MS,
+    "3pc": _TM.STAGE_3PC_MS,
+    "dispatch_wait": _TM.STAGE_DISPATCH_MS,
+    "execute": _TM.STAGE_EXECUTE_MS,
+    "reply": _TM.STAGE_REPLY_MS,
+}
+
+
+def stage_p99s(telemetry_snapshot: Optional[dict]) -> Dict[str, float]:
+    """Per-budget-stage measured p99 (ms) out of a telemetry snapshot
+    (hub.snapshot() / the validator-info Telemetry section); stages
+    without a telemetry histogram are absent."""
+    if not telemetry_snapshot:
+        return {}
+    hists = telemetry_snapshot.get("histograms") or {}
+    out: Dict[str, float] = {}
+    for stage, metric in _STAGE_TELEMETRY.items():
+        p99 = (hists.get(metric) or {}).get("p99")
+        if p99 is not None:
+            out[stage] = p99
+    return out
+
+
+def format_table(report: dict, telemetry_snapshot: dict = None) -> str:
+    """Human-readable per-stage table (the ``trace_budget`` CLI). With
+    a telemetry snapshot, each stage's measured p99 latency prints next
+    to its exclusive-ms mean — budget and tail read together."""
+    p99s = stage_p99s(telemetry_snapshot)
+    header = "%-14s %14s %18s %6s" % (
+        "stage", "host-ms/node", "ms/ordered-req", "share")
+    if p99s:
+        header += " %12s" % "p99-ms"
+    lines = [header]
     per_req = report["host_ms_per_ordered_req"]
     total = per_req.get("total") or 0.0
     for stage in STAGES:
         share = (per_req[stage] / total * 100.0) if total else 0.0
-        lines.append("%-14s %14.2f %18.4f %5.1f%%" % (
+        line = "%-14s %14.2f %18.4f %5.1f%%" % (
             stage, report["stage_ms_per_node"][stage], per_req[stage],
-            share))
+            share)
+        if p99s:
+            line += " %12s" % (("%.3f" % p99s[stage])
+                               if stage in p99s else "-")
+        lines.append(line)
     lines.append("%-14s %14s %18.4f" % (
         "total", "", total))
+    if p99s and telemetry_snapshot:
+        e2e = ((telemetry_snapshot.get("histograms") or {})
+               .get(_TM.ORDERED_E2E_MS) or {})
+        if e2e.get("p99") is not None:
+            lines.append("ordered e2e: p50=%.3f ms  p99=%.3f ms  "
+                         "(telemetry, n=%d)" % (
+                             e2e.get("p50") or 0.0, e2e["p99"],
+                             e2e.get("count", 0)))
     lines.append("nodes=%d ordered_reqs=%d" % (
         report["nodes"], report["ordered_reqs"]))
     return "\n".join(lines)

@@ -27,9 +27,13 @@ import json
 from typing import Iterable, List, Optional
 
 
-def trace_events(tracers: Iterable) -> List[dict]:
+def trace_events(tracers: Iterable, telemetry: Iterable = ()) -> List[dict]:
     """→ Trace Event Format event list (metadata first, then the
-    time-sorted merged timeline)."""
+    time-sorted merged timeline). ``telemetry`` hubs
+    (observability/telemetry.py) contribute their flush-history samples
+    as counter tracks — histogram p50/p99, pool-health gauges and
+    per-seam lane occupancy line up on the same perf_counter time axis
+    as the spans, one "telemetry" lane per hub."""
     meta: List[dict] = []
     timeline: List[dict] = []
     pid_of: dict = {}
@@ -72,22 +76,58 @@ def trace_events(tracers: Iterable) -> List[dict]:
                 timeline.append({
                     "name": name, "ph": "C", "pid": pid, "tid": tid,
                     "ts": ts, "args": payload})
+    for hub in telemetry or ():
+        if hub is None or not getattr(hub, "enabled", False):
+            continue
+        history = hub.flush_history()
+        if not history:
+            continue
+        pname = hub.name or "telemetry"
+        pid = pid_of.get(pname)
+        if pid is None:
+            pid = pid_of[pname] = len(pid_of) + 1
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "tid": 0, "args": {"name": pname}})
+        # one dedicated counter lane per hub, after any span tracks the
+        # same pid already claimed
+        tid = 1000
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": "telemetry"}})
+        for t, sample in history:
+            ts = int(round(t * 1e6))
+            for name in sorted(sample):
+                timeline.append({
+                    "name": name, "ph": "C", "pid": pid, "tid": tid,
+                    "ts": ts, "args": {name: sample[name]}})
     timeline.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"]))
     return meta + timeline
 
 
-def chrome_trace(tracers: Iterable) -> dict:
+def chrome_trace(tracers: Iterable, telemetry: Iterable = ()) -> dict:
     """→ the full JSON-object trace document."""
-    return {"traceEvents": trace_events(tracers),
+    return {"traceEvents": trace_events(tracers, telemetry=telemetry),
             "displayTimeUnit": "ms"}
 
 
-def export_chrome_trace(tracers: Iterable, path: str) -> str:
+def export_chrome_trace(tracers: Iterable, path: str,
+                        telemetry: Iterable = ()) -> str:
     """Write the merged timeline to `path`; → path."""
-    doc = chrome_trace(tracers)
+    doc = chrome_trace(tracers, telemetry=telemetry)
     with open(path, "w") as f:
         json.dump(doc, f)
     return path
+
+
+def pool_telemetry(nodes: Iterable) -> List:
+    """Collect every node's TelemetryHub (skipping nodes without one or
+    with telemetry off) — the counter-track set for a pool timeline and
+    the merge set for pool-wide snapshots."""
+    out = []
+    for node in nodes:
+        hub = getattr(node, "telemetry", None)
+        if hub is not None and getattr(hub, "enabled", False):
+            out.append(hub)
+    return out
 
 
 def pool_tracers(nodes: Iterable) -> List:

@@ -51,6 +51,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from plenum_tpu.observability import telemetry as _tmy
+
 # ---------------------------------------------------------------- constants
 
 NLIMB = 32
@@ -447,6 +449,11 @@ def aggregate_dispatch(jobs, n: int):
     m = mesh_mod.get_mesh()
     sharded = m.should_shard(B)
     Bp = m.padded_size(B, min_per_device=1) if sharded else B
+    # job-axis lane accounting: real shares vs the Bp×n identity-padded
+    # grid (short jobs pad with infinity shares, padding jobs are whole
+    # wasted rows)
+    _tmy.get_seam_hub().record_launch(
+        _tmy.SEAM_BLS, sum(len(j) for j in jobs), Bp * n, shape=(Bp, n))
     raw = np.zeros((Bp, n, 48), dtype=np.uint8)
     raw[:, :, 0] = 0xC0
     for i, job in enumerate(jobs):

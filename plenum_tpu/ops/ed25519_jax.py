@@ -41,6 +41,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from plenum_tpu.observability import telemetry as _tmy
+
 # ---------------------------------------------------------------- constants
 
 NLIMB = 20
@@ -650,6 +652,24 @@ def verify_batch(msgs: Sequence[bytes], sigs: Sequence[bytes],
     return np.asarray(ok_dev)[:n] & valid
 
 
+def launch_lanes(n: int) -> int:
+    """The padded batch-lane count a verify_batch_async(n) launch will
+    occupy: the mesh bucket when the batch shards, the power-of-two
+    (min 8) single-device bucket otherwise. Single-sourced so callers
+    that account lane occupancy for their OWN seam (the coalescing hub)
+    report the same bucket the launch actually pays for."""
+    if n <= 0:
+        return 0
+    from plenum_tpu.ops import mesh as mesh_mod
+    m = mesh_mod.get_mesh()
+    if m.should_shard(n):
+        return m.padded_size(n)
+    padded = 8
+    while padded < n:
+        padded *= 2
+    return padded
+
+
 def verify_batch_async(msgs: Sequence[bytes], sigs: Sequence[bytes],
                        verkeys: Sequence[bytes]):
     """Non-blocking batched verify: enqueues the device computation and
@@ -667,21 +687,21 @@ def verify_batch_async(msgs: Sequence[bytes], sigs: Sequence[bytes],
     arrays, valid = host_pack(msgs, sigs, verkeys)
     from plenum_tpu.ops import mesh as mesh_mod
     m = mesh_mod.get_mesh()
+    padded = launch_lanes(n)
+    _tmy.get_seam_hub().record_launch(
+        _tmy.SEAM_ED25519, n, padded, shape=padded)
     if m.should_shard(n):
         # the mesh path runs the XLA kernel: it SPMD-partitions over the
         # batch axis with no code change, whereas the Pallas kernel is a
         # per-chip program (its per-device halves still run the winning
         # tile grid when each shard fills a block)
-        arrays = mesh_mod.pad_rows(arrays, m.padded_size(n))
+        arrays = mesh_mod.pad_rows(arrays, padded)
         ok = m.dispatch(_verify_kernel, arrays, n=n)
         return ok, valid, n
     m.note_passthrough(n)
     # pad the batch axis to the next power of two (min 8) by repeating
     # row 0 so every size in [1, 2^k] shares one compiled kernel —
     # variable pool queue depths must not trigger XLA recompiles
-    padded = 8
-    while padded < n:
-        padded *= 2
     if padded != n:
         arrays = [np.concatenate(
             [a, np.repeat(a[:1], padded - n, axis=0)], axis=0)

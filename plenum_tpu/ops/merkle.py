@@ -61,6 +61,10 @@ import jax
 import jax.numpy as jnp
 
 from plenum_tpu.observability.tracing import CAT_DEVICE
+from plenum_tpu.observability.telemetry import (
+    SEAM_MERKLE_APPEND as _TM_SEAM_APPEND,
+    SEAM_MERKLE_BUILD as _TM_SEAM_BUILD,
+    get_seam_hub as _get_telemetry)
 from plenum_tpu.ops import pow2_at_least as _pow2_at_least
 from plenum_tpu.ops.sha256 import (
     _sha256_blocks, compress_blocks, digests_to_array, pad_messages,
@@ -441,6 +445,8 @@ class DeviceMerkleTree:
                 words = jnp.asarray(host_words)
                 nvalid = jnp.asarray(host_nvalid)
         def launch(be):
+            _get_telemetry().record_launch(
+                _TM_SEAM_BUILD, n, padded, shape=(padded, nblocks))
             if shard:
                 return _to_default_device(dm.dispatch(
                     lambda w, nv: _build_levels(w, nv, nblocks, depth, be),
@@ -475,6 +481,8 @@ class DeviceMerkleTree:
         shard = dm.should_shard(padded) and padded % dm.n_devices == 0
 
         def launch(be):
+            _get_telemetry().record_launch(
+                _TM_SEAM_BUILD, n, padded, shape=(padded, 1))
             if shard:
                 return _to_default_device(dm.dispatch(
                     lambda a: _build_levels_from_digest_bytes(a, depth, be),
@@ -571,6 +579,8 @@ class DeviceMerkleTree:
             arr_up[:b] = arr
         else:
             arr_up = arr
+        _tm_hub = _get_telemetry()
+        _tm_hub.record_launch(_TM_SEAM_APPEND, b, bucket0, shape=bucket0)
         with self.tracer.span("merkle_append_dispatch", CAT_DEVICE,
                               levels=0, n=b):
             self._levels[0] = _place(
@@ -593,6 +603,9 @@ class DeviceMerkleTree:
                 break
             if len(group) == 1:
                 level, p0, cnt = group[0]
+                _tm_hub.record_launch(_TM_SEAM_APPEND, cnt,
+                                      _pow2_at_least(cnt),
+                                      shape=_pow2_at_least(cnt))
                 with self.tracer.span("merkle_append_dispatch",
                                       CAT_DEVICE, levels=1, n=cnt):
                     self._levels[level], dig = _append_level_step(
@@ -606,6 +619,9 @@ class DeviceMerkleTree:
                                   dtype=jnp.int32)
                 cnts = jnp.asarray([c for _, _, c in group],
                                    dtype=jnp.int32)
+                _tm_hub.record_launch(_TM_SEAM_APPEND,
+                                      sum(c for _, _, c in group),
+                                      sum(buckets), shape=buckets)
                 with self.tracer.span("merkle_append_dispatch",
                                       CAT_DEVICE, levels=len(group),
                                       n=int(group[0][2])):

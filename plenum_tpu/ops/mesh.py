@@ -50,6 +50,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from plenum_tpu.observability.tracing import CAT_DEVICE, NullTracer
+from plenum_tpu.observability import telemetry as _telemetry
 
 # --------------------------------------------------------- capability probe
 
@@ -322,6 +323,11 @@ class DeviceMesh:
         b = int(np.shape(arrays[0])[0])
         d = self.n_devices
         per = b // d
+        # lane accounting: every padded row is a launched-but-wasted
+        # device lane; the (padded, devices) pair is the SPMD compile
+        # shape, so a new one is a compile event
+        _telemetry.get_seam_hub().record_launch(
+            _telemetry.SEAM_MESH, b if n is None else n, b, shape=(b, d))
         with self.tracer.span(label, CAT_DEVICE, n=b if n is None else n,
                               padded=b, devices=d, per_device=per):
             outs = fn(*self.put_sharded(arrays))

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from plenum_tpu.observability import telemetry as _tmy
 from plenum_tpu.ops import scatter_ragged_rows
 
 logger = logging.getLogger(__name__)
@@ -299,7 +300,14 @@ def pad_messages(msgs: Sequence[bytes], nblocks: int = None
              | words[..., 1].astype(np.uint32) << 16
              | words[..., 2].astype(np.uint32) << 8
              | words[..., 3].astype(np.uint32))
-    return words, np.asarray(need, dtype=np.int32), nblocks
+    nvalid = np.asarray(need, dtype=np.int32)
+    # block-lane accounting: every message occupies a full `nblocks`
+    # row on device but only `need` of its blocks do compression work —
+    # the bucket's wasted compressions are this seam's padding
+    _tmy.get_seam_hub().record_launch(
+        _tmy.SEAM_SHA256, int(nvalid.sum()), len(msgs) * nblocks,
+        shape=(len(msgs), nblocks))
+    return words, nvalid, nblocks
 
 
 def digests_to_bytes(dig: np.ndarray) -> List[bytes]:

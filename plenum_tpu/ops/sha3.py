@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from plenum_tpu.observability import telemetry as _tmy
 from plenum_tpu.ops import scatter_ragged_rows
 
 RATE_BYTES = 136          # SHA3-256: r = 1088 bits
@@ -204,7 +205,13 @@ def pad_sha3_messages(msgs: Sequence[bytes], nblocks: int = None
     # little-endian u32 halves: [..., 0] = lo word, [..., 1] = hi word
     words = (words[..., 0] | words[..., 1] << 8 | words[..., 2] << 16
              | words[..., 3] << 24)
-    return words, np.asarray(need, dtype=np.int32), nblocks
+    nvalid = np.asarray(need, dtype=np.int32)
+    # block-lane accounting (mirror of sha256.pad_messages): absorbs
+    # beyond a message's `need` blocks are wasted bucket lanes
+    _tmy.get_seam_hub().record_launch(
+        _tmy.SEAM_SHA3, int(nvalid.sum()), n * nblocks,
+        shape=(n, nblocks))
+    return words, nvalid, nblocks
 
 
 def digests_to_array(dig: np.ndarray) -> np.ndarray:

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from plenum_tpu.observability import telemetry as _tmy
 from plenum_tpu.ops import pow2_at_least as _pow2_at_least
 from plenum_tpu.ops.sha3 import (
     _sha3_blocks, digests_to_array, digests_to_bytes, pad_sha3_messages)
@@ -39,6 +40,14 @@ from plenum_tpu.ops.sha3 import (
 def _get_mesh():
     from plenum_tpu.ops import mesh as mesh_mod
     return mesh_mod.get_mesh()
+
+
+def _record_level_lanes(b: int, bp: int, nblocks: int) -> None:
+    """Lane accounting for one MPT level dispatch: b real node blobs
+    launched on bp batch lanes (power-of-two / mesh bucket); the
+    (bp, nblocks) pair is the compile-relevant Keccak shape."""
+    _tmy.get_seam_hub().record_launch(
+        _tmy.SEAM_TRIE, b, bp, shape=(bp, nblocks))
 
 
 def _pad_single(arrays, b: int):
@@ -75,12 +84,14 @@ def dispatch_node_hash_batch(blobs: Sequence[bytes]):
     if dm.should_shard(b):
         from plenum_tpu.ops.mesh import pad_rows
         bp = dm.padded_size(b)
+        _record_level_lanes(b, bp, nblocks)
         w, nv = pad_rows([words, nvalid], bp)
         dig = dm.dispatch(
             lambda ww, nn: _sha3_blocks(ww, nn, nblocks), [w, nv],
             n=b, label="state_sha3")
     else:
         dm.note_passthrough(b)
+        _record_level_lanes(b, _pow2_at_least(b), nblocks)
         words, nvalid = _pad_single([words, nvalid], b)
         dig = _sha3_blocks(jnp.asarray(words), jnp.asarray(nvalid),
                            nblocks)
@@ -108,12 +119,14 @@ def dispatch_node_verify_batch(blobs: Sequence[bytes],
     if dm.should_shard(b):
         from plenum_tpu.ops.mesh import pad_rows
         bp = dm.padded_size(b)
+        _record_level_lanes(b, bp, nblocks)
         w, nv, e = pad_rows([words, nvalid, exp], bp)
         ok = dm.dispatch(
             lambda ww, nn, ee: _sha3_blocks_eq(ww, nn, ee, nblocks),
             [w, nv, e], n=b, label="state_sha3_verify")
     else:
         dm.note_passthrough(b)
+        _record_level_lanes(b, _pow2_at_least(b), nblocks)
         words, nvalid, exp = _pad_single([words, nvalid, exp], b)
         ok = _sha3_blocks_eq(jnp.asarray(words), jnp.asarray(nvalid),
                              jnp.asarray(exp), nblocks)

@@ -16,6 +16,7 @@ from plenum_tpu.common.request import Request
 from plenum_tpu.consensus.ordering_service import BatchExecutor
 from plenum_tpu.observability.tracing import (
     CAT_DEVICE, CAT_EXECUTE, NullTracer)
+from plenum_tpu.observability.telemetry import TM, NullTelemetryHub
 from plenum_tpu.server.three_pc_batch import ThreePcBatch
 from plenum_tpu.server.write_request_manager import WriteRequestManager
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
@@ -46,6 +47,7 @@ class NodeBatchExecutor(BatchExecutor):
         self._requests_source = requests_source
         self.metrics = NullMetricsCollector()  # node injects the real one
         self.tracer = NullTracer()             # node injects the real one
+        self.telemetry = NullTelemetryHub()    # node injects the real one
         self._get_view_no = get_view_no or (lambda: 0)
         self._primaries_for_view = primaries_for_view or (lambda v: [])
         self._get_pp_seq_no = get_pp_seq_no
@@ -74,6 +76,7 @@ class NodeBatchExecutor(BatchExecutor):
                     pp_time: int, pp_digest: str = "",
                     original_view_no: int = None) -> Tuple[str, str, str]:
         with self.metrics.measure_time(MetricsName.BATCH_APPLY_TIME), \
+                self.telemetry.timer(TM.STAGE_EXECUTE_MS), \
                 self.tracer.span("batch_apply", CAT_EXECUTE,
                                  key=pp_digest or None,
                                  batch_size=len(pre_prepare_digests),
@@ -130,7 +133,8 @@ class NodeBatchExecutor(BatchExecutor):
             # round trip where the per-message path serialized them.
             # Results are bit-identical: the three streams touch
             # disjoint structures and each collect point is unchanged.
-            with self.tracer.span(
+            with self.telemetry.timer(TM.STAGE_DISPATCH_MS), \
+                    self.tracer.span(
                     "fused_dispatch", CAT_DEVICE, key=pp_digest or None,
                     groups=len(staged), batch_size=len(valid)):
                 in_flight = [
@@ -193,6 +197,7 @@ class NodeBatchExecutor(BatchExecutor):
 
     def commit_batch(self, ordered: Ordered):
         with self.metrics.measure_time(MetricsName.BATCH_COMMIT_TIME), \
+                self.telemetry.timer(TM.STAGE_COMMIT_MS), \
                 self.tracer.span(
                     "batch_commit", CAT_EXECUTE,
                     key="%d:%d" % (ordered.viewNo, ordered.ppSeqNo),

@@ -18,6 +18,7 @@ audit txn) → Reply{txn + audit path} to client.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Callable, Dict, List, Optional, Tuple
 
 from plenum_tpu.common.config import Config
@@ -55,6 +56,8 @@ from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
 _fp = try_load_ext("fastpath")
 from plenum_tpu.observability.tracing import (
     CAT_DEVICE, CAT_INTAKE, CAT_RECOVERY, CAT_REPLY, NullTracer, Tracer)
+from plenum_tpu.observability.telemetry import (
+    TM, NullTelemetryHub, TelemetryHub)
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
@@ -175,6 +178,18 @@ class Node:
                             capacity=self.config.TRACING_BUFFER_SPANS) \
                 if self.config.TRACING_ENABLED else NullTracer(name)
         self.tracer = tracer
+        # always-on telemetry plane (observability/telemetry.py): one
+        # hub per node — latency histograms on the ordered money path,
+        # pool-health gauges, recovery counters. Device-seam lane
+        # accounting lands in the process-wide seam hub instead (the
+        # seams are shared across co-resident nodes, like the mesh).
+        self.telemetry = TelemetryHub(name=name) \
+            if getattr(self.config, "TELEMETRY_ENABLED", True) \
+            else NullTelemetryHub(name)
+        # digest → intake-accept perf_counter: start marks for the
+        # intake→reply latency histogram (popped at commit/reject/GC;
+        # capped by TELEMETRY_PENDING_MAX)
+        self._tm_intake_ts: Dict[str, float] = {}
         # GC pause/throughput feed (reference gc_trackers.py): one
         # process-wide hook, weakly attached — only worth the callback
         # when a real collector will persist it
@@ -436,6 +451,14 @@ class Node:
                         getattr(self.replica, "view_changer", None)):
             if _traced is not None:
                 _traced.tracer = self.tracer
+        # telemetry rides the same single-injection-point pattern: the
+        # executor times the execute/fused-dispatch stages, the
+        # ordering service the 3PC stage, the view changer counts
+        # recovery events — all into THIS node's hub
+        for _tm_staged in (self.executor, self.replica.ordering,
+                           getattr(self.replica, "view_changer", None)):
+            if _tm_staged is not None:
+                _tm_staged.telemetry = self.telemetry
         if verifier is not None and hasattr(verifier, "tracer"):
             # device-dispatch profiling inside the CoalescingVerifierHub
             # (a hub shared across co-resident nodes keeps whichever
@@ -484,6 +507,17 @@ class Node:
         self._degradation_timer = RepeatingTimer(
             timer, self.config.ThroughputWindowSize,
             _check_master_degraded)
+        # telemetry flush: sample pool-health gauges, append a flush
+        # sample (the Perfetto counter-track time axis), and write the
+        # per-node Prometheus exposition file when a directory is
+        # configured. Fixed cadence is correct here (periodic non-retry
+        # work), period single-sourced from Config.
+        self._telemetry_timer = None
+        if self.telemetry.enabled:
+            self._telemetry_timer = RepeatingTimer(
+                timer,
+                getattr(self.config, "TELEMETRY_FLUSH_INTERVAL_S", 10),
+                self._flush_telemetry)
         # periodic spike sampling + stats-consumer push (reference
         # node.py:2552 checkNodeRequestSpike / monitor.py:643
         # checkPerformance), only scheduled when someone listens
@@ -1011,6 +1045,14 @@ class Node:
         # lifecycle root: everything downstream (propagate quorum, 3PC,
         # reply) correlates back to this digest on the merged timeline
         self.tracer.instant("request_accepted", CAT_INTAKE, key=key)
+        if self.telemetry.enabled:
+            # intake→reply latency start mark; a full map (pool deeply
+            # backlogged) degrades to counting the drop, never growing
+            if len(self._tm_intake_ts) < getattr(
+                    self.config, "TELEMETRY_PENDING_MAX", 1 << 17):
+                self._tm_intake_ts[key] = self.telemetry.clock()
+            else:
+                self.telemetry.count(TM.E2E_DROPPED)
         self._req_clients[key] = client_id
         if self._clients_attached:
             # building the Ack (schema-validated message object) only
@@ -1132,6 +1174,7 @@ class Node:
         lid = self.write_manager.type_to_ledger_id(request.txn_type)
         if lid is None:
             lid = DOMAIN_LEDGER_ID
+        self._tm_propagate_done(request.key)
         self.replicas.submit_request(request.key, lid)
 
     def _forward_finalised_batch(self, requests: List[Request]):
@@ -1145,9 +1188,20 @@ class Node:
             lid = type_to_lid(request.txn_type)
             if lid is None:
                 lid = DOMAIN_LEDGER_ID
+            self._tm_propagate_done(request.key)
             by_ledger.setdefault(lid, []).append(request.key)
         for lid, digests in by_ledger.items():
             self.replicas.submit_requests(digests, lid)
+
+    def _tm_propagate_done(self, key: str) -> None:
+        """Propagate-quorum wait histogram: intake accept → forwarded to
+        the ordering queues (quorum reached). Requests learned only via
+        gossip have no intake mark here — their latency is owned by the
+        node that accepted them from the client."""
+        t0 = self._tm_intake_ts.get(key)
+        if t0 is not None:
+            self.telemetry.observe(TM.STAGE_PROPAGATE_MS,
+                                   (self.telemetry.clock() - t0) * 1e3)
 
     def _deferred_outbox_flush(self):
         """Timer-armed flush covering votes provoked by deliveries:
@@ -1225,6 +1279,7 @@ class Node:
     def _on_batch_committed(self, ordered: Ordered, committed_txns):
         """Send Replies with audit paths; update dedup index; free reqs."""
         with self.metrics.measure_time(MetricsName.REPLY_TIME), \
+                self.telemetry.timer(TM.STAGE_REPLY_MS), \
                 self.tracer.span(
                     "reply", CAT_REPLY,
                     key="%d:%d" % (ordered.viewNo, ordered.ppSeqNo),
@@ -1234,6 +1289,8 @@ class Node:
     def _on_batch_committed_inner(self, ordered: Ordered, committed_txns):
         self.metrics.add_event(MetricsName.ORDERED_BATCH_COMMITTED,
                                len(committed_txns or []))
+        if committed_txns:
+            self.telemetry.count(TM.ORDERED_REQUESTS, len(committed_txns))
         self.observable.batch_committed(ordered.ledgerId,
                                         committed_txns or [])
         ledger = self.db_manager.get_ledger(ordered.ledgerId)
@@ -1247,6 +1304,10 @@ class Node:
         req_clients_pop = self._req_clients.pop
         rejected_pop = self._rejected_digests.pop
         free_request = self.propagator.requests.free
+        tm_enabled = self.telemetry.enabled
+        tm_intake_pop = self._tm_intake_ts.pop
+        tm_observe = self.telemetry.observe
+        tm_now = self.telemetry.clock() if tm_enabled else 0.0
         inst_id = ordered.instId
         lid_prefix = "%d:" % ordered.ledgerId
         reply_work = []       # (client_id, txn, seq_no) pending proofs
@@ -1263,6 +1324,10 @@ class Node:
                 ordered_pairs.append(
                     (digest, md.get(TXN_PAYLOAD_METADATA_FROM)))
                 rejected_pop(digest, None)
+                if tm_enabled:
+                    t0 = tm_intake_pop(digest, None)
+                    if t0 is not None:
+                        tm_observe(TM.ORDERED_E2E_MS, (tm_now - t0) * 1e3)
             client_id = req_clients_pop(digest, None)
             if client_id is not None and self._clients_attached:
                 reply_work.append((client_id, txn, seq_no))
@@ -1330,6 +1395,7 @@ class Node:
                        if seq <= stable_seq]:
             del self._rejected_digests[digest]
             self._req_clients.pop(digest, None)
+            self._tm_intake_ts.pop(digest, None)
             self.propagator.requests.free(digest)
 
     def _committed_reply(self, request: Request) -> Optional[Reply]:
@@ -1354,6 +1420,8 @@ class Node:
             return
         logger.info("%s starting catchup", self.name)
         self.tracer.instant("catchup_start", CAT_RECOVERY)
+        # pool-health bridge from the recovery lane
+        self.telemetry.count(TM.CATCHUPS)
         self._catchup_started_at = __import__("time").perf_counter()
         self._catchup_started_sim = self.timer.get_current_time()
         # reads degrade gracefully: keep serving the last committed
@@ -1469,6 +1537,35 @@ class Node:
         nodes have identical audit ledgers at the same pp_seq_no)."""
         audit = self.db_manager.get_ledger(AUDIT_LEDGER_ID)
         return audit.root_hash
+
+    def _flush_telemetry(self):
+        """One telemetry flush: sample the pool-health gauges (backlog
+        depth, finalised-queue depth, ordering stash sizes), append a
+        flush-history sample (the Perfetto counter-track time axis),
+        and rewrite this node's Prometheus exposition file when
+        Config.TELEMETRY_PROM_DIR is set."""
+        tm = self.telemetry
+        if not tm.enabled:
+            return
+        reqs = getattr(self.propagator, "requests", None)
+        tm.gauge(TM.BACKLOG_DEPTH, len(reqs) if reqs is not None else 0)
+        ordering = getattr(self.replica, "ordering", None)
+        if ordering is not None:
+            tm.gauge(TM.REQUEST_QUEUE_DEPTH,
+                     sum(len(q) for q in ordering.requestQueues.values()))
+            stasher = getattr(ordering, "_stasher", None)
+            if stasher is not None:
+                tm.gauge(TM.STASH_DEPTH, stasher.stash_size())
+        tm.flush()
+        prom_dir = getattr(self.config, "TELEMETRY_PROM_DIR", None)
+        if prom_dir:
+            try:
+                os.makedirs(prom_dir, exist_ok=True)
+                tm.write_prometheus(os.path.join(
+                    prom_dir, "%s.prom" % self.name.lower()))
+            except OSError:
+                logger.warning("%s: telemetry prom write failed",
+                               self.name, exc_info=True)
 
     def service(self):
         """One prod tick: all protocol instances (master + backups)."""

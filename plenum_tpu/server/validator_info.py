@@ -67,6 +67,7 @@ class ValidatorNodeInfoTool:
             "Latencies": self._latencies(),
             "Extractions": self._extractions(),
             "Tracing": self._tracing_info(),
+            "Telemetry": self._telemetry_info(),
             "Device_mesh": self._device_mesh_info(),
             "Metrics": (self._metrics.summary()
                         if self._metrics is not None
@@ -156,6 +157,26 @@ class ValidatorNodeInfoTool:
         tracer = getattr(self._node, "tracer", None)
         stats = getattr(tracer, "stats", None)
         return stats() if stats is not None else {}
+
+    def _telemetry_info(self) -> dict:
+        """Telemetry-plane snapshot (observability/telemetry.py): the
+        node's latency histograms (ordered p50/p99), pool-health gauges
+        and recovery counters, plus the process-wide device-seam lane
+        accounting (shared across co-resident nodes, like the mesh) —
+        the numbers a serving tier is judged on, readable without
+        attaching a profiler."""
+        hub = getattr(self._node, "telemetry", None)
+        if hub is None or not getattr(hub, "enabled", False):
+            return {"enabled": False}
+        out = hub.snapshot()
+        try:
+            from plenum_tpu.observability.telemetry import get_seam_hub
+            seam = get_seam_hub()
+            if getattr(seam, "enabled", False):
+                out["device_seams"] = seam.snapshot().get("seams", {})
+        except Exception:
+            pass
+        return out
 
     def _device_mesh_info(self) -> dict:
         """Device-mesh dispatcher stats (ops/mesh.py): enabled/gate

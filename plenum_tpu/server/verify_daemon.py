@@ -139,10 +139,23 @@ class VerifyDaemon:
         if len(chunks[-1]) < b:
             pad = chunks[-1][0]
             chunks[-1] = chunks[-1] + [pad] * (b - len(chunks[-1]))
+        # daemon-seam lane accounting + round trip: real items vs the
+        # fixed-bucket grid launched (the tail chunk's repetition
+        # padding is this seam's wasted lanes); this method runs on the
+        # worker thread start-to-finish, so the wall time here IS the
+        # fused dispatch→collect round trip
+        from plenum_tpu.observability import telemetry as tmy
+        tm_hub = tmy.get_seam_hub()
+        first_call = tm_hub.record_launch(
+            tmy.SEAM_DAEMON, len(items), b * len(chunks), shape=b)
+        t0 = tm_hub.clock()
         pendings = [self._verifier.dispatch(c) for c in chunks]
         out = []
         for p in pendings:
             out.extend(p.collect())
+        tm_hub.record_roundtrip(tmy.SEAM_DAEMON,
+                                (tm_hub.clock() - t0) * 1e3,
+                                first_call=first_call)
         return out[:len(items)]
 
     async def _handle_conn(self, reader: asyncio.StreamReader,

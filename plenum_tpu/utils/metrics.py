@@ -9,6 +9,7 @@ name set and a built-in reader that aggregates stats back out.
 """
 from __future__ import annotations
 
+import math
 import struct
 import time
 from abc import ABC, abstractmethod
@@ -86,19 +87,29 @@ class MetricsName(IntEnum):
 
 
 class ValueAccumulator:
-    """count/sum/min/max running stats for one metric between flushes."""
+    """count/sum/sumsq/min/max running stats for one metric between
+    flushes. Keeping the SUM OF SQUARES (not a running variance) is
+    what makes `merge` exact: variances don't add across windows, but
+    (count, sum, sumsq) triples do — merged-then-read stddev equals
+    recording everything into one accumulator. `sumsq` is None for
+    records decoded from the pre-variance on-disk format (their
+    squares are unrecoverable), and merging any such record poisons
+    the merged stddev to None rather than inventing a number."""
 
-    __slots__ = ("count", "sum", "min", "max")
+    __slots__ = ("count", "sum", "min", "max", "sumsq")
 
     def __init__(self):
         self.count = 0
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self.sumsq: Optional[float] = 0.0
 
     def add(self, value: float):
         self.count += 1
         self.sum += value
+        if self.sumsq is not None:
+            self.sumsq += value * value
         self.min = value if self.min is None else min(self.min, value)
         self.max = value if self.max is None else max(self.max, value)
 
@@ -106,7 +117,22 @@ class ValueAccumulator:
     def avg(self) -> Optional[float]:
         return (self.sum / self.count) if self.count else None
 
+    @property
+    def stddev(self) -> Optional[float]:
+        """Population standard deviation; None when empty or when any
+        merged-in record predates the sumsq format."""
+        if not self.count or self.sumsq is None:
+            return None
+        mean = self.sum / self.count
+        var = self.sumsq / self.count - mean * mean
+        return math.sqrt(var) if var > 0.0 else 0.0
+
     def merge(self, other: "ValueAccumulator"):
+        if (self.count and self.sumsq is None) or \
+                (other.count and other.sumsq is None):
+            self.sumsq = None
+        else:
+            self.sumsq = (self.sumsq or 0.0) + (other.sumsq or 0.0)
         self.count += other.count
         self.sum += other.sum
         for v in (other.min, other.max):
@@ -155,13 +181,18 @@ class NullMetricsCollector(MetricsCollector):
         pass
 
 
-_RECORD = struct.Struct(">dHIddd")  # ts, name, count, sum, min, max
+_RECORD = struct.Struct(">dHIdddd")  # ts, name, count, sum, min, max, sumsq
+# the pre-variance record layout (no sumsq); still decoded on read so
+# stores written by earlier builds keep parsing — their stddev reads
+# as unknown (None), never as a fabricated 0
+_RECORD_V1 = struct.Struct(">dHIddd")  # ts, name, count, sum, min, max
 
 
 class KvStoreMetricsCollector(MetricsCollector):
     """Flushes accumulator records to a KeyValueStorage. Key = 8-byte
     big-endian microsecond timestamp + 4-byte seq (sortable, unique);
-    value = packed (ts, name, count, sum, min, max)."""
+    value = packed (ts, name, count, sum, min, max, sumsq). Records in
+    the old sumsq-less layout are decoded transparently."""
 
     def __init__(self, storage, get_time=time.time,
                  max_records: Optional[int] = 100_000):
@@ -190,7 +221,9 @@ class KvStoreMetricsCollector(MetricsCollector):
         self._seq = (self._seq + 1) & 0xFFFFFFFF
         value = _RECORD.pack(ts, name, acc.count, acc.sum,
                              acc.min if acc.min is not None else 0.0,
-                             acc.max if acc.max is not None else 0.0)
+                             acc.max if acc.max is not None else 0.0,
+                             acc.sumsq if acc.sumsq is not None
+                             else float("nan"))
         self._storage.put(key, value)
         self._totals.setdefault(name, ValueAccumulator()).merge(acc)
         # retention: drop oldest records past the cap (totals keep the
@@ -216,12 +249,22 @@ class KvStoreMetricsCollector(MetricsCollector):
         """Decode every stored record — the ONE place that understands
         the on-disk format (restart seeding and events() both ride it)."""
         for key, value in self._storage.iterator():
-            if len(value) != _RECORD.size:
+            if len(value) == _RECORD.size:
+                ts, name, count, total, mn, mx, sumsq = \
+                    _RECORD.unpack(value)
+                if sumsq != sumsq:      # NaN sentinel → unknown
+                    sumsq = None
+            elif len(value) == _RECORD_V1.size:
+                # old 4-tuple (count/sum/min/max) record: parses fine,
+                # stddev unknown
+                ts, name, count, total, mn, mx = _RECORD_V1.unpack(value)
+                sumsq = None
+            else:
                 continue
-            ts, name, count, total, mn, mx = _RECORD.unpack(value)
             acc = ValueAccumulator()
             acc.count, acc.sum = count, total
             acc.min, acc.max = mn, mx
+            acc.sumsq = sumsq
             yield bytes(key), ts, name, acc
 
     def events(self) -> Iterator[Tuple[float, int, ValueAccumulator]]:
@@ -245,7 +288,8 @@ class KvStoreMetricsCollector(MetricsCollector):
             except ValueError:
                 label = str(name)
             out[label] = {"count": acc.count, "sum": acc.sum,
-                          "avg": acc.avg, "min": acc.min, "max": acc.max}
+                          "avg": acc.avg, "min": acc.min, "max": acc.max,
+                          "stddev": acc.stddev}
         return out
 
 

@@ -61,3 +61,34 @@ def test_best_prior_flags_stay_warn_only():
     flags = bench.merkle_regression_flags(
         {"vs_hashlib": 0.01, "vs_cpu_audit_paths": 0.01})
     assert flags["warn"]
+
+
+# ------------------------------------------- telemetry overhead gate
+# (ISSUE 10: the always-on plane's <2% A/B ceiling; same
+# gate-of-the-gate contract as the merkle gate above)
+
+
+def test_telemetry_gate_passes_under_ceiling():
+    bench = _gate()
+    assert bench.telemetry_overhead_gate({"overhead_pct": 0.0}) == []
+    assert bench.telemetry_overhead_gate({"overhead_pct": 1.99}) == []
+    # negative = telemetry side was faster (run-to-run jitter): passes
+    assert bench.telemetry_overhead_gate({"overhead_pct": -3.0}) == []
+
+
+def test_telemetry_gate_fails_at_or_over_ceiling():
+    bench = _gate()
+    failures = bench.telemetry_overhead_gate({"overhead_pct": 2.0})
+    assert failures and "2.00" in failures[0]
+    assert bench.telemetry_overhead_gate({"overhead_pct": 7.5}) != []
+
+
+def test_telemetry_gate_fails_on_missing_field():
+    bench = _gate()
+    failures = bench.telemetry_overhead_gate({})
+    assert any("overhead_pct" in f for f in failures)
+
+
+def test_telemetry_gate_ceiling_is_two_percent():
+    bench = _gate()
+    assert bench.TELEMETRY_OVERHEAD_MAX_PCT == 2.0

@@ -33,6 +33,74 @@ def test_value_accumulator_stats():
     assert (acc.count, acc.max) == (4, 10.0)
 
 
+def test_accumulator_stddev_matches_numpy():
+    import numpy as np
+    rng = np.random.default_rng(3)
+    vals = rng.lognormal(0.0, 1.0, 500)
+    acc = ValueAccumulator()
+    for v in vals:
+        acc.add(float(v))
+    assert acc.stddev == pytest.approx(float(np.std(vals)), rel=1e-9)
+    empty = ValueAccumulator()
+    assert empty.stddev is None
+    one = ValueAccumulator()
+    one.add(4.0)
+    assert one.stddev == 0.0
+
+
+def test_accumulator_variance_is_merge_consistent():
+    """(count, sum, sumsq) triples add across windows: merged stddev
+    equals recording everything into one accumulator."""
+    import numpy as np
+    rng = np.random.default_rng(8)
+    vals = rng.uniform(0.0, 50.0, 300)
+    whole = ValueAccumulator()
+    parts = [ValueAccumulator() for _ in range(4)]
+    for i, v in enumerate(vals):
+        whole.add(float(v))
+        parts[i % 4].add(float(v))
+    merged = ValueAccumulator()
+    for p in parts:
+        merged.merge(p)
+    assert merged.stddev == pytest.approx(whole.stddev, rel=1e-9)
+    # merging a pre-variance record (sumsq unknown) poisons the merged
+    # stddev to None instead of fabricating a number
+    old = ValueAccumulator()
+    old.add(1.0)
+    old.sumsq = None
+    merged.merge(old)
+    assert merged.stddev is None
+    assert merged.count == 301          # everything else still merges
+
+
+def test_old_record_format_still_parses():
+    """Backward compatibility: records packed in the pre-variance
+    layout (no sumsq — the old 4-tuple count/sum/min/max accumulator)
+    decode transparently; their stddev reads as unknown."""
+    import struct
+    storage = KeyValueStorageInMemory()
+    old_record = struct.Struct(">dHIddd")   # the PR-3..PR-9 layout
+    key = struct.pack(">QI", int(999.0 * 1e6), 0)
+    storage.put(key, old_record.pack(
+        999.0, int(MetricsName.NODE_PROD_TIME), 3, 6.0, 1.0, 3.0))
+    collector = KvStoreMetricsCollector(storage)
+    events = list(collector.events())
+    assert len(events) == 1
+    ts, name, acc = events[0]
+    assert (ts, name) == (999.0, int(MetricsName.NODE_PROD_TIME))
+    assert (acc.count, acc.sum, acc.min, acc.max) == (3, 6.0, 1.0, 3.0)
+    assert acc.sumsq is None and acc.stddev is None
+    summary = collector.summary()["NODE_PROD_TIME"]
+    assert summary["count"] == 3
+    assert summary["stddev"] is None
+    # new records written next to old ones round-trip their sumsq
+    collector.add_event(MetricsName.NODE_PROD_TIME, 2.0)
+    collector.flush_accumulated()
+    fresh = [acc for _, _, acc in collector.events()
+             if acc.sumsq is not None]
+    assert len(fresh) == 1 and fresh[0].sumsq == pytest.approx(4.0)
+
+
 def test_kv_collector_flush_and_summary():
     fake_now = [1000.0]
     collector = KvStoreMetricsCollector(KeyValueStorageInMemory(),

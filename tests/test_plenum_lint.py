@@ -519,6 +519,60 @@ def test_pt008_out_of_scope_paths():
     assert not rule.applies("plenum_tpu/testing/sim_network.py")
 
 
+# --------------------------------------------------------------- PT009
+
+# the cardinality-bomb shape the TM registry exists to prevent: a
+# per-peer/per-ledger metric NAME mints a new time series per value
+PT009_BAD = """
+    class Service:
+        def serve(self, peer, ledger_id, hub):
+            self.telemetry.observe("latency_%s" % peer, 1.5)
+            self.telemetry.count(f"retries_{ledger_id}")
+            hub.record_launch("seam_{}".format(ledger_id), 8, 16)
+            with self.telemetry.timer("stage_" + peer):
+                pass
+"""
+
+PT009_GOOD = """
+    from plenum_tpu.observability.telemetry import TM, SEAM_MESH
+
+    class Service:
+        def serve(self, peer, ledger_id, hub, items):
+            # registry constants: the closed name set
+            self.telemetry.observe(TM.ORDERED_E2E_MS, 1.5)
+            self.telemetry.count(TM.VIEW_CHANGES)
+            hub.record_launch(SEAM_MESH, len(items), 16)
+            # a plain literal is bounded cardinality (the dead-name
+            # test owns orphan literals)
+            self.telemetry.gauge("backlog_depth", len(items))
+            # literal-only concatenation is a constant too
+            self.telemetry.observe("stage_" "3pc_ms", 2.0)
+            # unrelated builtins named count must not match
+            n = "abc".count("a") + [1, 2].count(1)
+            return n
+"""
+
+
+def test_pt009_fires_on_dynamic_metric_names():
+    findings = check_snippet(rule_by_code("PT009"), PT009_BAD,
+                             "plenum_tpu/server/some_service.py")
+    assert len(findings) == 4
+    assert all("time series" in f.message for f in findings)
+
+
+def test_pt009_clean_on_registry_constants_and_literals():
+    assert check_snippet(rule_by_code("PT009"), PT009_GOOD,
+                         "plenum_tpu/server/some_service.py") == []
+
+
+def test_pt009_whole_tree_is_clean():
+    # every live record site uses registry constants — the rule gates
+    # the tree it was written for
+    new, baselined, _ = run_analysis([os.path.join(REPO, "plenum_tpu")],
+                                     select=["PT009"])
+    assert new == [] and baselined == []
+
+
 # -------------------------------------------------------------- pragmas
 
 def test_inline_pragma_suppresses_one_line():

@@ -1,0 +1,515 @@
+"""Telemetry plane (observability/telemetry.py): log-linear histogram
+quantiles vs numpy, exact pool merges, per-seam lane-occupancy
+accounting against forced bucket shapes (mesh / hub / merkle), the
+dead-name registry pin, Prometheus exposition, Perfetto counter
+tracks, and the end-to-end sim-pool wiring."""
+import os
+import pathlib
+import re
+
+import numpy as np
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.observability import telemetry as tmy
+from plenum_tpu.observability.telemetry import (
+    TM, LogLinearHistogram, NullTelemetryHub, TelemetryHub,
+    merged_snapshot, prometheus_text)
+from plenum_tpu.runtime.sim_random import DefaultSimRandom
+from plenum_tpu.server.node import Node
+from plenum_tpu.testing.sim_network import SimNetwork
+
+NAMES = ["Alpha", "Beta", "Gamma", "Delta"]
+
+
+def _ticking_clock(step=0.001):
+    t = [0.0]
+
+    def clock():
+        t[0] += step
+        return t[0]
+    return clock
+
+
+@pytest.fixture
+def seam_hub():
+    """Isolated process seam hub for lane-accounting assertions."""
+    hub = TelemetryHub(name="test-seams")
+    prev = tmy.set_seam_hub(hub)
+    yield hub
+    tmy.set_seam_hub(prev)
+
+
+# --------------------------------------------------------- histograms
+
+
+@pytest.mark.parametrize("dist,seed", [
+    ("lognormal", 7), ("lognormal", 23), ("uniform", 11),
+    ("exponential", 3), ("bimodal", 5),
+])
+def test_quantiles_match_numpy_within_bucket_error(dist, seed):
+    """Randomized distributions: every quantile readout lands within
+    the designed per-bucket relative error (1/sub) of the true
+    nearest-rank order statistic."""
+    rng = np.random.default_rng(seed)
+    if dist == "lognormal":
+        vals = rng.lognormal(mean=1.0, sigma=1.6, size=20000)
+    elif dist == "uniform":
+        vals = rng.uniform(0.01, 500.0, size=20000)
+    elif dist == "exponential":
+        vals = rng.exponential(scale=30.0, size=20000)
+    else:
+        # asymmetric split so no tested quantile's rank lands exactly
+        # on the inter-cluster gap (a nearest-rank boundary there is an
+        # off-by-one-order-statistic artifact, not histogram error)
+        vals = np.concatenate([rng.normal(2.0, 0.2, 11000),
+                               rng.normal(800.0, 40.0, 9000)])
+        vals = np.abs(vals)
+    h = LogLinearHistogram()
+    for v in vals:
+        h.record(float(v))
+    tol = 1.0 / h.sub + 1e-9
+    for q in (0.50, 0.95, 0.99, 0.999):
+        true = float(np.percentile(vals, q * 100.0, method="nearest"))
+        est = h.quantile(q)
+        assert est is not None
+        assert abs(est - true) / true <= tol, (dist, q, est, true)
+
+
+def test_quantile_edge_cases():
+    h = LogLinearHistogram()
+    assert h.quantile(0.5) is None          # empty
+    h.record(5.0)
+    # single value: every quantile clamps into [min, max]
+    for q in (0.0, 0.5, 0.999, 1.0):
+        assert h.quantile(q) == pytest.approx(5.0)
+    h2 = LogLinearHistogram()
+    h2.record(0.0)                          # underflow bucket
+    assert h2.quantile(0.5) == pytest.approx(0.0)
+    h2.record(1e12)                         # overflow bucket clamps
+    assert h2.quantile(1.0) >= h2.lo * 2.0 ** h2.octaves / 2
+    h2.record(-1.0)                         # negative: dropped
+    h2.record(float("nan"))                 # NaN: dropped
+    assert h2.count == 2
+
+
+def test_histogram_merge_is_exact():
+    """Merging per-node histograms equals recording into one: same
+    counts array, same quantiles — pool percentiles are exact."""
+    rng = np.random.default_rng(42)
+    vals = rng.lognormal(1.0, 1.2, 9000)
+    single = LogLinearHistogram()
+    parts = [LogLinearHistogram() for _ in range(3)]
+    for i, v in enumerate(vals):
+        single.record(float(v))
+        parts[i % 3].record(float(v))
+    merged = LogLinearHistogram()
+    for p in parts:
+        merged.merge(p)
+    assert np.array_equal(merged.counts, single.counts)
+    assert merged.count == single.count
+    assert merged.total == pytest.approx(single.total)
+    assert merged.vmin == single.vmin and merged.vmax == single.vmax
+    for q in (0.5, 0.99, 0.999):
+        assert merged.quantile(q) == single.quantile(q)
+
+
+def test_pool_merge_equals_recording_into_one_hub():
+    """The acceptance contract: TelemetryHub.merge over per-node hubs
+    reproduces the snapshot of one hub that saw every record."""
+    clock = _ticking_clock()
+    one = TelemetryHub("one", clock=clock)
+    hubs = [TelemetryHub("n%d" % i, clock=clock) for i in range(3)]
+    rng = np.random.default_rng(9)
+    for i in range(600):
+        v = float(rng.lognormal(0.5, 1.0))
+        one.observe(TM.ORDERED_E2E_MS, v)
+        hubs[i % 3].observe(TM.ORDERED_E2E_MS, v)
+        one.count(TM.ORDERED_REQUESTS)
+        hubs[i % 3].count(TM.ORDERED_REQUESTS)
+        if i % 50 == 0:
+            # same write order on both sides: merge keeps newest gauge
+            one.gauge(TM.BACKLOG_DEPTH, i)
+            hubs[i % 3].gauge(TM.BACKLOG_DEPTH, i)
+        if i % 25 == 0:
+            one.record_launch(tmy.SEAM_MESH, 10, 16, shape=(16, 2))
+            hubs[i % 3].record_launch(tmy.SEAM_MESH, 10, 16,
+                                      shape=(16, 2))
+    merged = TelemetryHub("pool", clock=clock)
+    for h in hubs:
+        merged.merge(h)
+    ms, os_ = merged.snapshot(buckets=True), one.snapshot(buckets=True)
+    for section in ("counters", "gauges", "histograms"):
+        assert ms[section] == os_[section], section
+    # seam lane accounting is additive too; compile events and idle
+    # gaps are genuinely PER-HUB facts (each hub compiles its own
+    # first bucket, each sees only its own inter-launch spacing), so
+    # only the additive fields reproduce the one-hub view
+    for field in ("useful_rows", "lane_rows", "launches",
+                  "lane_occupancy"):
+        assert ms["seams"][tmy.SEAM_MESH][field] == \
+            os_["seams"][tmy.SEAM_MESH][field], field
+    # Null hubs merge as no-ops
+    merged.merge(NullTelemetryHub("x"))
+    assert merged.snapshot(buckets=True)["histograms"] == \
+        os_["histograms"]
+
+
+# ------------------------------------------------- lane accounting
+
+
+def test_lane_occupancy_mesh_seam_forced_shape(seam_hub):
+    """A batch of n dispatched through the mesh on a 2^k-padded bucket
+    reports exactly n/2^k on the mesh seam."""
+    import jax
+    import jax.numpy as jnp
+    from plenum_tpu.ops.mesh import DeviceMesh
+    mesh = DeviceMesh(enabled=True)
+    fn = jax.jit(lambda x: x + 1)
+    arrays = [np.zeros((16, 4), dtype=np.int32)]
+    out = mesh.dispatch(fn, arrays, n=10)
+    assert np.asarray(out).shape[0] == 16
+    stats = seam_hub.snapshot()["seams"][tmy.SEAM_MESH]
+    assert stats["useful_rows"] == 10
+    assert stats["lane_rows"] == 16
+    assert stats["lane_occupancy"] == pytest.approx(10 / 16)
+    assert stats["launches"] == 1
+    assert stats["compile_events"] == 1        # first (16, d) shape
+    # same bucket again: no new compile event, occupancy accumulates
+    mesh.dispatch(fn, arrays, n=12)
+    stats = seam_hub.snapshot()["seams"][tmy.SEAM_MESH]
+    assert stats["useful_rows"] == 22
+    assert stats["lane_rows"] == 32
+    assert stats["compile_events"] == 1
+
+
+class _FakeBatchVerifier:
+    """Stands in for JaxBatchVerifier: the hub's lane accounting uses
+    the REAL ed25519 bucket math (launch_lanes) regardless of which
+    backend executes, so the test stays off the device."""
+
+    def dispatch(self, items):
+        from plenum_tpu.crypto.batch_verifier import _Ready
+        return _Ready([True] * len(items))
+
+
+def test_lane_occupancy_hub_seam_forced_shape(seam_hub):
+    """n unique items through the CoalescingVerifierHub's device branch
+    report exactly n / launch_lanes(n) (the pow2>=8 single-device
+    bucket) on the hub seam, plus one round-trip sample flagged as the
+    bucket's first call."""
+    from plenum_tpu.crypto.batch_verifier import CoalescingVerifierHub
+    from plenum_tpu.ops.ed25519_jax import launch_lanes
+    hub = CoalescingVerifierHub(batch=_FakeBatchVerifier(), threshold=4)
+    items = [(b"m%d" % i, b"s" * 64, b"k" * 32) for i in range(10)]
+    results = hub.verify_batch(items)
+    assert results == [True] * 10
+    lanes = launch_lanes(10)
+    assert lanes == 16                       # pow2 >= 8 bucket
+    stats = seam_hub.snapshot()["seams"][tmy.SEAM_HUB]
+    assert stats["useful_rows"] == 10
+    assert stats["lane_rows"] == 16
+    assert stats["lane_occupancy"] == pytest.approx(10 / 16)
+    assert stats["roundtrip_ms"]["count"] == 1
+    assert stats["first_call_ms"]["count"] == 1   # new bucket shape
+    # second generation, same bucket: round trip no longer "first call"
+    hub.verify_batch(items[:9])
+    stats = seam_hub.snapshot()["seams"][tmy.SEAM_HUB]
+    assert stats["roundtrip_ms"]["count"] == 2
+    assert stats["first_call_ms"]["count"] == 1
+    assert stats["compile_events"] == 1
+    # below-threshold generations take the scalar floor: NOT lane-
+    # accounted (no device launch happened)
+    small = CoalescingVerifierHub(batch=_FakeBatchVerifier(),
+                                  threshold=64)
+    small.verify_batch(items)
+    stats = seam_hub.snapshot()["seams"][tmy.SEAM_HUB]
+    assert stats["useful_rows"] == 19
+
+
+def test_lane_occupancy_merkle_append_forced_shape(seam_hub):
+    """Appending b leaves (b not a power of two) onto an empty device
+    tree: level 0 pads b → 2^k, level 1 hashes b>>1 parents — the
+    merkle_append seam reports exactly those useful/lane counts."""
+    from plenum_tpu.ops.merkle import DeviceMerkleTree
+    tree = DeviceMerkleTree()
+    digests = [bytes([i]) * 32 for i in range(3)]
+    tree.append_leaf_hashes(digests)
+    stats = seam_hub.snapshot()["seams"][tmy.SEAM_MERKLE_APPEND]
+    # level 0: 3 rows into a 4-bucket; level 1: 1 complete parent into
+    # a 1-bucket; level 2 has no complete node yet
+    assert stats["useful_rows"] == 3 + 1
+    assert stats["lane_rows"] == 4 + 1
+    assert stats["launches"] == 2
+    assert stats["lane_occupancy"] == pytest.approx(4 / 5)
+
+
+def test_lane_occupancy_bls_job_axis(seam_hub):
+    """The BLS job axis: ragged jobs identity-padded to a common width
+    report sum(len(job)) useful shares over B×n lanes."""
+    pytest.importorskip("jax")
+    from plenum_tpu.crypto import bls12_381 as B
+    from plenum_tpu.ops import bls381_jax
+    share = B.g1_compress(B.G1_GEN)
+    jobs = [[share] * 3, [share] * 2]        # ragged: widths 3 and 2
+    pts, ok = bls381_jax.aggregate_g1_jobs(jobs)
+    assert list(ok) == [True, True]
+    stats = seam_hub.snapshot()["seams"][tmy.SEAM_BLS]
+    assert stats["useful_rows"] == 5
+    assert stats["lane_rows"] == 2 * 3       # B=2 jobs × n=3 width
+    assert stats["lane_occupancy"] == pytest.approx(5 / 6, abs=1e-4)
+
+
+def test_idle_gap_recorded_between_launches(seam_hub):
+    clock = _ticking_clock(step=0.5)         # 500 ms between events
+    hub = TelemetryHub("t", clock=clock)
+    hub.record_launch(tmy.SEAM_MESH, 4, 8)
+    hub.record_launch(tmy.SEAM_MESH, 4, 8)
+    gap = hub.snapshot()["seams"][tmy.SEAM_MESH]["idle_gap_ms"]
+    assert gap["count"] == 1
+    assert gap["p50"] == pytest.approx(500.0, rel=0.1)
+
+
+# ------------------------------------------------------ registry pins
+
+
+def _registry_names():
+    names = [v for k, v in vars(TM).items()
+             if k.isupper() and isinstance(v, str)]
+    seams = [v for k, v in vars(tmy).items()
+             if k.startswith("SEAM_") and isinstance(v, str)]
+    consts = [k for k in vars(TM) if k.isupper()]
+    consts += [k for k in vars(tmy) if k.startswith("SEAM_")]
+    return names, seams, consts
+
+
+def test_every_telemetry_registry_name_is_recorded_somewhere():
+    """Dead-name check (the MetricsName precedent): every TM constant
+    and every SEAM_* constant must be referenced at a recording site
+    under plenum_tpu/ outside the registry module — an orphaned metric
+    is a lie in the docs and dead weight in every snapshot."""
+    import plenum_tpu
+    pkg = pathlib.Path(plenum_tpu.__file__).parent
+    registry = pkg / "observability" / "telemetry.py"
+    blob = "\n".join(p.read_text() for p in sorted(pkg.rglob("*.py"))
+                     if p != registry)
+    _names, _seams, consts = _registry_names()
+    missing = [c for c in consts if not re.search(r"\b%s\b" % c, blob)]
+    assert not missing, \
+        "telemetry registry constants never recorded under " \
+        "plenum_tpu/ (instrument them or delete them): %s" % missing
+
+
+def test_registry_values_are_unique():
+    names, seams, _ = _registry_names()
+    assert len(names) == len(set(names))
+    assert len(seams) == len(set(seams))
+    assert not set(names) & set(seams)
+
+
+# -------------------------------------------------------- exposition
+
+
+def test_prometheus_text_shape_and_determinism():
+    clock = _ticking_clock()
+    hub = TelemetryHub("alpha", clock=clock)
+    for v in (0.5, 2.0, 2.1, 90.0):
+        hub.observe(TM.ORDERED_E2E_MS, v)
+    hub.count(TM.VIEW_CHANGES, 2)
+    hub.gauge(TM.BACKLOG_DEPTH, 17)
+    hub.record_launch(tmy.SEAM_MESH, 10, 16, shape=(16, 1))
+    text = hub.to_prometheus()
+    assert text == hub.to_prometheus()       # deterministic
+    assert '# TYPE plenum_view_changes_total counter' in text
+    assert 'plenum_view_changes_total{node="alpha"} 2' in text
+    assert 'plenum_backlog_depth{node="alpha"} 17' in text
+    assert '# TYPE plenum_ordered_e2e_ms histogram' in text
+    assert 'plenum_ordered_e2e_ms_count{node="alpha"} 4' in text
+    assert 'le="+Inf"} 4' in text
+    assert 'plenum_lane_occupancy{node="alpha",seam="mesh"} 0.625' \
+        in text
+    # cumulative le buckets are monotone
+    counts = [int(m.group(1)) for m in re.finditer(
+        r'plenum_ordered_e2e_ms_bucket\{[^}]*\} (\d+)', text)]
+    assert counts == sorted(counts)
+
+
+def test_write_prometheus_atomic(tdir):
+    hub = TelemetryHub("alpha")
+    hub.count(TM.CATCHUPS)
+    path = os.path.join(tdir, "alpha.prom")
+    assert hub.write_prometheus(path) == path
+    with open(path) as f:
+        assert "plenum_catchups_total" in f.read()
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_flush_history_exports_as_counter_tracks():
+    from plenum_tpu.observability.export import chrome_trace
+    clock = _ticking_clock()
+    hub = TelemetryHub("alpha", clock=clock)
+    hub.observe(TM.ORDERED_E2E_MS, 5.0)
+    hub.gauge(TM.BACKLOG_DEPTH, 3)
+    hub.record_launch(tmy.SEAM_MESH, 8, 16)
+    hub.flush()
+    hub.observe(TM.ORDERED_E2E_MS, 50.0)
+    hub.flush()
+    doc = chrome_trace([], telemetry=[hub])
+    events = doc["traceEvents"]
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert counters, "flush samples must render as counter events"
+    names = {e["name"] for e in counters}
+    assert TM.ORDERED_E2E_MS + ".p50" in names
+    assert TM.BACKLOG_DEPTH in names
+    assert "lane_occupancy." + tmy.SEAM_MESH in names
+    # two flushes → the p50 track has two samples at distinct ts
+    p50 = [e for e in counters
+           if e["name"] == TM.ORDERED_E2E_MS + ".p50"]
+    assert len(p50) == 2 and p50[0]["ts"] < p50[1]["ts"]
+    # deterministic output
+    assert chrome_trace([], telemetry=[hub]) == doc
+    # disabled hubs contribute nothing
+    assert chrome_trace([], telemetry=[NullTelemetryHub("x")]) == \
+        {"traceEvents": [], "displayTimeUnit": "ms"}
+
+
+def test_budget_table_prints_stage_p99(tdir):
+    from plenum_tpu.observability.budget import format_table, stage_p99s
+    hub = TelemetryHub("alpha", clock=_ticking_clock())
+    hub.observe(TM.STAGE_3PC_MS, 12.0)
+    hub.observe(TM.ORDERED_E2E_MS, 40.0)
+    snap = hub.snapshot()
+    p99s = stage_p99s(snap)
+    assert "3pc" in p99s and p99s["3pc"] > 0
+    report = {"nodes": 1, "ordered_reqs": 1,
+              "stage_ms_per_node": {s: 1.0 for s in (
+                  "intake", "propagate", "3pc", "dispatch_wait",
+                  "execute", "reply")},
+              "host_ms_per_ordered_req": {s: 1.0 for s in (
+                  "intake", "propagate", "3pc", "dispatch_wait",
+                  "execute", "reply", "total")}}
+    table = format_table(report, telemetry_snapshot=snap)
+    assert "p99-ms" in table
+    assert "ordered e2e:" in table
+    # without telemetry the column is absent (old rendering intact)
+    assert "p99-ms" not in format_table(report)
+
+
+# ------------------------------------------------------- null hub
+
+
+def test_null_hub_records_nothing_and_is_free():
+    hub = NullTelemetryHub("n")
+    hub.observe(TM.ORDERED_E2E_MS, 1.0)
+    hub.count(TM.VIEW_CHANGES)
+    hub.gauge(TM.BACKLOG_DEPTH, 5)
+    assert hub.record_launch(tmy.SEAM_MESH, 1, 2) is False
+    hub.record_roundtrip(tmy.SEAM_MESH, 1.0)
+    with hub.timer(TM.STAGE_REPLY_MS):
+        pass
+    assert hub.flush() == {}
+    assert hub.flush_history() == []
+    assert hub.snapshot() == {"node": "n", "enabled": False}
+
+
+# ------------------------------------------------------ sim-pool e2e
+
+
+def _make_pool(mock_timer, telemetry=True, seed=11):
+    mock_timer.set_time(1600000000)
+    net = SimNetwork(mock_timer, DefaultSimRandom(seed))
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15, TELEMETRY_ENABLED=telemetry)
+    return [Node(n, NAMES, mock_timer, net.create_peer(n), config=conf,
+                 client_reply_handler=lambda c, m: None)
+            for n in NAMES], mock_timer
+
+
+def _order_batch(nodes, timer, n_reqs=3, run_s=25.0):
+    client = SimpleSigner(seed=b"\x57" * 32)
+    batch = []
+    for i in range(n_reqs):
+        req = {"identifier": client.identifier, "reqId": i + 1,
+               "protocolVersion": 2,
+               "operation": {"type": NYM,
+                             TARGET_NYM: "tm-%04d" % i + "x" * 16,
+                             VERKEY: "~tmtest" + "x" * 16}}
+        req["signature"] = client.sign(dict(req))
+        batch.append((req, "c1"))
+    for nd in nodes:
+        nd.process_client_batch([(dict(r), c) for r, c in batch])
+    end = timer.get_current_time() + run_s
+    while timer.get_current_time() < end:
+        for nd in nodes:
+            nd.service()
+        timer.run_for(0.05)
+        if all(nd.domain_ledger.size >= n_reqs for nd in nodes):
+            break
+    # run past one TELEMETRY_FLUSH_INTERVAL_S so the flush timer
+    # samples gauges / writes prom files at least once
+    timer.run_for(12.0)
+
+
+def test_sim_pool_money_path_histograms_and_merge(mock_timer, seam_hub):
+    nodes, timer = _make_pool(mock_timer)
+    _order_batch(nodes, timer, n_reqs=3)
+    assert all(nd.domain_ledger.size >= 3 for nd in nodes)
+    from plenum_tpu.observability.export import pool_telemetry
+    hubs = pool_telemetry(nodes)
+    assert len(hubs) == len(NAMES)
+    snap = merged_snapshot(hubs)
+    hists = snap["histograms"]
+    # every node ordered 3 requests it accepted from the client
+    e2e = hists[TM.ORDERED_E2E_MS]
+    assert e2e["count"] == 3 * len(NAMES)
+    assert e2e["p50"] is not None and e2e["p99"] >= e2e["p50"] > 0
+    # the per-stage family landed end to end
+    for metric in (TM.STAGE_PROPAGATE_MS, TM.STAGE_3PC_MS,
+                   TM.STAGE_EXECUTE_MS, TM.STAGE_REPLY_MS):
+        assert hists[metric]["count"] >= 1, metric
+    assert snap["counters"][TM.ORDERED_REQUESTS] == 3 * len(NAMES)
+    # the intake-ts maps drained (commit popped every start mark)
+    assert all(not nd._tm_intake_ts for nd in nodes)
+    # the flush timer sampled pool-health gauges (sim time advanced
+    # past TELEMETRY_FLUSH_INTERVAL_S)
+    assert TM.BACKLOG_DEPTH in snap["gauges"]
+    assert any(hub.flush_history() for hub in hubs)
+    # validator info surfaces the plane
+    from plenum_tpu.server.validator_info import ValidatorNodeInfoTool
+    info = ValidatorNodeInfoTool(nodes[0]).info
+    assert info["Telemetry"]["enabled"] is True
+    assert TM.ORDERED_E2E_MS in info["Telemetry"]["histograms"]
+    assert "device_seams" in info["Telemetry"]
+
+
+def test_sim_pool_telemetry_disabled_is_inert(mock_timer):
+    nodes, timer = _make_pool(mock_timer, telemetry=False)
+    _order_batch(nodes, timer, n_reqs=2)
+    assert all(nd.domain_ledger.size >= 2 for nd in nodes)
+    for nd in nodes:
+        assert not nd.telemetry.enabled
+        assert nd.telemetry.snapshot()["enabled"] is False
+        assert not nd._tm_intake_ts
+        assert nd._telemetry_timer is None
+    from plenum_tpu.observability.export import pool_telemetry
+    assert pool_telemetry(nodes) == []
+
+
+def test_sim_pool_prom_files_written(mock_timer, tdir, seam_hub):
+    mock_timer.set_time(1600000000)
+    net = SimNetwork(mock_timer, DefaultSimRandom(13))
+    prom_dir = os.path.join(tdir, "prom")
+    conf = Config(Max3PCBatchSize=10, Max3PCBatchWait=0.2, CHK_FREQ=5,
+                  LOG_SIZE=15, TELEMETRY_PROM_DIR=prom_dir)
+    nodes = [Node(n, NAMES, mock_timer, net.create_peer(n), config=conf,
+                  client_reply_handler=lambda c, m: None)
+             for n in NAMES]
+    _order_batch(nodes, timer=mock_timer, n_reqs=2)
+    files = sorted(os.listdir(prom_dir))
+    assert files == sorted("%s.prom" % n.lower() for n in NAMES)
+    with open(os.path.join(prom_dir, "alpha.prom")) as f:
+        text = f.read()
+    assert "plenum_ordered_requests_total" in text
+    assert 'node="Alpha"' in text
