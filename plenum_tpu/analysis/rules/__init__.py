@@ -25,6 +25,8 @@ from plenum_tpu.analysis.rules.pt008_per_item_hot_loop import (
     PerItemHotLoopRule)
 from plenum_tpu.analysis.rules.pt009_metric_cardinality import (
     UnboundedMetricCardinalityRule)
+from plenum_tpu.analysis.rules.pt010_wire_serializer import (
+    WireSerializerLoopRule)
 
 RULE_CLASSES = (
     BlockingCallRule,
@@ -36,6 +38,7 @@ RULE_CLASSES = (
     FixedRetryTimerRule,
     PerItemHotLoopRule,
     UnboundedMetricCardinalityRule,
+    WireSerializerLoopRule,
 )
 
 

@@ -26,6 +26,17 @@ class Config:
     # only gates our own coalesced SENDING. While an adversary tap is
     # installed the outbox degrades to per-message sends regardless.
     THREE_PC_BATCH_WIRE = True
+    # flat zero-copy wire codec (common/serializers/flat_wire.py):
+    # PREPARE/COMMIT votes travel as contiguous typed columns and
+    # PROPAGATE payloads as length-prefixed sections inside ONE
+    # FLAT_WIRE envelope per peer per tick — one pack / one parse
+    # instead of per-message serializer calls, zero intermediate
+    # message objects on the receive path. Inbound flat envelopes are
+    # always understood; this knob gates only our own SENDING (the
+    # typed THREE_PC_BATCH / PROPAGATE_BATCH path is the validated
+    # fallback, and an installed adversary tap degrades to it
+    # regardless so fault injection keeps per-message granularity).
+    FLAT_WIRE = True
     # micro-batching window for delivery-provoked votes (seconds): a
     # vote provoked outside a prod tick waits at most this long for
     # same-window siblings before the outbox flushes — peer deliveries

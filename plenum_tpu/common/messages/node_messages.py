@@ -189,6 +189,24 @@ class ThreePCBatch(MessageBase):
             for m in self.messages]}
 
 
+class FlatBatch(MessageBase):
+    """Flat zero-copy wire envelope (common/serializers/flat_wire.py):
+    PREPARE/COMMIT votes as contiguous typed columns, PRE-PREPAREs and
+    PROPAGATEs as length-prefixed sections — ONE pack and ONE parse per
+    peer per tick, zero intermediate Python message objects on the
+    receive path. The payload is opaque bytes to the transport (msgpack
+    wraps it as a single bin field, no canonical-sort recursion into
+    the votes); `to_legacy_messages` re-materializes typed messages for
+    the fault-injection unwrap seams. The typed THREE_PC_BATCH /
+    PROPAGATE_BATCH path stays as validated fallback
+    (Config.FLAT_WIRE=False or an installed adversary tap)."""
+
+    typename = "FLAT_WIRE"
+    schema = (
+        ("payload", SerializedValueField()),
+    )
+
+
 class Ordered(MessageBase):
     typename = "ORDERED"
     schema = (

@@ -34,13 +34,21 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from plenum_tpu.observability.telemetry import TM as _TM
 
 # stage order is the money-path order; reports preserve it
-STAGES = ("intake", "propagate", "3pc", "dispatch_wait", "execute",
-          "reply")
+STAGES = ("intake", "propagate", "serialize", "parse", "3pc",
+          "dispatch_wait", "execute", "reply")
 
 # span names whose category alone would misfile them: the intake auth
-# seams are device dispatches, but they are the INTAKE stage's cost
+# seams are device dispatches, but they are the INTAKE stage's cost;
+# the wire pack/parse spans sit inside 3PC/propagate flush handlers but
+# are the SERIALIZE/PARSE stages' cost (the flat-wire A/B reads the
+# before/after host-ms off these two rows instead of inferring it from
+# an end-to-end delta)
 _INTAKE_NAMES = frozenset({"auth_dispatch", "auth_conclude",
                            "read_batch"})
+_NAME_TO_STAGE = {
+    "wire_pack": "serialize",
+    "wire_parse": "parse",
+}
 _CAT_TO_STAGE = {
     "intake": "intake",
     "propagate": "propagate",
@@ -56,6 +64,9 @@ def stage_of(name: str, cat: str) -> Optional[str]:
     """Stage for one span; None = unbudgeted (recovery, counters)."""
     if name in _INTAKE_NAMES:
         return "intake"
+    stage = _NAME_TO_STAGE.get(name)
+    if stage is not None:
+        return stage
     return _CAT_TO_STAGE.get(cat)
 
 

@@ -83,6 +83,18 @@ class TM:
     STAGE_COMMIT_MS = "stage_commit_ms"        # batch commit (durable)
     STAGE_REPLY_MS = "stage_reply_ms"          # reply construct + proofs
 
+    # ---- wire plane (flat zero-copy codec; recorded into the SEAM
+    # hub — the wire is a process-shared resource like the device
+    # seams, and pool-wide reports merge it the same way)
+    WIRE_BYTES_SENT = "wire_bytes_sent"        # counter: flat payload B
+    WIRE_BYTES_RECV = "wire_bytes_recv"        # counter: flat payload B
+    WIRE_ENV_BYTES_3PC = "wire_env_bytes_three_pc"      # hist: env size
+    WIRE_ENV_BYTES_PROPAGATE = "wire_env_bytes_propagate"
+    WIRE_VOTE_BYTES_PREPARE = "wire_vote_bytes_prepare"  # hist: B/vote
+    WIRE_VOTE_BYTES_COMMIT = "wire_vote_bytes_commit"
+    WIRE_VOTE_BYTES_PREPREPARE = "wire_vote_bytes_preprepare"
+    WIRE_MALFORMED = "wire_malformed"          # counter: rejected envs
+
     # ---- pool health
     BACKLOG_DEPTH = "backlog_depth"            # gauge: in-flight requests
     REQUEST_QUEUE_DEPTH = "request_queue_depth"  # gauge: finalised queue

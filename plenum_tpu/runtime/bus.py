@@ -9,14 +9,22 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Type
 
 
 def _unwrap_three_pc_batch(message) -> Optional[list]:
-    """Inner votes of a coalesced THREE_PC_BATCH envelope, or None when
-    `message` is not one. Lazy import: the runtime layer must stay
-    importable without the message schema module loaded. Dict entries
-    (a real-transport envelope) are reconstructed through the message
-    factory so the tap always sees typed votes; an unreconstructable
-    entry is dropped here exactly as the node's own intake would drop
-    it."""
-    from plenum_tpu.common.messages.node_messages import ThreePCBatch
+    """Inner typed messages of a coalesced envelope — THREE_PC_BATCH or
+    a flat-wire FLAT_WIRE envelope — or None when `message` is neither.
+    Lazy import: the runtime layer must stay importable without the
+    message schema module loaded. Dict entries (a real-transport typed
+    envelope) are reconstructed through the message factory and flat
+    payloads re-materialized through the codec so the tap always sees
+    typed per-message granularity; an unreconstructable entry is
+    dropped here exactly as the node's own intake would drop it."""
+    from plenum_tpu.common.messages.node_messages import (
+        FlatBatch, ThreePCBatch)
+    if isinstance(message, FlatBatch):
+        from plenum_tpu.common.serializers import flat_wire
+        # malformed / all-entries-invalid envelopes pass through WHOLE
+        # (the receiving node owns that judgement) — the policy is
+        # single-sourced next to the codec
+        return flat_wire.unwrap_for_tap(message.payload)
     if not isinstance(message, ThreePCBatch):
         return None
     from plenum_tpu.common.messages.message_factory import (

@@ -26,10 +26,13 @@ from plenum_tpu.consensus.ordering_service import Suspicions
 
 logger = logging.getLogger(__name__)
 
-# the only code whose offending message provably names its author
-# (two conflicting PRE-PREPAREs signed for the same (view, seq))
+# codes whose offending evidence provably names its author: two
+# conflicting PRE-PREPAREs signed for the same (view, seq), and a
+# structurally corrupt flat wire envelope (it arrived whole on that
+# peer's authenticated stream — nobody else could have framed it)
 AUTO_BLACKLIST_CODES = frozenset({
     Suspicions.DUPLICATE_PPR_SENT,
+    Suspicions.WIRE_MALFORMED,
 })
 
 

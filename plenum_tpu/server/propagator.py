@@ -13,11 +13,16 @@ from typing import Callable, Dict, Optional, Set
 import msgpack
 
 from plenum_tpu.common.messages.node_messages import (
-    Propagate, PropagateBatch)
+    FlatBatch, Propagate, PropagateBatch)
 from plenum_tpu.common.request import Request
+from plenum_tpu.common.serializers import flat_wire
+from plenum_tpu.common.serializers.serializers import MsgPackSerializer
 from plenum_tpu.consensus.quorums import Quorums
 from plenum_tpu.observability.tracing import CAT_PROPAGATE, NullTracer
+from plenum_tpu.observability.telemetry import TM, get_seam_hub
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
+
+_wire_serializer = MsgPackSerializer()
 
 logger = logging.getLogger(__name__)
 
@@ -170,7 +175,8 @@ class Propagator:
     def __init__(self, name: str, quorums: Quorums, network,
                  forward_handler: Callable[[Request], None],
                  authenticator: Callable[[Request], bool] = None,
-                 forward_batch_handler: Callable[[list], None] = None):
+                 forward_batch_handler: Callable[[list], None] = None,
+                 flat_wire_enabled: bool = False):
         """network: ExternalBus; forward_handler: called exactly once per
         finalised request (feeds ordering queues). authenticator(request)
         → bool gates requests FIRST LEARNED from a peer's PROPAGATE: a
@@ -189,6 +195,14 @@ class Propagator:
         self._forward = forward_handler
         self._forward_batch = forward_batch_handler
         self._authenticator = authenticator
+        # flat zero-copy wire (common/serializers/flat_wire.py): each
+        # queued payload is packed ONCE at queue time — the same bytes
+        # feed the size budget AND the envelope, so the old pack-for-
+        # sizing-then-discard cost disappears. Degrades to the typed
+        # Propagate/PropagateBatch wire while an adversary tap is
+        # installed (per-message granularity IS the fault-injection
+        # seam) or when the flag is off.
+        self._flat = flat_wire_enabled
         self.requests = Requests()
         self.metrics = NullMetricsCollector()   # node injects the real one
         self.tracer = NullTracer()              # node injects the real one
@@ -214,7 +228,21 @@ class Propagator:
         self._try_finalise(request.key)
 
     def _queue_out(self, payload: dict, client_name) -> None:
-        self._out.append((payload, client_name, _payload_size(payload)))
+        if self._flat:
+            try:
+                raw = _wire_serializer.serialize(payload)
+                # estimate covers the client-id string + per-entry
+                # offset-table overhead too; the post-encode split in
+                # _send_flat_chunk backstops any remaining lag
+                self._out.append((payload, client_name,
+                                  len(raw) + len(client_name or "") + 24,
+                                  raw))
+                return
+            except Exception:
+                # unpackable oddity: ride the typed fallback below
+                pass
+        self._out.append((payload, client_name, _payload_size(payload),
+                          None))
 
     def flush(self) -> int:
         """Send everything queued since the last flush, chunked under
@@ -231,15 +259,25 @@ class Propagator:
 
     def _flush(self) -> int:
         out, self._out = self._out, []
+        flat = self._flat and not getattr(self._network, "has_tap",
+                                          False)
 
         def send_chunk(chunk):
+            if flat and all(e[3] is not None for e in chunk):
+                try:
+                    self._send_flat_chunk(chunk)
+                    return
+                except flat_wire.FlatWireUnencodable as e:
+                    # cannot ride the flat layout: typed fallback below
+                    logger.debug("propagator: flat encode fell back "
+                                 "(%s)", e)
             if len(chunk) == 1:
                 self._network.send(Propagate(request=chunk[0][0],
                                              senderClient=chunk[0][1]))
             else:
                 self._network.send(PropagateBatch(
-                    requests=[r for r, _, _ in chunk],
-                    clients=[c or "" for _, c, _ in chunk]))
+                    requests=[r for r, _, _, _ in chunk],
+                    clients=[c or "" for _, c, _, _ in chunk]))
 
         chunk, chunk_size = [], 0
         for entry in out:
@@ -253,6 +291,26 @@ class Propagator:
         if chunk:
             send_chunk(chunk)
         return len(out)
+
+    def _send_flat_chunk(self, chunk) -> None:
+        """One flat envelope from the chunk's already-packed request
+        blobs — the payload bytes computed for the size budget ARE the
+        wire bytes; no second serialization happens."""
+        with self.tracer.span("wire_pack", CAT_PROPAGATE, n=len(chunk)):
+            payload = flat_wire.encode_propagate_envelope(
+                [raw for _, _, _, raw in chunk],
+                [c or "" for _, c, _, _ in chunk])
+        if len(payload) > self.BATCH_SIZE_BUDGET and len(chunk) > 1:
+            # estimate lagged (same backstop as ThreePCOutbox): split
+            # rather than build a frame the transport drops wholesale
+            half = len(chunk) // 2
+            self._send_flat_chunk(chunk[:half])
+            self._send_flat_chunk(chunk[half:])
+            return
+        hub = get_seam_hub()
+        hub.count(TM.WIRE_BYTES_SENT, len(payload))
+        hub.observe(TM.WIRE_ENV_BYTES_PROPAGATE, len(payload))
+        self._network.send(FlatBatch(payload=payload))
 
     # ---------------------------------------------------------- receiving
 
@@ -287,6 +345,33 @@ class Propagator:
                               finalise_sink=finalised)
         if finalised:
             self._forward_batch([s.request for s in finalised])
+
+    def process_propagate_columns(self, cols, frm: str):
+        """Flat-wire PROPAGATE intake: the parsed section hands each
+        request payload over as raw msgpack bytes, unpacked straight
+        into the dict ``_process_one`` needs — no Propagate message
+        object, no envelope schema validation, no per-field canonical
+        re-sort on the receive path. Finalisation stays columnar: all
+        requests reaching quorum inside this envelope forward as one
+        contiguous digest column."""
+        with self.metrics.measure_time(MetricsName.PROPAGATE_PROCESS_TIME):
+            self._process_propagate_columns(cols, frm)
+
+    def _process_propagate_columns(self, cols, frm: str):
+        sink = [] if self._forward_batch is not None else None
+        for i in range(cols.n):
+            try:
+                payload = cols.request(i)
+            except Exception:
+                # one bad entry costs ONE propagate, never the envelope
+                logger.warning(
+                    "%s: bad PROPAGATE entry in flat envelope from %s "
+                    "— ignored", self.name, frm)
+                continue
+            self._process_one(payload, cols.client(i) or None, frm,
+                              finalise_sink=sink)
+        if sink:
+            self._forward_batch([s.request for s in sink])
 
     def _process_one(self, payload: dict, sender_client, frm: str,
                      finalise_sink=None):

@@ -11,6 +11,7 @@ identical ledger/state roots — asserted by the test harness.
 """
 from __future__ import annotations
 
+import base64
 import json
 import logging
 from typing import Callable, List, Tuple
@@ -19,6 +20,31 @@ logger = logging.getLogger(__name__)
 
 KIND_NODE_MSG = "node"      # peer consensus message
 KIND_CLIENT_MSG = "client"  # client request dict
+
+# JSONL cannot carry raw bytes (flat-wire FLAT_WIRE payloads are
+# opaque byte strings): mark-and-base64 on dump, reversed on load, so
+# a recorded flat envelope replays bit-identically
+_BYTES_MARK = "__plenum_b64__"
+
+
+def _to_jsonable(v):
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return {_BYTES_MARK: base64.b64encode(bytes(v)).decode("ascii")}
+    if isinstance(v, dict):
+        return {k: _to_jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_to_jsonable(x) for x in v]
+    return v
+
+
+def _from_jsonable(v):
+    if isinstance(v, dict):
+        if set(v) == {_BYTES_MARK}:
+            return base64.b64decode(v[_BYTES_MARK])
+        return {k: _from_jsonable(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_from_jsonable(x) for x in v]
+    return v
 
 
 class Recorder:
@@ -39,7 +65,7 @@ class Recorder:
     def dump(self, path: str):
         with open(path, "w") as f:
             for t, kind, frm, payload in self.entries:
-                f.write(json.dumps([t, kind, frm, payload],
+                f.write(json.dumps([t, kind, frm, _to_jsonable(payload)],
                                    sort_keys=True) + "\n")
 
     @classmethod
@@ -50,7 +76,8 @@ class Recorder:
                 line = line.strip()
                 if line:
                     t, kind, frm, payload = json.loads(line)
-                    rec.entries.append((t, kind, frm, payload))
+                    rec.entries.append((t, kind, frm,
+                                        _from_jsonable(payload)))
         return rec
 
 

@@ -92,3 +92,28 @@ def test_telemetry_gate_fails_on_missing_field():
 def test_telemetry_gate_ceiling_is_two_percent():
     bench = _gate()
     assert bench.TELEMETRY_OVERHEAD_MAX_PCT == 2.0
+
+
+# ------------------------------------------- host-ms best-prior tripwire
+# (ISSUE 11: the flat-wire round adds host_ms_per_ordered_req.total as
+# a warn-tripwire vs the best prior recorded round — merkle_regression
+# convention, warn-only half)
+
+def test_host_ms_tripwire_flags_regression_and_stays_warn_only():
+    bench = _gate()
+    flags = bench.host_ms_regression_flags(0.00001)
+    # beating (or matching) every prior round: no warning
+    assert flags["warn"] is None
+    flags = bench.host_ms_regression_flags(10 ** 9)
+    # prior rounds recorded a total → a worse current one warns; on a
+    # tree with no prior host-ms record the tripwire stays silent
+    if flags["best_prior"] is not None:
+        assert flags["warn"] and "best prior" in flags["warn"][0]
+    else:
+        assert flags["warn"] is None
+
+
+def test_host_ms_tripwire_tolerates_missing_current():
+    bench = _gate()
+    flags = bench.host_ms_regression_flags(None)
+    assert flags["warn"] is None

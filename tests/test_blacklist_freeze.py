@@ -38,7 +38,11 @@ def test_only_attributable_evidence_auto_blacklists():
     equivocating primary, honest PREPAREs mismatch each other
     (PR_DIGEST_WRONG against honest senders), and MessageReq
     re-attributes fetched PRE-PREPAREs to the primary."""
-    assert AUTO_BLACKLIST_CODES == {Suspicions.DUPLICATE_PPR_SENT}
+    # two attributable codes: conflicting signed PRE-PREPAREs, and a
+    # structurally corrupt flat wire envelope (it arrived whole on the
+    # sender's authenticated stream)
+    assert AUTO_BLACKLIST_CODES == {Suspicions.DUPLICATE_PPR_SENT,
+                                    Suspicions.WIRE_MALFORMED}
     b = SimpleBlacklister("n")
     b.report_suspicion("Honest", Suspicions.PR_DIGEST_WRONG, "mismatch",
                        auto_blacklist=True)

@@ -88,8 +88,11 @@ def snapshot(replica, suspicions):
     ex = o._executor
     stashes = {}
     for (typ, code), stash in o._stasher._stashes.items():
-        items = sorted(
-            (repr(item) for item in getattr(stash, "_items", [])))
+        # the stash containers are iterable ((message, *args) entries);
+        # an attribute probe here once read a nonexistent `_items` and
+        # silently compared empty lists — every stash assertion was
+        # vacuous until the flat-wire catchup test caught it
+        items = sorted(repr(item) for item in stash)
         if items:
             stashes[(typ.__name__, code)] = items
     return {
@@ -233,9 +236,11 @@ def test_columnar_batch_with_only_garbage_is_noop():
 
 # ------------------------------------------------------------------- e2e
 
-def _run_pool(batch_wire: bool, n_reqs: int = 24):
+def _run_pool(batch_wire: bool, n_reqs: int = 24, flat_wire: bool = None):
     """One deterministic 4-node sim pool ordering n_reqs NYMs;
-    → (domain_root, audit_root, state_root, ordered txn sequence)."""
+    → (domain_root, audit_root, state_root, ordered txn sequence).
+    flat_wire pins Config.FLAT_WIRE (None = the class default) — the
+    flat-codec A/B in tests/test_flat_wire.py reuses this harness."""
     from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
     from plenum_tpu.common.txn_util import get_payload_data
     from plenum_tpu.crypto.signer import SimpleSigner
@@ -256,8 +261,11 @@ def _run_pool(batch_wire: bool, n_reqs: int = 24):
     # any remaining root drift is a real equivalence bug.
     net = SimNetwork(timer, DefaultSimRandom(77),
                      min_latency=0.003, max_latency=0.003)
-    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2,
-                  THREE_PC_BATCH_WIRE=batch_wire)
+    overrides = dict(Max3PCBatchSize=5, Max3PCBatchWait=0.2,
+                     THREE_PC_BATCH_WIRE=batch_wire)
+    if flat_wire is not None:
+        overrides["FLAT_WIRE"] = flat_wire
+    conf = Config(**overrides)
     nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
              for name in names]
     signer = SimpleSigner(seed=b"\x31" * 32)

@@ -573,6 +573,97 @@ def test_pt009_whole_tree_is_clean():
     assert new == [] and baselined == []
 
 
+# --------------------------------------------------------------- PT010
+
+# the per-message wire shape the flat codec killed: one serializer /
+# factory invocation per inner envelope entry in a hot wire handler
+PT010_BAD = """
+    class Stack:
+        def _process_batch(self, msg, frm):
+            for entry in msg.messages:
+                m = node_message_factory.get_instance(**entry)
+                self.rx.append(m)
+
+        def flush_outboxes(self, out):
+            frames = [self.serializer.serialize(m) for m in out]
+            return frames
+
+        def _unpack_wire(self, msg, frm):
+            for raw in msg.get("messages", []):
+                self.rx.append(serializer.deserialize(raw))
+"""
+
+PT010_GOOD = """
+    class Stack:
+        def _process_batch(self, msg, frm):
+            # ONE parse for the whole envelope, columns to the intake
+            env = flat_wire.parse_envelope(msg.payload)
+            for sec in env.sections:
+                self.route_columns(sec, frm)
+
+        def flush_outboxes(self, out):
+            # one pack per envelope, hoisted out of the per-item path
+            payload = flat_wire.encode_three_pc([], out, [])
+            self.send_frame(payload)
+
+        def _collect(self, msg):
+            # per-item loops without serializer calls are fine
+            for entry in msg.messages:
+                self.rx.append(entry)
+
+        def summarize(self, report):
+            # a serializer call over a non-wire collection is fine
+            return [self.serializer.serialize(r)
+                    for r in report.sections]
+"""
+
+
+def test_pt010_fires_on_per_item_serializer_calls():
+    findings = check_snippet(rule_by_code("PT010"), PT010_BAD,
+                             "plenum_tpu/network/some_stack.py")
+    assert len(findings) == 3
+    assert all("per-item" in f.message for f in findings)
+    assert {f.message.split("'")[1] for f in findings} \
+        == {"get_instance", "serialize", "deserialize"}
+
+
+def test_pt010_clean_on_whole_envelope_codec():
+    assert check_snippet(rule_by_code("PT010"), PT010_GOOD,
+                         "plenum_tpu/network/some_stack.py") == []
+
+
+def test_pt010_nested_loops_report_one_finding_per_call():
+    src = """
+        class Stack:
+            def flush_all(self, out):
+                for chunk in out:
+                    for m in chunk:
+                        self.serializer.serialize(m)
+    """
+    findings = check_snippet(rule_by_code("PT010"), src,
+                             "plenum_tpu/network/some_stack.py")
+    assert len(findings) == 1
+
+
+def test_pt010_out_of_scope_layers_unchecked():
+    # the codec itself (common/serializers/) legitimately loops over
+    # per-item blobs — the rule scopes to the wire handler layers
+    rule = rule_by_code("PT010")
+    assert not rule.applies("plenum_tpu/common/serializers/flat_wire.py")
+    assert rule.applies("plenum_tpu/network/stack.py")
+    assert rule.applies("plenum_tpu/server/node.py")
+
+
+def test_pt010_tree_has_only_justified_baseline_entries():
+    # the typed-fallback / tap-degrade paths are baselined with
+    # justifications; nothing NEW may appear
+    new, baselined, _ = run_analysis(
+        [os.path.join(REPO, "plenum_tpu")], select=["PT010"],
+        baseline_path=os.path.join(REPO, "lint_baseline.json"))
+    assert new == []
+    assert len(baselined) == 2
+
+
 # -------------------------------------------------------------- pragmas
 
 def test_inline_pragma_suppresses_one_line():

@@ -383,13 +383,11 @@ def test_budget_table_prints_stage_p99(tdir):
     snap = hub.snapshot()
     p99s = stage_p99s(snap)
     assert "3pc" in p99s and p99s["3pc"] > 0
+    from plenum_tpu.observability.budget import STAGES
     report = {"nodes": 1, "ordered_reqs": 1,
-              "stage_ms_per_node": {s: 1.0 for s in (
-                  "intake", "propagate", "3pc", "dispatch_wait",
-                  "execute", "reply")},
-              "host_ms_per_ordered_req": {s: 1.0 for s in (
-                  "intake", "propagate", "3pc", "dispatch_wait",
-                  "execute", "reply", "total")}}
+              "stage_ms_per_node": {s: 1.0 for s in STAGES},
+              "host_ms_per_ordered_req": dict(
+                  {s: 1.0 for s in STAGES}, total=float(len(STAGES)))}
     table = format_table(report, telemetry_snapshot=snap)
     assert "p99-ms" in table
     assert "ordered e2e:" in table

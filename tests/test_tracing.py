@@ -179,9 +179,13 @@ def test_sim_pool_merged_timeline_has_every_3pc_phase(traced_pool, tdir):
         assert spans.get("propagate_quorum", 0) >= 1, (name, spans)
         assert spans.get("pp_create", 0) + spans.get("pp_process", 0) \
             >= 1, (name, spans)
-        assert spans.get("prepare_process", 0) >= 1, (name, spans)
+        # inbound votes arrive per-message OR as flat/typed envelopes
+        # (the columnar intake spans carry the same phase evidence)
+        assert spans.get("prepare_process", 0) \
+            + spans.get("prepare_batch", 0) >= 1, (name, spans)
         assert spans.get("prepared", 0) >= 1, (name, spans)
-        assert spans.get("commit_process", 0) >= 1, (name, spans)
+        assert spans.get("commit_process", 0) \
+            + spans.get("commit_batch", 0) >= 1, (name, spans)
         assert spans.get("order", 0) >= 1, (name, spans)
         assert spans.get("batch_apply", 0) >= 1, (name, spans)
         assert spans.get("batch_commit", 0) >= 1, (name, spans)
