@@ -27,6 +27,8 @@ from plenum_tpu.analysis.rules.pt009_metric_cardinality import (
     UnboundedMetricCardinalityRule)
 from plenum_tpu.analysis.rules.pt010_wire_serializer import (
     WireSerializerLoopRule)
+from plenum_tpu.analysis.rules.pt011_declared_keys import (
+    DeclaredKeysRule)
 
 RULE_CLASSES = (
     BlockingCallRule,
@@ -39,6 +41,7 @@ RULE_CLASSES = (
     PerItemHotLoopRule,
     UnboundedMetricCardinalityRule,
     WireSerializerLoopRule,
+    DeclaredKeysRule,
 )
 
 

@@ -53,6 +53,24 @@ class Config:
     # device window per applied batch instead of serialized round trips
     FUSED_BATCH_DISPATCH = True
 
+    # ---- conflict-lane execution (server/executor.py +
+    # server/execution_lanes.py): partition each ordered batch into
+    # deterministic execution lanes from the handlers' declared state
+    # touches — batched pre-batch read prefetch for every declared read
+    # key, one bulk structural trie merge per written state, and ONE
+    # merged level-wise SHA3 resolve across all written states per
+    # batch. False restores the pre-lane serial apply path (the bench
+    # A/B baseline; results are byte-equal either way).
+    EXEC_LANES = True
+    # batches below this many requests skip lane planning — the plan +
+    # prefetch overhead only pays for itself on real batches
+    EXEC_LANE_MIN = 8
+    # merged-resolve hash routing: "auto" = device dispatches only on
+    # hosts with a real accelerator (on CPU hosts hashlib beats
+    # per-level dispatch overhead at MPT node counts — the SHA-256
+    # "tiled" CPU-backend precedent); True/False force one side
+    EXEC_MERGED_DEVICE_HASH = "auto"
+
     # ---- propagation
     PROPAGATE_REQUEST_DELAY = 0
 

@@ -37,6 +37,15 @@ from plenum_tpu.observability.telemetry import TM as _TM
 STAGES = ("intake", "propagate", "serialize", "parse", "3pc",
           "dispatch_wait", "execute", "reply")
 
+# named sub-stages of the execute budget line (conflict-lane executor,
+# server/executor.py): plan+prefetch / per-request validate-apply /
+# merged hash resolution. They carry the execute category, so their
+# exclusive time already lands in the execute stage — the sub-stage
+# report says WHICH of the three owns it. (The device work nested
+# inside hash_resolve keeps charging dispatch_wait, exactly like the
+# fused window always has.)
+EXECUTE_SUBSTAGES = ("exec_validate", "lane_apply", "hash_resolve")
+
 # span names whose category alone would misfile them: the intake auth
 # seams are device dispatches, but they are the INTAKE stage's cost;
 # the wire pack/parse spans sit inside 3PC/propagate flush handlers but
@@ -70,35 +79,44 @@ def stage_of(name: str, cat: str) -> Optional[str]:
     return _CAT_TO_STAGE.get(cat)
 
 
-def _exclusive_ms(spans: List[Tuple[float, float, str]]) -> Dict[str, float]:
-    """(t0, t1, stage) spans from ONE single-threaded recorder →
-    per-stage EXCLUSIVE milliseconds. Nested spans (device windows
-    inside an apply, batch intakes inside a flush) are charged to their
-    own stage and subtracted from the enclosing span's stage."""
+def _exclusive_ms(spans: List[Tuple[float, float, str, str]]
+                  ) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """(t0, t1, stage, name) spans from ONE single-threaded recorder →
+    (per-stage, per-execute-sub-stage) EXCLUSIVE milliseconds. Nested
+    spans (device windows inside an apply, batch intakes inside a
+    flush) are charged to their own stage and subtracted from the
+    enclosing span's stage; the named executor sub-stages additionally
+    accumulate their own exclusive time so the execute line splits
+    into validate / lane-apply / hash-resolve populations."""
     out: Dict[str, float] = {s: 0.0 for s in STAGES}
+    subs: Dict[str, float] = {s: 0.0 for s in EXECUTE_SUBSTAGES}
     # parents sort before their children; among equal starts the longer
     # span is the parent
     spans = sorted(spans, key=lambda s: (s[0], -s[1]))
-    stack: List[List] = []   # [t0, t1, stage, child_time]
+    stack: List[List] = []   # [t0, t1, stage, name, child_time]
     def _close(entry):
-        t0, t1, stage, child = entry
+        t0, t1, stage, name, child = entry
+        excl = max(0.0, (t1 - t0) - child) * 1e3
         if stage is not None:
-            out[stage] += max(0.0, (t1 - t0) - child) * 1e3
+            out[stage] += excl
+        if name in subs:
+            subs[name] += excl
         if stack:
-            stack[-1][3] += t1 - t0
-    for t0, t1, stage in spans:
+            stack[-1][4] += t1 - t0
+    for t0, t1, stage, name in spans:
         while stack and t0 >= stack[-1][1]:
             _close(stack.pop())
-        stack.append([t0, t1, stage, 0.0])
+        stack.append([t0, t1, stage, name, 0.0])
     while stack:
         _close(stack.pop())
-    return out
+    return out, subs
 
 
 def budget_from_tracers(tracers: Iterable) -> dict:
     """Live ``Tracer`` buffers (one per node) → the budget report (see
     :func:`_report`)."""
     per_node: List[Dict[str, float]] = []
+    per_node_subs: List[Dict[str, float]] = []
     ordered: List[int] = []
     for tracer in tracers:
         if tracer is None:
@@ -107,19 +125,21 @@ def budget_from_tracers(tracers: Iterable) -> dict:
         for kind, name, cat, t0, t1, key, args in tracer.spans():
             if kind != "X":
                 continue
-            spans.append((t0, t1, stage_of(name, cat)))
+            spans.append((t0, t1, stage_of(name, cat), name))
             if name == "batch_apply" and args:
                 n_ordered += int(args.get("batch_size", 0))
         if spans:
-            per_node.append(_exclusive_ms(spans))
+            stage_ms, sub_ms = _exclusive_ms(spans)
+            per_node.append(stage_ms)
+            per_node_subs.append(sub_ms)
             ordered.append(n_ordered)
-    return _report(per_node, ordered)
+    return _report(per_node, ordered, per_node_subs)
 
 
 def budget_from_chrome(doc: dict) -> dict:
     """Exported Chrome trace document (``trace_view`` / scenario
     dumps) → the budget report. Timestamps are microseconds."""
-    by_pid: Dict[int, List[Tuple[float, float, Optional[str]]]] = {}
+    by_pid: Dict[int, List[Tuple[float, float, Optional[str], str]]] = {}
     ordered_by_pid: Dict[int, int] = {}
     for e in doc.get("traceEvents", []):
         if e.get("ph") != "X":
@@ -129,23 +149,31 @@ def budget_from_chrome(doc: dict) -> dict:
         t1 = t0 + e.get("dur", 0) * 1e-6
         name = e.get("name", "")
         by_pid.setdefault(pid, []).append(
-            (t0, t1, stage_of(name, e.get("cat", ""))))
+            (t0, t1, stage_of(name, e.get("cat", "")), name))
         if name == "batch_apply":
             ordered_by_pid[pid] = ordered_by_pid.get(pid, 0) + \
                 int((e.get("args") or {}).get("batch_size", 0))
-    per_node = [_exclusive_ms(spans) for spans in by_pid.values()]
+    per_node, per_node_subs = [], []
+    for spans in by_pid.values():
+        stage_ms, sub_ms = _exclusive_ms(spans)
+        per_node.append(stage_ms)
+        per_node_subs.append(sub_ms)
     ordered = [ordered_by_pid.get(pid, 0) for pid in by_pid]
-    return _report(per_node, ordered)
+    return _report(per_node, ordered, per_node_subs)
 
 
-def _report(per_node: List[Dict[str, float]], ordered: List[int]) -> dict:
+def _report(per_node: List[Dict[str, float]], ordered: List[int],
+            per_node_subs: List[Dict[str, float]] = None) -> dict:
     """Merge per-node stage totals into the budget report:
 
     * ``ordered_reqs`` — requests applied (max across nodes: every
       node applies every batch, stragglers just show fewer),
     * ``stage_ms_per_node`` — average total host-ms per stage per node,
     * ``host_ms_per_ordered_req`` — per-stage average host-ms one
-      ordered request costs ONE node, plus ``total``.
+      ordered request costs ONE node, plus ``total``,
+    * ``execute_substages`` — the execute line split into the lane
+      executor's validate / lane-apply / hash-resolve populations
+      (ms per ordered request; absent when nothing recorded them).
     """
     n_nodes = len(per_node)
     n_ordered = max(ordered) if ordered else 0
@@ -155,13 +183,21 @@ def _report(per_node: List[Dict[str, float]], ordered: List[int]) -> dict:
     per_req = {s: (avg[s] / n_ordered if n_ordered else 0.0)
                for s in STAGES}
     per_req["total"] = sum(per_req[s] for s in STAGES)
-    return {
+    report = {
         "nodes": n_nodes,
         "ordered_reqs": n_ordered,
         "stage_ms_per_node": {s: round(avg[s], 2) for s in STAGES},
         "host_ms_per_ordered_req": {
             s: round(v, 4) for s, v in per_req.items()},
     }
+    if per_node_subs and n_nodes and any(
+            any(v for v in subs.values()) for subs in per_node_subs):
+        sub_avg = {s: sum(subs.get(s, 0.0) for subs in per_node_subs)
+                   / n_nodes for s in EXECUTE_SUBSTAGES}
+        report["execute_substages"] = {
+            s: round(sub_avg[s] / n_ordered if n_ordered else 0.0, 4)
+            for s in EXECUTE_SUBSTAGES}
+    return report
 
 
 # telemetry stage-latency histogram feeding each budget stage's
@@ -205,6 +241,7 @@ def format_table(report: dict, telemetry_snapshot: dict = None) -> str:
     lines = [header]
     per_req = report["host_ms_per_ordered_req"]
     total = per_req.get("total") or 0.0
+    substages = report.get("execute_substages") or {}
     for stage in STAGES:
         share = (per_req[stage] / total * 100.0) if total else 0.0
         line = "%-14s %14.2f %18.4f %5.1f%%" % (
@@ -214,6 +251,12 @@ def format_table(report: dict, telemetry_snapshot: dict = None) -> str:
             line += " %12s" % (("%.3f" % p99s[stage])
                                if stage in p99s else "-")
         lines.append(line)
+        if stage == "execute" and substages:
+            # the conflict-lane executor's split of the execute budget
+            for name in EXECUTE_SUBSTAGES:
+                lines.append("  %-12s %14s %18.4f" % (
+                    name.replace("exec_", ""), "",
+                    substages.get(name, 0.0)))
     lines.append("%-14s %14s %18.4f" % (
         "total", "", total))
     if p99s and telemetry_snapshot:

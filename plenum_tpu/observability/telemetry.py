@@ -83,6 +83,12 @@ class TM:
     STAGE_COMMIT_MS = "stage_commit_ms"        # batch commit (durable)
     STAGE_REPLY_MS = "stage_reply_ms"          # reply construct + proofs
 
+    # ---- conflict-lane executor (server/executor.py): per-batch lane
+    # accounting — how parallel the declared-key partition actually is
+    EXEC_LANES_PER_BATCH = "exec_lanes_per_batch"    # hist: lane count
+    EXEC_CONFLICT_PCT = "exec_conflict_pct"          # hist: 0..100
+    EXEC_SERIAL_FALLBACK = "exec_serial_fallback_reqs"  # counter
+
     # ---- wire plane (flat zero-copy codec; recorded into the SEAM
     # hub — the wire is a process-shared resource like the device
     # seams, and pool-wide reports merge it the same way)

@@ -20,6 +20,16 @@ every chip — each row is an independent Keccak absorb, so the SPMD
 program has zero collectives; smaller batches keep the single-device
 path, and the gate below them is the caller's (Config
 STATE_DEVICE_BATCH_MIN routes tiny batches to hashlib on host).
+
+The merged multi-state resolver (state/device_state.resolve_applies,
+conflict-lane executor) is the third caller: it concatenates level N
+of EVERY state trie a batch wrote into one ``hash_nodes`` launch, so
+a mixed domain+pool+config batch pays one dispatch per level total,
+not one per state — the batch axis does the merging, this module
+needs no new shapes. Its routing gate is its own
+(Config.EXEC_MERGED_DEVICE_HASH: device only on real accelerators),
+because at MPT node counts hashlib beats per-level dispatch overhead
+on CPU hosts.
 """
 from __future__ import annotations
 

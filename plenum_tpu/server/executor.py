@@ -4,6 +4,32 @@ Bridges OrderingService (which speaks request digests and roots) to the
 WriteRequestManager pipeline (reference: the Node.executeBatch /
 apply_reqs glue, plenum/server/node.py:2661 + ordering_service
 create_3pc_batch). Replaces SimExecutor in full-node pools.
+
+Shard-parallel deterministic execution (docs/execution.md): each
+ordered batch runs through three sub-stages, each its own flight-
+recorder span so ``scripts/trace_budget`` attributes the execute
+budget line by line:
+
+* ``exec_validate`` — resolve every request, collect the handlers'
+  declared state touches (``WriteRequestHandler.touched_keys``),
+  partition the batch into deterministic execution lanes (union-find
+  over shared keys, server/execution_lanes.py), pre-invalidate handler
+  read caches for the batch's declared writes, and prefetch every
+  declared read key's pre-batch value in ONE deduplicated walk per
+  state (``PruningState.begin_read_window``).
+* ``lane_apply`` — the per-request validate→apply stream in batch
+  order (the canonical schedule every schedule must be byte-equal to);
+  validation reads are dict hits against pending-buffer + read window.
+* ``hash_resolve`` — ONE merged hash resolution for every state the
+  batch wrote (``flush_states_merged``: per-state bulk structural
+  merge, then all states' dirty nodes hashed in shared level-wise
+  SHA3 dispatches), overlapped with the ledger leaf-hash launches and
+  the verifier-hub kick inside the fused device window.
+
+Lane assignment is a pure function of the ordered batch — every honest
+node partitions identically — and the applied state is a function of
+batch order alone, so lanes can never diverge roots (tests +
+bench gate assert byte-equality against the serial path).
 """
 from __future__ import annotations
 
@@ -17,8 +43,10 @@ from plenum_tpu.consensus.ordering_service import BatchExecutor
 from plenum_tpu.observability.tracing import (
     CAT_DEVICE, CAT_EXECUTE, NullTracer)
 from plenum_tpu.observability.telemetry import TM, NullTelemetryHub
+from plenum_tpu.server.execution_lanes import plan_lanes
 from plenum_tpu.server.three_pc_batch import ThreePcBatch
 from plenum_tpu.server.write_request_manager import WriteRequestManager
+from plenum_tpu.state.pruning_state import flush_states_merged
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
 
 logger = logging.getLogger(__name__)
@@ -34,7 +62,8 @@ class NodeBatchExecutor(BatchExecutor):
                  on_request_rejected: Callable[[str, str, int],
                                                None] = None,
                  fused_dispatch: bool = True,
-                 device_kick: Callable[[], None] = None):
+                 device_kick: Callable[[], None] = None,
+                 lanes: bool = None, lane_min: int = None):
         """requests_source(digest) → Request (the propagator's store).
         get_pp_seq_no() → seq of the batch being applied NOW (the
         ordering service's apply position + 1) — must survive catchup
@@ -42,7 +71,10 @@ class NodeBatchExecutor(BatchExecutor):
         primaries_for_view(view_no) → primaries of that view — keyed by
         the batch's ORIGINAL view so re-applied batches reproduce the
         same audit txn (reference PrimaryBatchHandler.post_batch_applied
-        selects primaries from three_pc_batch.original_view_no)."""
+        selects primaries from three_pc_batch.original_view_no).
+        lanes/lane_min: conflict-lane execution (Config.EXEC_LANES /
+        EXEC_LANE_MIN when None)."""
+        from plenum_tpu.common.config import Config
         self.write_manager = write_manager
         self._requests_source = requests_source
         self.metrics = NullMetricsCollector()  # node injects the real one
@@ -63,12 +95,24 @@ class NodeBatchExecutor(BatchExecutor):
         # (CoalescingVerifierHub) into that same window.
         self._fused = fused_dispatch
         self._device_kick = device_kick
+        self._lanes = getattr(Config, "EXEC_LANES", True) \
+            if lanes is None else lanes
+        self._lane_min = getattr(Config, "EXEC_LANE_MIN", 8) \
+            if lane_min is None else lane_min
         # staged batches by apply order (mirrors write manager staging)
         self._staged: List[ThreePcBatch] = []
 
     @property
     def db(self):
         return self.write_manager.database_manager
+
+    def _next_pp_seq_no(self) -> int:
+        """Seq number of the batch being applied NOW: the ordering
+        service's position when wired, the local counter's successor in
+        standalone use (bench/tests) — single-sourced for the reject
+        path and the post-apply advance."""
+        return self._get_pp_seq_no() if self._get_pp_seq_no is not None \
+            else self._pp_seq_no + 1
 
     # -------------------------------------------------------------- apply
 
@@ -84,11 +128,46 @@ class NodeBatchExecutor(BatchExecutor):
             return self._apply_batch(pre_prepare_digests, ledger_id,
                                      pp_time, pp_digest, original_view_no)
 
+    def _plan_and_prefetch(self, requests: List[Request], key: str,
+                           windows: List):
+        """exec_validate sub-stage: declared touches → lane plan →
+        cache pre-invalidation → one read-window prefetch per touched
+        state. Installed windows append to the CALLER's `windows` list
+        as they open, so the caller's finally closes every window even
+        when a later prefetch raises mid-way. → the lane plan."""
+        touched = self.write_manager.touched_keys
+        with self.tracer.span("exec_validate", CAT_EXECUTE, key=key,
+                              batch_size=len(requests)) as sp:
+            plan = plan_lanes([touched(r) for r in requests])
+            self.telemetry.observe(TM.EXEC_LANES_PER_BATCH, plan.n_lanes)
+            self.telemetry.observe(TM.EXEC_CONFLICT_PCT,
+                                   plan.conflict_ratio * 100.0)
+            if plan.serial_requests:
+                self.telemetry.count(TM.EXEC_SERIAL_FALLBACK,
+                                     plan.serial_requests)
+            self.write_manager.invalidate_read_caches(
+                plan.write_keys_by_ledger)
+            for lid, keys in plan.read_keys_by_ledger.items():
+                state = self.db.get_state(lid)
+                if state is not None and state.begin_read_window(keys):
+                    windows.append(state)
+            sp.add(lanes=plan.n_lanes, serial=plan.serial_requests)
+        return plan
+
     def _apply_batch(self, pre_prepare_digests: List[str], ledger_id: int,
                      pp_time: int, pp_digest: str = "",
                      original_view_no: int = None) -> Tuple[str, str, str]:
         ledger = self.db.get_ledger(ledger_id)
         state = self.db.get_state(ledger_id)
+        requests: List[Request] = []
+        for digest in pre_prepare_digests:
+            request = self._requests_source(digest)
+            if request is None:
+                raise KeyError(
+                    "request {} not available for apply".format(digest))
+            requests.append(request)
+        plan = None
+        windows: List = []
         valid = []
         # state updates happen per request (later requests' validation
         # must see them), but the ledger staging of the whole batch is
@@ -98,63 +177,48 @@ class NodeBatchExecutor(BatchExecutor):
         seq_base: Dict[int, int] = {}
         validate = self.write_manager.dynamic_validation
         apply_deferred = self.write_manager.apply_request_deferred
-        for digest in pre_prepare_digests:
-            request = self._requests_source(digest)
-            if request is None:
-                raise KeyError(
-                    "request {} not available for apply".format(digest))
-            try:
-                validate(request, pp_time)
-            except Exception as e:
-                logger.info("request %s failed dynamic validation: %s",
+        try:
+            if self._lanes and len(requests) >= self._lane_min:
+                plan = self._plan_and_prefetch(
+                    requests, pp_digest or None, windows)
+            with self.tracer.span(
+                    "lane_apply", CAT_EXECUTE, key=pp_digest or None,
+                    batch_size=len(requests),
+                    lanes=plan.n_lanes if plan else 0):
+                # batch order is the canonical schedule: every request
+                # observes exactly the writes ordered before it (reads
+                # go pending-buffer → read window → trie), so the lane
+                # machinery can never diverge from serial semantics
+                for digest, request in zip(pre_prepare_digests, requests):
+                    try:
+                        validate(request, pp_time)
+                    except Exception as e:
+                        logger.info(
+                            "request %s failed dynamic validation: %s",
                             digest, e)
-                seq = self._get_pp_seq_no() if self._get_pp_seq_no \
-                    else self._pp_seq_no + 1
-                self._on_request_rejected(digest, str(e), seq)
-                continue
-            handler_lid = self.write_manager.ledger_id_for_request(request)
-            group = staged.get(handler_lid)
-            if group is None:
-                group = staged[handler_lid] = []
-                seq_base[handler_lid] = self.db.get_ledger(
-                    handler_lid).uncommitted_size
-            txn, _lgr = apply_deferred(
-                request, pp_time,
-                seq_base[handler_lid] + len(group) + 1)
-            group.append(txn)
-            valid.append(digest)
-        if self._fused and staged:
-            # FUSED per-batch device window: launch every ledger group's
-            # leaf-hash dispatch, kick the verifier hub's queued
-            # generation into the same window, run the MPT pending-apply
-            # (the state head read flushes the batch's buffered writes
-            # through the device trie engine) WHILE those launches are
-            # in flight, then collect the staged hashes — one overlapped
-            # round trip where the per-message path serialized them.
-            # Results are bit-identical: the three streams touch
-            # disjoint structures and each collect point is unchanged.
-            with self.telemetry.timer(TM.STAGE_DISPATCH_MS), \
-                    self.tracer.span(
-                    "fused_dispatch", CAT_DEVICE, key=pp_digest or None,
-                    groups=len(staged), batch_size=len(valid)):
-                in_flight = [
-                    (lid, self.db.get_ledger(lid).stage_txns_dispatch(
-                        txns))
-                    for lid, txns in staged.items()]
-                if self._device_kick is not None:
-                    self._device_kick()
-                state_root = ledger.hashToStr(state.headHash) \
-                    if state else ""
-                for lid, handle in in_flight:
-                    self.db.get_ledger(lid).stage_txns_collect(handle)
-        else:
-            for lid, txns in staged.items():
-                self.db.get_ledger(lid).appendTxns(txns)
-            state_root = ledger.hashToStr(state.headHash) if state else ""
-        if self._get_pp_seq_no is not None:
-            self._pp_seq_no = self._get_pp_seq_no()
-        else:
-            self._pp_seq_no += 1
+                        self._on_request_rejected(
+                            digest, str(e), self._next_pp_seq_no())
+                        continue
+                    handler_lid = self.write_manager.ledger_id_for_request(
+                        request)
+                    group = staged.get(handler_lid)
+                    if group is None:
+                        group = staged[handler_lid] = []
+                        seq_base[handler_lid] = self.db.get_ledger(
+                            handler_lid).uncommitted_size
+                    txn, _lgr = apply_deferred(
+                        request, pp_time,
+                        seq_base[handler_lid] + len(group) + 1)
+                    group.append(txn)
+                    valid.append(digest)
+        finally:
+            for st in windows:
+                st.end_read_window()
+        with self.tracer.span("hash_resolve", CAT_EXECUTE,
+                              key=pp_digest or None, groups=len(staged)):
+            state_root = self._stage_and_resolve(staged, state, ledger,
+                                                 len(valid), pp_digest)
+        self._pp_seq_no = self._next_pp_seq_no()
         txn_root = ledger.hashToStr(ledger.uncommitted_root_hash)
         view_no = self._get_view_no()
         ov = original_view_no if original_view_no is not None else view_no
@@ -176,6 +240,50 @@ class NodeBatchExecutor(BatchExecutor):
         audit = self.db.get_ledger(AUDIT_LEDGER_ID)
         audit_root = audit.hashToStr(audit.uncommitted_root_hash)
         return state_root, txn_root, audit_root
+
+    def _stage_and_resolve(self, staged: Dict[int, List[dict]], state,
+                           ledger, n_valid: int, pp_digest: str) -> str:
+        """hash_resolve sub-stage: stage every ledger group's txns and
+        resolve every written state's dirty trie nodes in ONE merged
+        level-wise pass, all inside the fused device window."""
+        if self._fused and staged:
+            # FUSED per-batch device window: launch every ledger group's
+            # leaf-hash dispatch, kick the verifier hub's queued
+            # generation into the same window, run the merged MPT
+            # pending-resolve (per-state bulk structural merge + shared
+            # level-wise hash dispatches across ALL written states)
+            # WHILE those launches are in flight, then collect the
+            # staged hashes — one overlapped round trip where the
+            # per-message path serialized them. Results are
+            # bit-identical: the streams touch disjoint structures and
+            # each collect point is unchanged.
+            with self.telemetry.timer(TM.STAGE_DISPATCH_MS), \
+                    self.tracer.span(
+                    "fused_dispatch", CAT_DEVICE, key=pp_digest or None,
+                    groups=len(staged), batch_size=n_valid):
+                in_flight = [
+                    (lid, self.db.get_ledger(lid).stage_txns_dispatch(
+                        txns))
+                    for lid, txns in staged.items()]
+                if self._device_kick is not None:
+                    self._device_kick()
+                state_root = self._resolve_states(staged, state, ledger)
+                for lid, handle in in_flight:
+                    self.db.get_ledger(lid).stage_txns_collect(handle)
+        else:
+            for lid, txns in staged.items():
+                self.db.get_ledger(lid).appendTxns(txns)
+            state_root = self._resolve_states(staged, state, ledger)
+        return state_root
+
+    def _resolve_states(self, staged: Dict[int, List[dict]], state,
+                        ledger) -> str:
+        """Merge every written state's hash resolution (lanes and
+        ledgers share the level-wise dispatches); the batch ledger's
+        head read afterwards is a no-op flush."""
+        if self._lanes and staged:
+            flush_states_merged([self.db.get_state(lid) for lid in staged])
+        return ledger.hashToStr(state.headHash) if state else ""
 
     # ------------------------------------------------------------- revert
 

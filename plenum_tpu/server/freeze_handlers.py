@@ -25,6 +25,7 @@ from plenum_tpu.common.txn_util import (
 from plenum_tpu.server.batch_handlers import (
     AUDIT_TXN_LEDGER_ROOT, AUDIT_TXN_LEDGERS_SIZE, AUDIT_TXN_STATE_ROOT)
 from plenum_tpu.server.database_manager import DatabaseManager
+from plenum_tpu.server.execution_lanes import TouchedKeys
 from plenum_tpu.server.request_handlers import (
     ReadRequestHandler, WriteRequestHandler, decode_state_value,
     encode_state_value, nym_to_state_key)
@@ -59,6 +60,17 @@ class LedgersFreezeHandler(WriteRequestHandler):
                 request.identifier, request.reqId,
                 "base ledgers {} can't be frozen".format(
                     tuple(VALID_LEDGER_IDS)))
+
+    def touched_keys(self, request: Request):
+        """One fixed config key (the frozen-ledger registry) plus the
+        author's domain record — both computable from the request, so
+        freezes lane-plan despite reading the audit ledger (lane keys
+        cover STATE touches; ledger reads don't conflict)."""
+        return TouchedKeys(
+            reads=((CONFIG_LEDGER_ID, FROZEN_LEDGERS_PATH),
+                   (DOMAIN_LEDGER_ID,
+                    nym_to_state_key(request.identifier or ""))),
+            writes=((CONFIG_LEDGER_ID, FROZEN_LEDGERS_PATH),))
 
     def dynamic_validation(self, request: Request, req_pp_time=None):
         domain_state = self.database_manager.get_state(DOMAIN_LEDGER_ID)

@@ -301,7 +301,12 @@ class Node:
             # the authnr's verifier may have a whole intake generation
             # queued — flush it into the fused window so the device
             # verifies while the host applies
-            device_kick=lambda: self.authnr.flush())
+            device_kick=lambda: self.authnr.flush(),
+            # conflict-lane execution (docs/execution.md): declared-key
+            # lane planning + batched read prefetch + merged hash
+            # resolution per applied batch
+            lanes=getattr(self.config, "EXEC_LANES", True),
+            lane_min=getattr(self.config, "EXEC_LANE_MIN", None))
         # ---- freshness: stale ledgers get empty batches so BLS-signed
         # state roots never age past the timeout (reference
         # replica_freshness_checker.py)

@@ -29,6 +29,7 @@ from plenum_tpu.common.request import Request
 from plenum_tpu.common.txn_util import (
     get_from, get_payload_data, get_seq_no, get_txn_time)
 from plenum_tpu.server.database_manager import DatabaseManager
+from plenum_tpu.server.execution_lanes import TouchedKeys
 
 from plenum_tpu.native import try_load_ext
 
@@ -74,6 +75,19 @@ class WriteRequestHandler(RequestHandler):
     @abstractmethod
     def update_state(self, txn: dict, prev_result, request: Request,
                      is_committed: bool = False): ...
+
+    def touched_keys(self, request: Request):
+        """Declared state touches for the conflict-lane executor
+        (server/execution_lanes.py): a ``TouchedKeys`` whose read/write
+        sets are a SUPERSET of every ``state.get``/``state.set`` key
+        this handler's ``dynamic_validation`` + ``update_state`` can
+        reach for `request` — computable from the request alone, never
+        from state content. Return None when the key set is inherently
+        dynamic (whole-state scans, digest chains read from state):
+        the request then takes the designated serial lane and is
+        excluded from batched read prefetch. Lint rule PT011 flags
+        state accesses not reachable from this declaration."""
+        return None
 
     def apply_request(self, request: Request, batch_ts: int):
         """Default apply: reqToTxn + update_state; returns (start, txn)."""
@@ -206,6 +220,22 @@ class NymHandler(WriteRequestHandler):
                 request.identifier, request.reqId,
                 "invalid role {}".format(role))
 
+    def touched_keys(self, request: Request):
+        """NYM touches exactly two keys, both computable from the
+        request: the target nym's record (read in validation, written
+        in update_state) and the author's record (role checks via
+        cached_nym_record)."""
+        dest = request.operation.get(TARGET_NYM)
+        if not isinstance(dest, str) or not dest:
+            return None
+        key = nym_to_state_key(dest)
+        reads = [(DOMAIN_LEDGER_ID, key)]
+        idr = request.identifier
+        if isinstance(idr, str) and idr:
+            reads.append((DOMAIN_LEDGER_ID, nym_to_state_key(idr)))
+        return TouchedKeys(reads=reads,
+                           writes=((DOMAIN_LEDGER_ID, key),))
+
     def dynamic_validation(self, request: Request, req_pp_time=None):
         op = request.operation
         key = nym_to_state_key(op[TARGET_NYM])
@@ -267,6 +297,24 @@ class NymHandler(WriteRequestHandler):
         cached read may now be stale."""
         self._nym_cache.clear()
         self._lookup_memo = None
+
+    def invalidate_for_writes(self, state_keys):
+        """Lane safety for the nym read cache: before a lane-planned
+        batch applies, drop every cached record whose state key the
+        batch DECLARES it will write. In-order apply already pops the
+        written nym at each update_state, so this pre-invalidation is
+        a structural guarantee, not a fix for a live bug: whatever
+        order lanes resolve their reads in, a record the batch touches
+        can never be served from a pre-batch cache entry. Keys that
+        don't decode to an identifier clear the cache wholesale (the
+        nym key codec is identifier.encode(); anything else means the
+        caller's key space changed under us)."""
+        for key in state_keys:
+            try:
+                self._nym_cache.pop(bytes(key).decode(), None)
+            except UnicodeDecodeError:
+                self._nym_cache.clear()
+                return
 
     def update_state(self, txn: dict, prev_result, request: Request,
                      is_committed: bool = False):
@@ -335,6 +383,14 @@ class NodeHandler(WriteRequestHandler):
                 request.identifier, request.reqId,
                 "services must be a list drawn from ['{}']".format(
                     VALIDATOR))
+
+    def touched_keys(self, request: Request):
+        # inherently dynamic key set: alias uniqueness and steward
+        # ownership scan the WHOLE pool state head (_committed_aliases /
+        # _steward_owns_node), so the touched keys are a function of
+        # state content, not of the request — NODE txns take the
+        # serial lane (PT011 baseline records the scans as justified)
+        return None
 
     def dynamic_validation(self, request: Request, req_pp_time=None):
         op = request.operation

@@ -34,6 +34,7 @@ from plenum_tpu.common.request import Request
 from plenum_tpu.common.txn_util import (
     get_payload_data, get_seq_no, get_txn_time)
 from plenum_tpu.server.database_manager import DatabaseManager
+from plenum_tpu.server.execution_lanes import TouchedKeys
 from plenum_tpu.server.request_handlers import (
     ReadRequestHandler, WriteRequestHandler, decode_state_value,
     encode_state_value, nym_to_state_key)
@@ -62,6 +63,15 @@ def _path_aml_latest() -> bytes:
 
 def _path_aml_version(version: str) -> bytes:
     return "taa:aml:v:{}".format(version).encode()
+
+
+# the fixed CONFIG keys every TAA acceptance check can read (active
+# digest, then the AML registry when an acceptance is present) — the
+# write manager widens lane-plan declarations with these
+# (WriteRequestManager.touched_keys); the acceptance-digest slot is
+# per-request (_path_digest)
+TAA_STATIC_READ_KEYS = ((CONFIG_LEDGER_ID, _path_latest()),
+                        (CONFIG_LEDGER_ID, _path_aml_latest()))
 
 
 class TaaAccess:
@@ -236,6 +246,21 @@ class TxnAuthorAgreementAmlHandler(_ConfigWriteHandler):
             raise InvalidClientRequest(
                 request.identifier, request.reqId,
                 "AML must be a non-empty mechanisms dict")
+
+    def touched_keys(self, request: Request):
+        """AML state paths are pure functions of the request (version
+        string), so the handler can declare: the version slot read by
+        uniqueness validation, the author's domain record, and the
+        latest+versioned slots update_state writes."""
+        version = request.operation.get(AML_VERSION)
+        if not isinstance(version, str) or not version:
+            return None
+        reads = [(CONFIG_LEDGER_ID, _path_aml_version(version)),
+                 (DOMAIN_LEDGER_ID,
+                  nym_to_state_key(request.identifier or ""))]
+        return TouchedKeys(reads=reads, writes=(
+            (CONFIG_LEDGER_ID, _path_aml_latest()),
+            (CONFIG_LEDGER_ID, _path_aml_version(version))))
 
     def dynamic_validation(self, request: Request, req_pp_time=None):
         self._require_trustee(request)

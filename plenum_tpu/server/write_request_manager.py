@@ -46,6 +46,10 @@ class WriteRequestManager:
         self.txn_version_controller = TxnVersionController()
         # staged batches in apply order: (ledger_id, txn_count)
         self._applied_batches: List[Tuple[int, int]] = []
+        # lazily-resolved TAA key helpers for touched_keys (hot lane-
+        # planning path: one tuple lookup instead of two imports per
+        # request)
+        self._taa_key_helpers = None
 
     # -------------------------------------------------------- registration
 
@@ -130,6 +134,62 @@ class WriteRequestManager:
 
     def ledger_id_for_request(self, request: Request) -> int:
         return self.request_handlers[request.txn_type].ledger_id
+
+    # --------------------------------------------------- execution lanes
+
+    def touched_keys(self, request: Request):
+        """The request's declared state touches for lane planning
+        (server/execution_lanes.py): the handler's own declaration
+        widened by the pipeline reads dynamic_validation performs on
+        the handler's behalf — TAA acceptance checks read the active
+        agreement / acceptance digest / AML records out of the CONFIG
+        state for every write on a TAA-protected ledger. None =
+        undeclared (serial lane)."""
+        handler = self.request_handlers.get(request.txn_type)
+        if handler is None:
+            return None
+        tk = handler.touched_keys(request)
+        if tk is None:
+            return None
+        if self.taa_validator is not None and \
+                self.database_manager.is_taa_acceptance_required(
+                    handler.ledger_id):
+            taa = self._taa_key_helpers
+            if taa is None:
+                from plenum_tpu.common.constants import (
+                    CONFIG_LEDGER_ID, TAA_ACCEPTANCE_DIGEST)
+                from plenum_tpu.server.taa_handlers import (
+                    TAA_STATIC_READ_KEYS, _path_digest)
+                taa = self._taa_key_helpers = (
+                    CONFIG_LEDGER_ID, TAA_ACCEPTANCE_DIGEST,
+                    TAA_STATIC_READ_KEYS, TAA_STATIC_READ_KEYS[:1],
+                    _path_digest)
+            config_lid, digest_field, all_keys, latest_only, path = taa
+            acceptance = request.taaAcceptance
+            if acceptance:
+                extra = list(all_keys)
+                digest = acceptance.get(digest_field)
+                if isinstance(digest, str):
+                    extra.append((config_lid, path(digest)))
+            else:
+                extra = latest_only  # taa:latest only
+            tk = tk.with_reads(extra)
+        return tk
+
+    def invalidate_read_caches(self, write_keys_by_ledger) -> None:
+        """Lane safety: before a planned batch applies, drop every
+        handler read-cache entry for a state key the batch DECLARES it
+        will write (NymHandler.invalidate_for_writes) — no cached
+        pre-batch record can survive into a batch that rewrites it,
+        whatever order lanes resolve their reads in."""
+        for lid, keys in write_keys_by_ledger.items():
+            for handler in self.request_handlers.values():
+                if handler.ledger_id != lid:
+                    continue
+                invalidate = getattr(handler, "invalidate_for_writes",
+                                     None)
+                if invalidate is not None:
+                    invalidate(keys)
 
     def apply_request_deferred(self, request: Request, batch_ts: int,
                                seq_no: int) -> Tuple[dict, object]:

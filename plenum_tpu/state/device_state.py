@@ -1,5 +1,6 @@
 """Device-resident MPT state engine — batched trie reads, level-wise
-SHA3 node hashing, and state proofs at read scale.
+SHA3 node hashing, merged multi-state hash resolution, and state
+proofs at read scale.
 
 ``state/`` was the last pure-Python crypto hot path: the trie walks one
 key at a time and hashes every dirty node one ``hashlib.sha3_256`` call
@@ -34,6 +35,30 @@ Results are byte-equal to the pure-Python ``Trie`` (roots, values and
 proof nodes — randomized equivalence in tests/test_device_state.py);
 levels below ``Config.STATE_DEVICE_HASH_FLOOR`` use hashlib on host,
 where the scalar path wins on latency (the root level is one node).
+
+The conflict-lane executor (server/executor.py, PR 13) splits
+``apply_batch`` into two halves so MANY states' batches share one set
+of hash dispatches per applied 3PC batch:
+
+ - ``begin_apply``: the structural half alone — a whole batch's writes
+   merge into the standing trie through ONE recursive bulk merge
+   (``_bulk_merge``: sorted keys descend shared path nodes once per
+   batch, not once per key — ~2x fewer node visits/copies than per-key
+   ``_update`` walks), returning a ``_DeferredApply`` whose dirty
+   nodes await hashing.
+ - ``resolve_applies``: resolves ANY number of deferred applies (one
+   per written state — domain / pool / config in a mixed batch)
+   bottom-up with SHARED level-wise SHA3 dispatches: level N of every
+   participating trie hashes in the same launch, so lanes and ledgers
+   merge at the hash step for free. Hash routing follows the sha256
+   "tiled"-backend precedent: device dispatches only where a real
+   accelerator serves them (``Config.EXEC_MERGED_DEVICE_HASH`` =
+   "auto"); on CPU hosts hashlib beats per-level dispatch overhead at
+   MPT node counts.
+
+Both halves are byte-equal to ``apply_batch`` (and to the host trie):
+the MPT is content-canonical, so the bulk merge and the per-key walk
+produce the identical tree for the identical final mapping.
 """
 from __future__ import annotations
 
@@ -44,7 +69,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from plenum_tpu.observability.tracing import CAT_DEVICE, NullTracer
 from plenum_tpu.state import rlp
 from plenum_tpu.state.trie import (
-    BLANK_NODE, BLANK_ROOT, Trie, bytes_to_nibbles, hp_decode)
+    BLANK_NODE, BLANK_ROOT, Trie, bytes_to_nibbles, hp_decode, hp_encode)
 
 
 class CorruptStateError(Exception):
@@ -62,6 +87,119 @@ class _DeferredTrie(Trie):
         if node == BLANK_NODE:
             return BLANK_NODE
         return node
+
+
+# ------------------------------------------------ bulk structural merge
+#
+# One recursive merge of a SORTED key set into the standing trie: every
+# shared path node (the root branch, hot spine extensions) is loaded
+# and copied once per batch, where per-key ``_update`` walks copy it
+# once per key. The MPT is content-canonical — the same final mapping
+# yields the same tree whatever the insertion schedule — so the merge
+# is byte-equal to per-key updates (randomized equivalence in
+# tests/test_executor_lanes.py). Deletes stay on the per-key ``_delete``
+# path (branch collapse is order-local and deletes are rare).
+
+def _lcp_sorted(items) -> int:
+    """Longest common prefix length over sorted item nibble tuples —
+    for a sorted list this is lcp(first, last)."""
+    first = items[0][0]
+    last = items[-1][0]
+    m = 0
+    n = min(len(first), len(last))
+    while m < n and first[m] == last[m]:
+        m += 1
+    return m
+
+
+def _build_subtree(items):
+    """Fresh in-memory subtree for sorted (nibbles, value) items under
+    a blank slot. Unique keys; at most one item can terminate exactly
+    at the common prefix (a prefix sorts before its extensions)."""
+    if len(items) == 1:
+        nib, val = items[0]
+        return [hp_encode(list(nib), True), val]
+    m = _lcp_sorted(items)
+    branch = [BLANK_NODE] * 16 + [BLANK_NODE]
+    i = 0
+    n = len(items)
+    if len(items[0][0]) == m:
+        branch[16] = items[0][1]
+        i = 1
+    while i < n:
+        c = items[i][0][m]
+        j = i
+        while j < n and items[j][0][m] == c:
+            j += 1
+        branch[c] = _build_subtree(
+            [(nib[m + 1:], val) for nib, val in items[i:j]])
+        i = j
+    if m:
+        return [hp_encode(list(items[0][0][:m]), False), branch]
+    return branch
+
+
+def _bulk_merge(trie, node, items):
+    """Merge sorted (nibbles, value) items into ``node`` (in-memory
+    form, deferred refs — children held inline). → the new node."""
+    if not items:
+        return node
+    if node == BLANK_NODE:
+        return _build_subtree(items)
+    if len(node) == 17:  # branch: group items by first nibble
+        node = list(node)
+        i = 0
+        n = len(items)
+        if len(items[0][0]) == 0:
+            node[16] = items[0][1]
+            i = 1
+        while i < n:
+            c = items[i][0][0]
+            j = i
+            while j < n and items[j][0][0] == c:
+                j += 1
+            group = [(nib[1:], val) for nib, val in items[i:j]]
+            node[c] = _bulk_merge(trie, trie._load(node[c]), group)
+            i = j
+        return node
+    path, terminal = hp_decode(bytes(node[0]))
+    if terminal:
+        # absorb the existing leaf as one more item (an exact-match
+        # item overwrites it) and rebuild the subtree fresh
+        merged = {tuple(path): bytes(node[1])}
+        for nib, val in items:
+            merged[tuple(nib)] = val
+        return _build_subtree(sorted(merged.items()))
+    # extension: find the earliest divergence of any item against path
+    tp = tuple(path)
+    lp = len(tp)
+    m = lp
+    for nib, _ in items:
+        k = 0
+        n2 = min(len(nib), lp)
+        while k < n2 and nib[k] == tp[k]:
+            k += 1
+        if k < m:
+            m = k
+            if m == 0:
+                break
+    if m == lp:  # every item continues through the extension
+        sub = _bulk_merge(trie, trie._load(node[1]),
+                          [(nib[lp:], val) for nib, val in items])
+        return [node[0], sub]
+    # branch at the divergence point; the extension remainder keeps
+    # the old child (same shapes per-key _update produces on a split)
+    rest = tp[m:]
+    branch = [BLANK_NODE] * 16 + [BLANK_NODE]
+    if len(rest) == 1:
+        branch[rest[0]] = node[1]
+    else:
+        branch[rest[0]] = [hp_encode(list(rest[1:]), False), node[1]]
+    branch = _bulk_merge(trie, branch,
+                         [(nib[m:], val) for nib, val in items])
+    if m:
+        return [hp_encode(list(tp[:m]), False), branch]
+    return branch
 
 
 class _Walk:
@@ -96,17 +234,6 @@ class DeviceStateEngine:
         self.host_hash_calls = 0
 
     # ------------------------------------------------------------ hashing
-
-    def _hash_level(self, blobs: List[bytes]) -> List[bytes]:
-        """SHA3-256 one level of node blobs: device above the floor,
-        hashlib below it (root-adjacent levels are one or two nodes)."""
-        if len(blobs) < self.hash_floor:
-            self.host_hash_calls += 1
-            return [hashlib.sha3_256(b).digest() for b in blobs]
-        from plenum_tpu.ops import trie_jax
-        self.dispatches += 1
-        return [bytes(row) for row in trie_jax.collect_node_hash_batch(
-            trie_jax.dispatch_node_hash_batch(blobs))]
 
     def _verify_level(self, blobs: List[bytes], refs: List[bytes]) -> None:
         """Hash-verify a level of loaded blobs against their refs —
@@ -295,41 +422,36 @@ class DeviceStateEngine:
         encoded bytes stay inline (never persisted — same as _ref),
         larger ones batch into one SHA3 dispatch per level and are
         written through hash → blob. The root is always hashed and
-        persisted (Trie._set_root contract)."""
-        put = self._store.put
-        if root_node == BLANK_NODE:
-            encoded = rlp.encode(b"")
-            put(BLANK_ROOT, encoded)
-            return BLANK_ROOT
-        nodes, heights = self._collect_heights(root_node)
-        by_height = defaultdict(list)
-        for nid, node in nodes.items():
-            by_height[heights[nid]].append((nid, node))
-        resolved: Dict[int, object] = {}
-        root_id = id(root_node)
-        root_encoded = None
-        for h in sorted(by_height):
-            level_ids: List[int] = []
-            level_blobs: List[bytes] = []
-            for nid, node in by_height[h]:
-                subst = [resolved[id(c)] if type(c) is list else c
-                         for c in node]
-                encoded = rlp.encode(subst)
-                if nid == root_id:
-                    root_encoded = encoded
-                elif len(encoded) < 32:
-                    resolved[nid] = subst
-                else:
-                    level_ids.append(nid)
-                    level_blobs.append(encoded)
-            if level_blobs:
-                for nid, blob, dig in zip(level_ids, level_blobs,
-                                          self._hash_level(level_blobs)):
-                    put(dig, blob)
-                    resolved[nid] = dig
-        root_digest = hashlib.sha3_256(root_encoded).digest()
-        put(root_digest, root_encoded)
-        return root_digest
+        persisted (Trie._set_root contract). ONE implementation serves
+        both the legacy whole-batch apply and the merged multi-state
+        path: this is the single-handle case of ``_resolve_applies``,
+        with device routing pinned on (the PR-6 contract — this seam's
+        own ``hash_floor`` already keeps small levels on hashlib)."""
+        return _resolve_applies([_DeferredApply(self, root_node, [])],
+                                on_device=True, floor=self.hash_floor)[0]
+
+    # ------------------------------------------- deferred (merged) apply
+
+    def begin_apply(self, root_hash: bytes,
+                    pairs: Sequence[Tuple[bytes, bytes]]) -> "_DeferredApply":
+        """The structural half of apply_batch: merge the batch's writes
+        into the standing trie through ONE recursive bulk merge (sorted
+        keys descend shared path nodes once per batch) with hashing
+        deferred. The returned handle's dirty nodes are resolved later —
+        together with other states' handles — by :func:`resolve_applies`,
+        so every lane's and every ledger's batch shares one set of
+        level-wise SHA3 dispatches. ``begin_apply`` + single-handle
+        ``resolve_applies`` is byte-equal to :meth:`apply_batch` (the
+        MPT is content-canonical)."""
+        trie = _DeferredTrie(self._store, bytes(root_hash))
+        node = trie._root_node()
+        sets = sorted((tuple(bytes_to_nibbles(bytes(k))), bytes(v))
+                      for k, v in pairs if v)
+        node = _bulk_merge(trie, node, sets)
+        for k, v in pairs:
+            if not v:
+                node = trie._delete(node, bytes_to_nibbles(bytes(k)))
+        return _DeferredApply(self, node, list(pairs))
 
     @staticmethod
     def _collect_heights(root_node):
@@ -366,3 +488,123 @@ class DeviceStateEngine:
             "device_dispatches": self.dispatches,
             "host_hash_calls": self.host_hash_calls,
         }
+
+
+class _DeferredApply:
+    """One state's structural batch update awaiting hash resolution.
+
+    ``pairs`` is retained so a failed merged resolve can fall back to
+    the host trie path with the identical write set; ``state`` is set
+    by PruningState.begin_flush_deferred so the resolver can hand each
+    new root back to its owner."""
+
+    __slots__ = ("engine", "root_node", "pairs", "state")
+
+    def __init__(self, engine: DeviceStateEngine, root_node, pairs):
+        self.engine = engine
+        self.root_node = root_node
+        self.pairs = pairs
+        self.state = None
+
+
+def merged_hash_on_device(use_device=None) -> bool:
+    """Routing policy for the merged resolve's level hashing
+    (``Config.EXEC_MERGED_DEVICE_HASH``): "auto" keeps device
+    dispatches for hosts with a real accelerator and takes hashlib on
+    CPU hosts, where per-level dispatch overhead loses to scalar SHA3
+    at MPT node counts (the sha256 "tiled" CPU-backend precedent);
+    True / False force one side (tests pin the dispatch path)."""
+    if use_device is None:
+        from plenum_tpu.common.config import Config
+        use_device = getattr(Config, "EXEC_MERGED_DEVICE_HASH", "auto")
+    if use_device == "auto":
+        from plenum_tpu.ops.mesh import is_accelerator
+        return is_accelerator()
+    return bool(use_device)
+
+
+def resolve_applies(applies: Sequence[_DeferredApply],
+                    use_device=None) -> List[bytes]:
+    """Resolve MANY deferred applies bottom-up with SHARED level-wise
+    SHA3 dispatches: level N of every participating trie (one per
+    written state — domain / pool / config in a mixed batch) hashes in
+    the same launch, so execution lanes and ledgers merge at the hash
+    step for free. → the new root per apply, byte-equal to resolving
+    each handle alone (node digests are content hashes — independent
+    of which launch computed them). Nodes under 32 encoded bytes stay
+    inline (never persisted — the ``_ref`` contract); every root is
+    hashed and persisted (``Trie._set_root`` contract)."""
+    if not applies:
+        return []
+    on_device = merged_hash_on_device(use_device)
+    floor = min(ap.engine.hash_floor for ap in applies)
+    tracer = applies[0].engine.tracer
+    n_pairs = sum(len(ap.pairs) for ap in applies)
+    with tracer.span("state_apply_merged", CAT_DEVICE,
+                     n=n_pairs, states=len(applies)) as sp:
+        d0 = applies[0].engine.dispatches
+        roots = _resolve_applies(applies, on_device, floor)
+        sp.add(dispatches=applies[0].engine.dispatches - d0)
+    return roots
+
+
+def _hash_level_merged(applies, blobs, on_device, floor):
+    """SHA3-256 one merged level: one device dispatch above the floor
+    when routed on-device, hashlib otherwise (stats land on the first
+    handle's engine — the launch is shared)."""
+    if on_device and len(blobs) >= floor:
+        from plenum_tpu.ops import trie_jax
+        applies[0].engine.dispatches += 1
+        return trie_jax.hash_nodes(blobs)
+    applies[0].engine.host_hash_calls += 1
+    return [hashlib.sha3_256(b).digest() for b in blobs]
+
+
+def _resolve_applies(applies, on_device, floor) -> List[bytes]:
+    roots: List[Optional[bytes]] = [None] * len(applies)
+    # collect each handle's in-memory nodes keyed by id + height
+    per = []
+    by_height = defaultdict(list)   # height -> [(apply_idx, nid, node)]
+    for ai, ap in enumerate(applies):
+        if ap.root_node == BLANK_NODE:
+            encoded = rlp.encode(b"")
+            ap.engine._store.put(BLANK_ROOT, encoded)
+            roots[ai] = BLANK_ROOT
+            per.append(None)
+            continue
+        nodes, heights = DeviceStateEngine._collect_heights(ap.root_node)
+        per.append(nodes)
+        for nid, node in nodes.items():
+            by_height[heights[nid]].append((ai, nid, node))
+    resolved: List[Dict[int, object]] = [{} for _ in applies]
+    root_ids = [id(ap.root_node) if per[ai] is not None else None
+                for ai, ap in enumerate(applies)]
+    root_encoded: List[Optional[bytes]] = [None] * len(applies)
+    for h in sorted(by_height):
+        level_owner: List[Tuple[int, int]] = []   # (apply_idx, nid)
+        level_blobs: List[bytes] = []
+        for ai, nid, node in by_height[h]:
+            res = resolved[ai]
+            subst = [res[id(c)] if type(c) is list else c for c in node]
+            encoded = rlp.encode(subst)
+            if nid == root_ids[ai]:
+                root_encoded[ai] = encoded
+            elif len(encoded) < 32:
+                res[nid] = subst
+            else:
+                level_owner.append((ai, nid))
+                level_blobs.append(encoded)
+        if level_blobs:
+            digs = _hash_level_merged(applies, level_blobs,
+                                      on_device, floor)
+            for (ai, nid), blob, dig in zip(level_owner, level_blobs,
+                                            digs):
+                applies[ai].engine._store.put(dig, blob)
+                resolved[ai][nid] = dig
+    for ai, ap in enumerate(applies):
+        if roots[ai] is not None:
+            continue
+        dig = hashlib.sha3_256(root_encoded[ai]).digest()
+        ap.engine._store.put(dig, root_encoded[ai])
+        roots[ai] = dig
+    return roots

@@ -68,6 +68,10 @@ class State(ABC):
 
 from plenum_tpu.common.config import Config as _Config
 
+# read-window miss marker: the window stores None for keys ABSENT at
+# the pre-batch root (a hit that must not fall through to a trie walk)
+_WINDOW_MISS = object()
+
 
 class PruningState(State):
     # key under which the committed root hash survives restarts
@@ -100,6 +104,13 @@ class PruningState(State):
         # bumps on every write; validation memos key on it (cheaper than
         # forcing a flush to compare head roots)
         self.mutation_count = 0
+        # prefetched read window (conflict-lane executor): pre-batch
+        # values for the batch's DECLARED read keys, served by
+        # uncommitted get() after the pending-buffer check — a key
+        # written this batch is in _pending (exact), an unwritten key's
+        # pre-batch value is the window's (exact), so the window can
+        # never serve a stale value. Any flush or rewind drops it.
+        self._read_window: Optional[dict] = None
         self._engine = None
         self._engine_breaker = None
 
@@ -164,6 +175,10 @@ class PruningState(State):
     def _flush_pending(self):
         if not self._pending:
             return
+        # the window holds PRE-BATCH values; once the batch's writes
+        # land in the trie the pending-first shield is gone, so the
+        # window must go with it
+        self._read_window = None
         pending, self._pending = self._pending, {}
         if self._engine is not None \
                 and len(pending) >= self._engine_batch_min:
@@ -177,15 +192,48 @@ class PruningState(State):
             if root is not None:
                 self._trie.root_hash = root
                 return
+        self._host_apply_pairs(pending)
+
+    def begin_flush_deferred(self):
+        """The structural half of a pending-buffer flush (conflict-lane
+        executor): merge the whole buffer into the trie with hashing
+        deferred and return a ``_DeferredApply`` handle for the shared
+        :func:`flush_states_merged` resolve — so a batch that writes
+        several ledgers' states hashes ALL their dirty nodes in one set
+        of level-wise dispatches. Returns None when the host path
+        already served the flush (no engine, open breaker, or a buffer
+        below the batch threshold — identical routing to
+        ``_flush_pending``)."""
+        if not self._pending:
+            return None
+        if self._engine is None \
+                or len(self._pending) < self._engine_batch_min:
+            self._flush_pending()
+            return None
+        self._read_window = None
+        pending, self._pending = self._pending, {}
+        handle = self._engine_call(
+            lambda eng: eng.begin_apply(self._trie.root_hash,
+                                        list(pending.items())),
+            "begin_apply")
+        if handle is None:
+            self._host_apply_pairs(pending)
+            return None
+        handle.state = self
+        return handle
+
+    def _host_apply_pairs(self, pending: dict) -> None:
+        """Host-trie fallback for a popped pending buffer (engine
+        failure mid-flush): same write set, same final root."""
         set_many = getattr(self._trie, "set_many", None)
         if set_many is not None:
             set_many(list(pending.items()))
-        else:
-            for k, v in pending.items():
-                if v:
-                    self._trie.set(k, v)
-                else:
-                    self._trie.delete(k)
+            return
+        for k, v in pending.items():
+            if v:
+                self._trie.set(k, v)
+            else:
+                self._trie.delete(k)
 
     def get(self, key: bytes, isCommitted: bool = True) -> Optional[bytes]:
         if isCommitted:
@@ -193,6 +241,11 @@ class PruningState(State):
         k = bytes(key)
         if k in self._pending:
             return self._pending[k] or None
+        win = self._read_window
+        if win is not None:
+            hit = win.get(k, _WINDOW_MISS)
+            if hit is not _WINDOW_MISS:
+                return hit
         return self._trie.get(k)
 
     def get_for_root_hash(self, root_hash: bytes, key: bytes
@@ -235,6 +288,42 @@ class PruningState(State):
                 return vals
         return [self._trie.get_at_root(root_hash, k) for k in keys]
 
+    # -------------------------------------------------------- read window
+
+    def begin_read_window(self, keys: Sequence[bytes]) -> bool:
+        """Prefetch pre-batch values for the batch's DECLARED read keys
+        into one dict (conflict-lane executor, server/executor.py): the
+        per-request validation/apply reads those keys as dict hits
+        instead of one trie walk each. Exactness holds for ANY
+        interleaving of reads and writes because uncommitted ``get``
+        checks the pending write buffer first — the window only ever
+        answers for keys untouched so far this batch, where the
+        pre-batch value IS the serial value. → True if a window was
+        installed."""
+        if not keys:
+            return False
+        root = self._trie.root_hash
+        win: dict = {}
+        missing: List[bytes] = []
+        for k in keys:
+            kb = bytes(k)
+            if kb not in self._pending:
+                missing.append(kb)
+        if missing:
+            # host walks, one per key: the trie's decode cache holds the
+            # hot spine, so this beats the engine's lockstep walk on
+            # every measured shape (the walk is host work either way —
+            # the device only ever hash-VERIFIES, which own-state apply
+            # reads skip under the host trust-the-store contract)
+            get_at_root = self._trie.get_at_root
+            for k in missing:
+                win[k] = get_at_root(root, k)
+        self._read_window = win
+        return True
+
+    def end_read_window(self) -> None:
+        self._read_window = None
+
     # ------------------------------------------------------- commit/revert
 
     def commit(self, rootHash: Optional[bytes] = None):
@@ -250,6 +339,7 @@ class PruningState(State):
 
     def revertToHead(self, headHash: bytes):
         self._pending.clear()  # buffered writes belong to the abandoned head
+        self._read_window = None
         self.mutation_count += 1
         self._trie.root_hash = headHash
 
@@ -350,3 +440,31 @@ class PruningState(State):
 
     def close(self):
         self._kv.close()
+
+
+def flush_states_merged(states, use_device=None) -> None:
+    """Flush MANY states' pending buffers through ONE merged hash
+    resolution (conflict-lane executor, server/executor.py): each
+    state's structural update runs with hashing deferred
+    (``begin_flush_deferred``), then every participating trie's dirty
+    nodes resolve together in shared level-wise SHA3 dispatches
+    (state/device_state.resolve_applies). States the engine cannot
+    serve (no engine, open breaker, sub-threshold buffers) flush
+    through their host path inside ``begin_flush_deferred``; a failed
+    merged resolve falls back to the host trie per state with the
+    identical write set — roots are byte-equal on every path."""
+    handles = [h for h in (st.begin_flush_deferred() for st in states
+                           if st is not None) if h is not None]
+    if not handles:
+        return
+    from plenum_tpu.state.device_state import resolve_applies
+    first = handles[0].state
+    ok, roots = first._engine_breaker.run(
+        lambda: resolve_applies(handles, use_device=use_device),
+        "resolve_merged")
+    if ok:
+        for h, root in zip(handles, roots):
+            h.state._trie.root_hash = root
+        return
+    for h in handles:
+        h.state._host_apply_pairs(dict(h.pairs))

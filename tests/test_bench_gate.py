@@ -117,3 +117,19 @@ def test_host_ms_tripwire_tolerates_missing_current():
     bench = _gate()
     flags = bench.host_ms_regression_flags(None)
     assert flags["warn"] is None
+
+
+def test_host_ms_tripwire_covers_execute_stage():
+    """ISSUE 13: the best-prior tripwire extends to the execute stage
+    the conflict-lane executor owns — a worse current execute warns
+    even when the total improved."""
+    bench = _gate()
+    flags = bench.host_ms_regression_flags(0.00001, 10 ** 9)
+    best = flags["best_prior"] or {}
+    if "execute" in best:
+        assert flags["warn"] and ".execute" in flags["warn"][0]
+    else:
+        assert flags["warn"] is None
+    # both stages clean -> silent
+    flags = bench.host_ms_regression_flags(0.00001, 0.00001)
+    assert flags["warn"] is None

@@ -664,6 +664,107 @@ def test_pt010_tree_has_only_justified_baseline_entries():
     assert len(baselined) == 2
 
 
+# --------------------------------------------------------------- PT011
+
+# declaration drift the conflict-lane executor must never suffer: a
+# write handler whose validation/apply reaches state keys its
+# touched_keys declaration cannot produce (or that never declares)
+PT011_BAD = """
+    class DriftingHandler(WriteRequestHandler):
+        def touched_keys(self, request):
+            key = thing_to_state_key(request.operation["dest"])
+            return TouchedKeys(reads=((1, key),), writes=((1, key),))
+
+        def dynamic_validation(self, request, req_pp_time=None):
+            # reachable: same recipe as the declaration
+            key = thing_to_state_key(request.operation["dest"])
+            self.state.get(key, isCommitted=False)
+            # NOT reachable: a second key family the declaration
+            # never mentions
+            self.state.get(owner_index_key(request.identifier))
+
+        def update_state(self, txn, prev_result, request,
+                         is_committed=False):
+            self.state.set(b"some:literal:key", b"v")
+            # shadowing touched_keys' own local name must not grant
+            # reachability to an undeclared recipe
+            key = owner_index_key(request.identifier)
+            self.state.get(key)
+
+
+    class UndeclaredHandler(WriteRequestHandler):
+        def dynamic_validation(self, request, req_pp_time=None):
+            self.state.get(thing_to_state_key(request.operation["d"]))
+
+        def update_state(self, txn, prev_result, request,
+                         is_committed=False):
+            domain_state = self.database_manager.get_state(1)
+            domain_state.set(thing_to_state_key("x"), b"v")
+"""
+
+PT011_GOOD = """
+    class DeclaredHandler(WriteRequestHandler):
+        def touched_keys(self, request):
+            key = thing_to_state_key(request.operation["dest"])
+            return TouchedKeys(
+                reads=((1, key), (1, REGISTRY_PATH)),
+                writes=((1, key), (1, REGISTRY_PATH)))
+
+        def dynamic_validation(self, request, req_pp_time=None):
+            key = thing_to_state_key(request.operation["dest"])
+            self.state.get(key, isCommitted=False)
+            self.state.get(REGISTRY_PATH, isCommitted=False)
+
+        def update_state(self, txn, prev_result, request,
+                         is_committed=False):
+            self.state.set(
+                thing_to_state_key(get_payload_data(txn)["dest"]), b"v")
+            self.state.set(REGISTRY_PATH, b"r")
+
+
+    class NotAHandler:
+        # state-shaped calls outside WriteRequestHandler classes are
+        # out of scope
+        def update_state(self, txn):
+            self.state.set(b"whatever", b"v")
+
+
+    class ReadSide(ReadRequestHandler):
+        def get_result(self, request):
+            return self.state.get(b"anything")
+"""
+
+
+def test_pt011_fires_on_undeclared_and_unreachable_keys():
+    findings = check_snippet(rule_by_code("PT011"), PT011_BAD,
+                             "plenum_tpu/server/handlers_x.py")
+    # DriftingHandler: owner_index_key get + literal set + the
+    # local-name-shadowing get; UndeclaredHandler: both accesses
+    # (incl. the get_state local)
+    assert len(findings) == 5
+    msgs = [f.message for f in findings]
+    assert sum("not reachable" in m for m in msgs) == 3
+    assert sum("no touched_keys declaration" in m for m in msgs) == 2
+    assert {f.symbol.split(".")[0] for f in findings} \
+        == {"DriftingHandler", "UndeclaredHandler"}
+
+
+def test_pt011_clean_on_declared_recipes():
+    assert check_snippet(rule_by_code("PT011"), PT011_GOOD,
+                         "plenum_tpu/server/handlers_x.py") == []
+
+
+def test_pt011_tree_has_only_justified_baseline_entries():
+    # NODE (whole-state scans) and the TAA digest-chain handlers are
+    # inherently dynamic: serial-lane opt-outs carried as justified
+    # baseline entries; nothing NEW may appear
+    new, baselined, _ = run_analysis(
+        [os.path.join(REPO, "plenum_tpu")], select=["PT011"],
+        baseline_path=os.path.join(REPO, "lint_baseline.json"))
+    assert new == []
+    assert len(baselined) == 8
+
+
 # -------------------------------------------------------------- pragmas
 
 def test_inline_pragma_suppresses_one_line():
