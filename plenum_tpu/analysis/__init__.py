@@ -1,6 +1,6 @@
 """plenum-lint — AST-based consensus-safety and device-hygiene analyzer.
 
-Rules encode this repo's shipped-and-fixed bug classes (PT001–PT006;
+Rules encode this repo's shipped-and-fixed bug classes (PT001–PT014;
 see docs/static_analysis.md). Pure stdlib ``ast`` — importing or
 running the analyzer never initializes JAX or any native extension,
 which is what lets tests/test_lint_clean.py gate tier-1 in-process.

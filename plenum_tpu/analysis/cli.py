@@ -29,21 +29,42 @@ JSON_SCHEMA_VERSION = 1
 def changed_py_files(root: str) -> List[str]:
     """Tracked-modified + untracked .py files, repo-relative. A failing
     git (not a repo, binary missing, hang) raises RuntimeError — the
-    pre-commit gate must fail CLOSED, not read as an empty diff."""
-    out: List[str] = []
-    for args in (["git", "diff", "--name-only", "HEAD", "--"],
-                 ["git", "ls-files", "--others", "--exclude-standard"]):
+    pre-commit gate must fail CLOSED, not read as an empty diff.
+
+    Renames are followed: ``--name-status -M`` reports ``R<score>\\t
+    old\\tnew`` and the NEW path joins the scan set (a plain
+    ``--name-only``/``--diff-filter`` diff dropped renamed files, so a
+    renamed file with findings exited clean)."""
+    def run_git(*args: str) -> str:
         try:
-            res = subprocess.run(args, cwd=root, capture_output=True,
-                                 text=True, timeout=30)
+            res = subprocess.run(["git", *args], cwd=root,
+                                 capture_output=True, text=True,
+                                 timeout=30)
         except (OSError, subprocess.TimeoutExpired) as e:
             raise RuntimeError("cannot run git for --changed: %s" % e)
         if res.returncode != 0:
             raise RuntimeError(
-                "git failed for --changed (%s): %s" % (
-                    " ".join(args), res.stderr.strip() or res.returncode))
-        out.extend(line.strip() for line in res.stdout.splitlines()
-                   if line.strip())
+                "git failed for --changed (git %s): %s" % (
+                    " ".join(args),
+                    res.stderr.strip() or res.returncode))
+        return res.stdout
+
+    out: List[str] = []
+    for line in run_git("diff", "--name-status", "-M", "HEAD",
+                        "--").splitlines():
+        parts = line.rstrip("\n").split("\t")
+        if len(parts) < 2 or not parts[0]:
+            continue
+        status = parts[0][0]
+        if status == "D":
+            continue
+        # renames/copies list "R<score>\told\tnew" — scan the NEW path
+        out.append(parts[2] if status in ("R", "C") and len(parts) > 2
+                   else parts[1])
+    out.extend(line.strip() for line in
+               run_git("ls-files", "--others",
+                       "--exclude-standard").splitlines()
+               if line.strip())
     seen, files = set(), []
     for rel in out:
         if rel.endswith(".py") and rel not in seen:
@@ -93,6 +114,51 @@ def _to_json(findings: List[Finding], baselined: set,
     }
 
 
+def _callgraph_mode(root: str, needle: str) -> int:
+    """Resolve one symbol in the whole-program engine and print its
+    summary, direct callees and callers — the triage companion for
+    PT012–PT014 findings (which report at the SOURCE site; this walks
+    the reach)."""
+    from plenum_tpu.analysis.core import Analyzer
+    from plenum_tpu.analysis.engine import Engine
+    pkg = os.path.join(root, "plenum_tpu")
+    if not os.path.isdir(pkg):
+        print("plenum_lint: no plenum_tpu/ package under %s" % root,
+              file=sys.stderr)
+        return 2
+    files = Analyzer([], root).collect_files([pkg])
+    eng = Engine.build(files, root)
+    matches = eng.graph.find_symbol(needle)
+    if not matches:
+        print("plenum_lint: no symbol matches %r" % needle,
+              file=sys.stderr)
+        return 2
+    for sym in matches[:10]:
+        fn = eng.function(sym)
+        s = eng.summaries.get(sym)
+        print("%s  (%s:%d)" % (eng.symbol_display(sym),
+                               eng.path_of(sym), fn["line"]))
+        if s is not None:
+            print("  summary: pure=%s nondet=%s returns_open=%s "
+                  "closes=%s buckets=%s" % (
+                      s.pure, sorted(s.nondet) or "-",
+                      sorted(s.returns_open) or "-",
+                      sorted(s.closes) or "-", s.routes_bucket))
+        callees = eng.graph.callees(sym)
+        callers = eng.graph.callers(sym)
+        print("  callees (%d):" % len(callees))
+        for c in callees:
+            print("    -> %s" % eng.symbol_display(c))
+        print("  callers (%d):" % len(callers))
+        for c in callers:
+            print("    <- %s" % eng.symbol_display(c))
+        print()
+    if len(matches) > 10:
+        print("plenum_lint: %d more matches not shown"
+              % (len(matches) - 10))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="plenum_lint", description=__doc__,
@@ -103,6 +169,14 @@ def main(argv=None) -> int:
                     help="lint only .py files in the git diff "
                          "(tracked-modified + untracked)")
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--sarif", action="store_true", dest="as_sarif",
+                    help="emit SARIF 2.1.0 (CI/code-review ingestion; "
+                         "baselined findings carry baselineState="
+                         "unchanged)")
+    ap.add_argument("--callgraph", default=None, metavar="SYMBOL",
+                    help="debugging mode: resolve SYMBOL (qualified "
+                         "or bare name) in the whole-program engine "
+                         "and print its summary, callees and callers")
     ap.add_argument("--root", default=None,
                     help="repo root (default: autodetected from the "
                          "package location)")
@@ -132,6 +206,10 @@ def main(argv=None) -> int:
         return 0
 
     root = os.path.abspath(args.root) if args.root else repo_root()
+
+    if args.callgraph:
+        return _callgraph_mode(root, args.callgraph)
+
     try:
         severities = _parse_severities(args.severity)
         rules = build_rules(
@@ -195,7 +273,11 @@ def main(argv=None) -> int:
     new, old = baseline.match(findings)
     baselined = set(old)
 
-    if args.as_json:
+    if args.as_sarif:
+        from plenum_tpu.analysis.sarif import to_sarif
+        print(json.dumps(to_sarif(findings, baselined, rules),
+                         indent=2))
+    elif args.as_json:
         print(json.dumps(_to_json(findings, baselined, len(files)),
                          indent=2))
     else:

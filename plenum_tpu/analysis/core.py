@@ -34,6 +34,22 @@ PRAGMA_RE = re.compile(
 FILE_PRAGMA_HEAD_LINES = 5
 
 
+def iter_pragmas(lines):
+    """Yield ``(lineno, codes, file_wide)`` for every pragma comment —
+    the ONE implementation of the pragma syntax, shared by the
+    per-module rules (ModuleContext) and the whole-program engine
+    (engine/symtab.py), so both suppression layers can never drift."""
+    for i, line in enumerate(lines, start=1):
+        m = PRAGMA_RE.search(line)
+        if not m:
+            continue
+        codes = {c.strip().upper() for c in m.group(1).split(",")
+                 if c.strip()}
+        file_wide = (i <= FILE_PRAGMA_HEAD_LINES
+                     and line.strip().startswith("#"))
+        yield i, codes, file_wide
+
+
 @dataclass(frozen=True)
 class Finding:
     rule: str        # "PT001"
@@ -111,14 +127,9 @@ class ModuleContext:
     # ------------------------------------------------------------ pragmas
 
     def _scan_pragmas(self) -> None:
-        for i, line in enumerate(self.lines, start=1):
-            m = PRAGMA_RE.search(line)
-            if not m:
-                continue
-            codes = {c.strip().upper() for c in m.group(1).split(",")
-                     if c.strip()}
+        for i, codes, file_wide in iter_pragmas(self.lines):
             self.line_pragmas.setdefault(i, set()).update(codes)
-            if i <= FILE_PRAGMA_HEAD_LINES and line.strip().startswith("#"):
+            if file_wide:
                 self.file_pragmas.update(codes)
 
     def suppressed(self, code: str, line: int) -> bool:
@@ -170,6 +181,22 @@ class Rule:
         raise NotImplementedError
 
 
+class ProgramRule(Rule):
+    """Whole-program rule: sees the inter-procedural engine (symbol
+    table, call graph, bottom-up summaries) instead of one module at a
+    time. ``check_program`` runs ONCE per analysis over the full
+    program scope; findings are filtered to the scanned files by the
+    driver, so ``--changed`` stays meaningful while resolution is
+    always whole-tree."""
+
+    def check(self, ctx: ModuleContext) -> List[Finding]:
+        return []
+
+    def check_program(self, engine,
+                      rel_paths) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
 class ParseErrorRule(Rule):
     """Synthetic rule code for unparseable files — a syntax error in the
     scanned tree must fail the gate, not be skipped silently."""
@@ -181,10 +208,16 @@ _PARSE_ERROR = ParseErrorRule()
 
 
 class Analyzer:
-    def __init__(self, rules: Sequence[Rule], root: str):
+    def __init__(self, rules: Sequence[Rule], root: str,
+                 use_engine_cache: bool = True):
         """root: repository root; finding paths are relative to it."""
-        self.rules = list(rules)
+        self.rules = [r for r in rules
+                      if not isinstance(r, ProgramRule)]
+        self.program_rules = [r for r in rules
+                              if isinstance(r, ProgramRule)]
         self.root = os.path.abspath(root)
+        self.use_engine_cache = use_engine_cache
+        self.engine = None  # built lazily by run_files
 
     # --------------------------------------------------------- file walk
 
@@ -221,8 +254,40 @@ class Analyzer:
         findings: List[Finding] = []
         for path in files:
             findings.extend(self.run_one(path))
+        if self.program_rules:
+            findings.extend(self._run_program_rules(files))
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
         return findings
+
+    def _program_scope(self, files: Sequence[str]) -> List[str]:
+        """The file set the engine resolves over: the whole package
+        tree when it exists (inter-procedural rules must see callees
+        outside a --changed diff), else just the scanned files (fixture
+        trees)."""
+        pkg = os.path.join(self.root, "plenum_tpu")
+        scope = list(files)
+        if os.path.isdir(pkg):
+            known = set(scope)
+            scope.extend(p for p in self.collect_files([pkg])
+                         if p not in known)
+        return scope
+
+    def _run_program_rules(self, files: Sequence[str]
+                           ) -> List[Finding]:
+        from plenum_tpu.analysis.engine import Engine
+        if self.engine is None:
+            self.engine = Engine.build(
+                self._program_scope(files), self.root,
+                use_cache=self.use_engine_cache)
+        scanned = {self._rel(p) for p in files}
+        out: List[Finding] = []
+        for rule in self.program_rules:
+            for f in rule.check_program(self.engine, scanned):
+                if f.path in scanned and rule.applies(f.path) \
+                        and not self.engine.suppressed(
+                            f.path, f.rule, f.line):
+                    out.append(f)
+        return out
 
     def run_one(self, path: str) -> List[Finding]:
         rel = self._rel(path)

@@ -29,6 +29,12 @@ from plenum_tpu.analysis.rules.pt010_wire_serializer import (
     WireSerializerLoopRule)
 from plenum_tpu.analysis.rules.pt011_declared_keys import (
     DeclaredKeysRule)
+from plenum_tpu.analysis.rules.pt012_nondeterminism import (
+    NondeterminismRule)
+from plenum_tpu.analysis.rules.pt013_dispatch_collect import (
+    DispatchWithoutCollectRule)
+from plenum_tpu.analysis.rules.pt014_compile_cardinality import (
+    CompileCardinalityRule)
 
 RULE_CLASSES = (
     BlockingCallRule,
@@ -42,6 +48,9 @@ RULE_CLASSES = (
     UnboundedMetricCardinalityRule,
     WireSerializerLoopRule,
     DeclaredKeysRule,
+    NondeterminismRule,
+    DispatchWithoutCollectRule,
+    CompileCardinalityRule,
 )
 
 

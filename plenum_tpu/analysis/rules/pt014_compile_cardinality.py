@@ -1,0 +1,141 @@
+"""PT014 unbounded-compile-cardinality.
+
+XLA compiles one executable per distinct operand SHAPE at every
+``jax.jit`` / ``pallas_call`` boundary. A launch whose batch axis is
+the raw input length therefore pays a fresh multi-second compile for
+every distinct size that ever arrives — the exact shape of two shipped
+incidents: the per-distinct-size Keccak compiles caught in PR 6 review
+(unbucketed trie level sizes), and the r05 bench regression root-caused
+in PR 9. The fix discipline is a handful of sanctioned bounded-shape
+helpers (``pow2_at_least``, ``launch_lanes``, ``mesh.padded_size``,
+the ``pad_messages`` family): every shape that reaches a compiled
+callable must route through one, so the compile cache is bounded by
+O(log sizes) buckets.
+
+Encoding, per launch site (a call resolving to a jit-decorated
+project function, a ``jax.jit(...)``/``pallas_call(...)`` assignment,
+or the ``_build_*(...)(...)`` cached-builder idiom):
+
+* operands CONDITIONALLY bucketed — ``padded_size(B) if sharded else
+  B`` and every value derived from it — always flag: one branch pays
+  per-distinct-shape compiles while the other hides it (the r05
+  shape, and the live bls381 finding this rule shipped with);
+* otherwise the site needs bucket EVIDENCE: a bucket helper in the
+  operand expressions themselves, anywhere in the enclosing function,
+  or in a direct callee (one level — a distant pow2 call must not
+  excuse a raw local launch);
+* all-constant operands are exempt (warm-up calls with literal
+  bucket shapes), as are launches inside jit-decorated functions
+  (traced inline: the outer boundary owns the shape) and
+  ``ops/mesh.py`` (the bucketing layer itself).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from plenum_tpu.analysis.core import Finding, ProgramRule
+
+
+class CompileCardinalityRule(ProgramRule):
+    code = "PT014"
+    name = "unbounded-compile-cardinality"
+
+    @staticmethod
+    def _ancestor_buckets(graph, sym: str) -> bool:
+        """Nested defs (merkle's `launch` closures) share the
+        enclosing function's scope — its bucket calls are evidence."""
+        mod, q = sym.split(":", 1)
+        while "." in q:
+            q = q.rsplit(".", 1)[0]
+            anc = graph.functions.get("%s:%s" % (mod, q))
+            if anc and anc["buckets"]:
+                return True
+        return False
+
+    @staticmethod
+    def _class_buckets(graph, sym: str, fn: dict) -> bool:
+        cls = fn.get("cls")
+        if not cls:
+            return False
+        mod = sym.split(":", 1)[0]
+        prefix = "%s:%s." % (mod, cls)
+        return any(other["buckets"]
+                   for osym, other in graph.functions.items()
+                   if osym.startswith(prefix))
+
+    def applies(self, rel_path: str) -> bool:
+        return (rel_path.startswith("plenum_tpu/")
+                and rel_path != "plenum_tpu/ops/mesh.py")
+
+    def check_program(self, engine, rel_paths) -> List[Finding]:
+        out: List[Finding] = []
+        graph = engine.graph
+        for sym in sorted(graph.functions):
+            fn = graph.functions[sym]
+            if fn.get("jitted"):
+                continue
+            path = graph.fn_path[sym]
+            if path == "plenum_tpu/ops/mesh.py":
+                continue
+            summary = engine.summaries.get(sym)
+            resolved = {id(call): callee
+                        for callee, call in graph.edges[sym]}
+            for call in fn["calls"]:
+                callee = resolved.get(id(call))
+                csum = engine.summaries.get(callee) \
+                    if callee is not None else None
+                launcher = call.get("builder") \
+                    or (csum is not None
+                        and csum.launches_param_shapes) \
+                    or graph.is_jit_callee(sym, call["chain"])
+                if not launcher:
+                    continue
+                name = call["chain"][-1]
+                if call.get("arg_cond_bucketed"):
+                    out.append(Finding(
+                        rule=self.code, severity=self.severity,
+                        path=path, line=call["line"],
+                        col=call["col"],
+                        message=(
+                            "compiled launch %s() with CONDITIONALLY "
+                            "bucketed operand shapes (bucketed on one "
+                            "branch, raw on another) — the unbucketed "
+                            "branch pays one XLA compile per distinct "
+                            "size (the r05 regression shape); route "
+                            "every branch through pow2_at_least/"
+                            "launch_lanes/padded_size" % name),
+                        symbol=fn["qname"]))
+                    continue
+                if call.get("args_all_const") \
+                        or call.get("arg_static"):
+                    # literal or module-constant operands: fixed
+                    # shapes per process, no cardinality to bound
+                    continue
+                if call.get("arg_param_only"):
+                    # pass-through seam: every operand came in through
+                    # the function's own parameters — the summary
+                    # lifts the obligation to this function's callers
+                    # (launches_param_shapes), so no local finding
+                    continue
+                if call.get("arg_bucketed") or fn["buckets"] \
+                        or (summary and summary.routes_bucket) \
+                        or self._ancestor_buckets(graph, sym):
+                    continue
+                if call.get("arg_self_rooted") \
+                        and self._class_buckets(graph, sym, fn):
+                    # operands live on the object; the owning class
+                    # shaped its arrays (pow2 capacities at build /
+                    # growth), which any of its methods evidences
+                    continue
+                out.append(Finding(
+                    rule=self.code, severity=self.severity,
+                    path=path, line=call["line"], col=call["col"],
+                    message=(
+                        "compiled launch %s() with no bucket-routing "
+                        "evidence — operand shapes that don't route "
+                        "through pow2_at_least/launch_lanes/"
+                        "padded_size pay one XLA compile per distinct "
+                        "batch size (the per-distinct-size Keccak "
+                        "incident, PR 6 review)" % name),
+                    symbol=fn["qname"]))
+        return out

@@ -411,7 +411,10 @@ class ViewChangeService:
                 params={"instId": inst_id, "viewNo": view_no}))
             return
         have = self._view_changes[view_no]
-        for frm, digest in {tuple(x) for x in self._new_view.viewChanges}:
+        # sorted: set iteration follows the per-process str hash salt
+        # (PT012) — re-request order must not differ across replicas
+        for frm, digest in sorted(
+                {tuple(x) for x in self._new_view.viewChanges}):
             if frm in have \
                     and view_change_digest(have[frm]) == digest:
                 continue
@@ -643,7 +646,13 @@ class ViewChangeService:
         # set of VIEW_CHANGEs (if we have them all)
         view_no = self._data.view_no
         have = self._view_changes[view_no]
-        referenced = {tuple(x) for x in nv.viewChanges}
+        # sorted: `usable` feeds calc_checkpoint/calc_batches — the
+        # recomputation of the primary's NEW_VIEW decision — and set
+        # iteration order follows the per-process str hash salt
+        # (PT012): replicas fed the identical NEW_VIEW must build the
+        # identical usable list or their accept/reject verdicts could
+        # split on tie-breaks
+        referenced = sorted({tuple(x) for x in nv.viewChanges})
         usable = [have[frm] for frm, digest in referenced
                   if frm in have
                   and view_change_digest(have[frm]) == digest]

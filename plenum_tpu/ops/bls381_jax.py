@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from plenum_tpu.observability import telemetry as _tmy
+from plenum_tpu.ops import pow2_at_least
 
 # ---------------------------------------------------------------- constants
 
@@ -448,7 +449,12 @@ def aggregate_dispatch(jobs, n: int):
     from plenum_tpu.ops import mesh as mesh_mod
     m = mesh_mod.get_mesh()
     sharded = m.should_shard(B)
-    Bp = m.padded_size(B, min_per_device=1) if sharded else B
+    # both branches bucket the job axis: the unsharded path used to
+    # launch the raw B and paid one XLA compile per distinct job-batch
+    # size (the PT014 / r05 regression shape); identity-padded jobs
+    # aggregate to infinity and their rows are sliced off lazily
+    Bp = m.padded_size(B, min_per_device=1) if sharded \
+        else pow2_at_least(max(B, 1))
     # job-axis lane accounting: real shares vs the Bp×n identity-padded
     # grid (short jobs pad with infinity shares, padding jobs are whole
     # wasted rows)
@@ -469,7 +475,10 @@ def aggregate_dispatch(jobs, n: int):
             outs = tuple(o[:B] for o in outs)
         return outs
     m.note_passthrough(B)
-    return _aggregate_kernel(*(jnp.asarray(a) for a in arrays))
+    outs = _aggregate_kernel(*(jnp.asarray(a) for a in arrays))
+    if Bp != B:
+        outs = tuple(o[:B] for o in outs)
+    return outs
 
 
 def aggregate_collect(handles) -> Tuple[List[Optional[Tuple[int, int]]],

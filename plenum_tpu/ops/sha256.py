@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from plenum_tpu.observability import telemetry as _tmy
-from plenum_tpu.ops import scatter_ragged_rows
+from plenum_tpu.ops import pow2_at_least, scatter_ragged_rows
 
 logger = logging.getLogger(__name__)
 
@@ -346,10 +346,20 @@ def sha256_node_pairs_array(pairs: np.ndarray) -> np.ndarray:
     """[m, 64] u8 rows of left||right digests → [m, 32] u8 node digests
     H(0x01||l||r). Digest bytes stay in arrays end to end."""
     pairs = np.ascontiguousarray(pairs, dtype=np.uint8).reshape(-1, 64)
+    m = pairs.shape[0]
+    # bucket the row axis: level-wise bulk builds hand this every
+    # distinct level size, and the raw m paid one XLA compile each
+    # (the PT014 per-distinct-size incident class); pad rows hash
+    # garbage the tail slice drops
+    mp = pow2_at_least(max(m, 1))
+    if mp != m:
+        padded = np.zeros((mp, 64), dtype=np.uint8)
+        padded[:m] = pairs
+        pairs = padded
     words = _node_words_from_digest_pairs(jnp.asarray(pairs))
     nvalid = jnp.full((pairs.shape[0],), 2, dtype=jnp.int32)
     return digests_to_array(np.asarray(
-        sha256_blocks_routed(words, nvalid, 2)))
+        sha256_blocks_routed(words, nvalid, 2)))[:m]
 
 
 def sha256_many(msgs: Sequence[bytes]) -> List[bytes]:

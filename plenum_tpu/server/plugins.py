@@ -31,6 +31,7 @@ import math
 import re
 import sys
 import time
+import zlib
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -50,7 +51,12 @@ TOPIC_CLUSTER_THROUGHPUT_SPIKE = "ClusterThroughputSuspiciousSpike"
 def _load_module_from_file(path: Path):
     """Import one file as a uniquely-named module without touching
     sys.path (plugin dirs must not shadow stdlib names)."""
-    mod_name = "plenum_tpu_plugin_%s_%x" % (path.stem, hash(str(path)) & 0xffffffff)
+    # crc32, not hash(): str hashes are PYTHONHASHSEED-salted, so the
+    # module name would differ per process (PT012 audit; the PR-7
+    # catchup-jitter precedent) — crc32 keeps names stable across
+    # replicas and restarts
+    mod_name = "plenum_tpu_plugin_%s_%x" % (
+        path.stem, zlib.crc32(str(path).encode()))
     if mod_name in sys.modules:
         return sys.modules[mod_name]
     spec = importlib.util.spec_from_file_location(mod_name, path)

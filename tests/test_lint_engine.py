@@ -1,0 +1,499 @@
+"""plenum-lint whole-program engine — symtab, callgraph, summaries,
+cache, SARIF, CLI surfaces.
+
+Pins the engine contracts the PT012–PT014 rule families stand on:
+decorator-aware extraction, method resolution through project base
+classes, call-graph cycle handling (SCC fixpoints), bottom-up summary
+propagation, content-hash cache invalidation and the repeat-run
+speedup gate, SARIF 2.1.0 shape, the rename-following --changed scan
+set, and the --callgraph debugging mode.
+"""
+import json
+import os
+import subprocess
+import textwrap
+import time
+
+import pytest
+
+from plenum_tpu.analysis import repo_root
+from plenum_tpu.analysis.cli import changed_py_files, main as cli_main
+from plenum_tpu.analysis.core import Analyzer
+from plenum_tpu.analysis.engine import Engine, extract_file_facts
+from plenum_tpu.analysis.engine.cache import FactsCache
+from plenum_tpu.analysis.engine.symtab import (
+    collect_families, dispatch_family, module_name)
+
+REPO = repo_root()
+
+
+def build_tree(tmp_path, files):
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return paths
+
+
+def build_engine(tmp_path, files, cache=None):
+    paths = build_tree(tmp_path, files)
+    return Engine.build(sorted(paths), str(tmp_path), cache=cache,
+                        use_cache=cache is not None)
+
+
+# ------------------------------------------------------------- symtab
+
+def test_module_name_and_families():
+    assert module_name("plenum_tpu/ops/sha3.py") == \
+        "plenum_tpu.ops.sha3"
+    assert module_name("plenum_tpu/ops/__init__.py") == \
+        "plenum_tpu.ops"
+    assert dispatch_family("stage_txns_dispatch") == "stage_txns"
+    assert dispatch_family("dispatch_node_hash_batch") == \
+        "node_hash_batch"
+    assert dispatch_family("begin_read_window") == "read_window"
+    assert dispatch_family("collect_node_hash_batch") is None
+    assert "read_window" in collect_families("end_read_window")
+    assert "stage_txns" in collect_families("stage_txns_collect")
+
+
+def test_extraction_records_decorators_and_jit():
+    facts = extract_file_facts("plenum_tpu/ops/k.py", textwrap.dedent(
+        """
+        import functools
+
+        import jax
+
+        @jax.jit
+        def plain_jit(x):
+            return x
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def partial_jit(x, n):
+            return x
+
+        @staticmethod
+        def not_jit(x):
+            return x
+
+        assigned = jax.jit(not_jit)
+        """))
+    by_name = {f["name"]: f for f in facts["functions"]}
+    assert by_name["plain_jit"]["jitted"]
+    assert by_name["partial_jit"]["jitted"]
+    assert by_name["partial_jit"]["decorators"] == \
+        ["functools.partial(jax.jit)"]
+    assert not by_name["not_jit"]["jitted"]
+    assert facts["jit_names"] == ["assigned"]
+
+
+def test_extraction_call_result_flow():
+    facts = extract_file_facts("plenum_tpu/m.py", textwrap.dedent(
+        """
+        def f():
+            a = make()
+            drop()
+            use(make())
+            return make()
+        """))
+    fn = facts["functions"][0]
+    flows = {(c["line"], c["flow"]) for c in fn["calls"]
+             if c["chain"] == ["make"]}
+    assert (3, "named") in flows
+    assert (5, "escapes") in flows
+    assert (6, "returned") in flows
+    drop = [c for c in fn["calls"] if c["chain"] == ["drop"]][0]
+    assert drop["flow"] == "discarded"
+
+
+# ---------------------------------------------------------- callgraph
+
+def test_method_resolution_through_project_bases(tmp_path):
+    eng = build_engine(tmp_path, {
+        "plenum_tpu/base.py": """
+            class BaseHandler:
+                def commit(self):
+                    return 1
+        """,
+        "plenum_tpu/sub.py": """
+            from plenum_tpu.base import BaseHandler
+
+            class NymHandler(BaseHandler):
+                def apply(self):
+                    return self.commit()
+        """,
+    })
+    sym = "plenum_tpu.sub:NymHandler.apply"
+    assert eng.graph.callees(sym) == \
+        ["plenum_tpu.base:BaseHandler.commit"]
+    assert eng.graph.callers("plenum_tpu.base:BaseHandler.commit") \
+        == [sym]
+
+
+def test_unique_name_fallback_and_ambiguity(tmp_path):
+    eng = build_engine(tmp_path, {
+        "plenum_tpu/a.py": """
+            class Engine:
+                def warm_unique(self):
+                    return 1
+
+                def shared(self):
+                    return 2
+        """,
+        "plenum_tpu/b.py": """
+            class Other:
+                def shared(self):
+                    return 3
+
+            def caller(eng):
+                eng.warm_unique()
+                eng.shared()
+        """,
+    })
+    callees = eng.graph.callees("plenum_tpu.b:caller")
+    # unique method name resolves through an unknown receiver;
+    # ambiguous names stay unresolved (over-linking floods taint)
+    assert callees == ["plenum_tpu.a:Engine.warm_unique"]
+
+
+def test_callgraph_cycles_scc_and_taint_fixpoint(tmp_path):
+    eng = build_engine(tmp_path, {
+        "plenum_tpu/cyc.py": """
+            def ping(n):
+                name = str(n)
+                salted = hash(name)
+                return pong(salted)
+
+            def pong(n):
+                return ping(n - 1)
+
+            def outside(n):
+                return pong(n)
+        """,
+    })
+    comps = {frozenset(c) for c in eng.graph.sccs() if len(c) > 1}
+    assert frozenset({"plenum_tpu.cyc:ping",
+                      "plenum_tpu.cyc:pong"}) in comps
+    # taint reaches every member of the cycle AND its callers
+    for sym in ("plenum_tpu.cyc:ping", "plenum_tpu.cyc:pong",
+                "plenum_tpu.cyc:outside"):
+        assert "hash-salted" in eng.summaries[sym].nondet, sym
+
+
+def test_scc_fixpoint_crosses_many_backward_hops(tmp_path):
+    """Regression (review fuzz finding): a fixed pass count per SCC
+    dropped facts that must cross several hops AGAINST the component's
+    processing order — the fixpoint must iterate until stable."""
+    eng = build_engine(tmp_path, {
+        "plenum_tpu/ring.py": """
+            def f1(n):
+                return f2(n)
+
+            def f2(n):
+                return f3(n)
+
+            def f3(n):
+                return f4(n)
+
+            def f4(n):
+                salted = hash(str(n))
+                return f5(salted)
+
+            def f5(n):
+                return f6(n)
+
+            def f6(n):
+                if n > 0:
+                    return f1(n - 1)
+                return n
+        """,
+    })
+    comps = [c for c in eng.graph.sccs() if len(c) > 1]
+    assert len(comps) == 1 and len(comps[0]) == 6
+    for i in range(1, 7):
+        sym = "plenum_tpu.ring:f%d" % i
+        assert "hash-salted" in eng.summaries[sym].nondet, sym
+
+
+def test_summary_returns_open_and_closes(tmp_path):
+    eng = build_engine(tmp_path, {
+        "plenum_tpu/seam.py": """
+            def stage(blobs):
+                return dispatch_node_hash_batch(blobs)
+
+            def finish(handle):
+                return collect_node_hash_batch(handle)
+        """,
+    })
+    stage = eng.summaries["plenum_tpu.seam:stage"]
+    finish = eng.summaries["plenum_tpu.seam:finish"]
+    assert "node_hash_batch" in stage.returns_open
+    assert "node_hash_batch" in finish.closes
+
+
+def test_summary_purity(tmp_path):
+    eng = build_engine(tmp_path, {
+        "plenum_tpu/p.py": """
+            def pure_fn(x):
+                y = x + 1
+                return y
+
+            def impure_fn(self, x):
+                self.total = x
+                return x
+
+            def calls_impure(self, x):
+                return impure_fn(self, x)
+        """,
+    })
+    assert eng.summaries["plenum_tpu.p:pure_fn"].pure
+    assert not eng.summaries["plenum_tpu.p:impure_fn"].pure
+    assert not eng.summaries["plenum_tpu.p:calls_impure"].pure
+
+
+def test_const_shaped_launch_lifts_no_obligation(tmp_path):
+    """Regression (review finding): a launch whose operands carry no
+    caller data (module constants, literal shapes) is fixed per
+    process — it must neither flag nor push a phantom bucket
+    obligation onto its callers."""
+    eng = build_engine(tmp_path, {
+        "plenum_tpu/ops/warm.py": """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            TABLE = np.zeros((64, 8), dtype=np.uint32)
+
+            @jax.jit
+            def _kernel(rows):
+                return rows
+
+            def warmup(cfg):
+                return _kernel(jnp.asarray(TABLE))
+
+            def caller(batch):
+                warmup(None)
+                return len(batch)
+        """,
+    })
+    warm = eng.summaries["plenum_tpu.ops.warm:warmup"]
+    assert not warm.launches_param_shapes
+    from plenum_tpu.analysis.rules.pt014_compile_cardinality import (
+        CompileCardinalityRule)
+    findings = CompileCardinalityRule().check_program(
+        eng, set(eng.files))
+    assert findings == []
+
+
+# -------------------------------------------------------------- cache
+
+TREE_V1 = {
+    "plenum_tpu/one.py": """
+        def f(x):
+            return x
+    """,
+    "plenum_tpu/two.py": """
+        def g(x):
+            return x
+    """,
+}
+
+
+def test_cache_hits_and_content_invalidation(tmp_path):
+    cache_path = str(tmp_path / "cache.json")
+    eng = build_engine(tmp_path, TREE_V1, FactsCache(cache_path))
+    assert eng.stats["parsed"] == 2 and eng.stats["cached"] == 0
+
+    eng = build_engine(tmp_path, TREE_V1, FactsCache(cache_path))
+    assert eng.stats["parsed"] == 0 and eng.stats["cached"] == 2
+
+    # content change re-extracts exactly the edited file
+    (tmp_path / "plenum_tpu" / "one.py").write_text(
+        "def f(x):\n    return x + 1\n")
+    paths = [str(tmp_path / rel) for rel in sorted(TREE_V1)]
+    eng = Engine.build(paths, str(tmp_path),
+                       cache=FactsCache(cache_path))
+    assert eng.stats["parsed"] == 1 and eng.stats["cached"] == 1
+    fn = eng.graph.functions["plenum_tpu.one:f"]
+    assert fn["qname"] == "f"
+
+
+def test_cache_corrupt_and_version_mismatch_degrade_cold(tmp_path):
+    cache_path = str(tmp_path / "cache.json")
+    with open(cache_path, "w") as f:
+        f.write("{ not json")
+    eng = build_engine(tmp_path, TREE_V1, FactsCache(cache_path))
+    assert eng.stats["parsed"] == 2
+    with open(cache_path, "w") as f:
+        json.dump({"schema": 999, "facts_version": 0,
+                   "entries": {}}, f)
+    eng = build_engine(tmp_path, TREE_V1, FactsCache(cache_path))
+    assert eng.stats["parsed"] == 2
+
+
+def test_cache_prunes_deleted_files(tmp_path):
+    cache_path = str(tmp_path / "cache.json")
+    build_engine(tmp_path, TREE_V1, FactsCache(cache_path))
+    paths = [str(tmp_path / "plenum_tpu" / "one.py")]
+    cache = FactsCache(cache_path)
+    Engine.build(paths, str(tmp_path), cache=cache)
+    kept = set(FactsCache(cache_path).entries)
+    assert kept == {"plenum_tpu/one.py"}
+
+
+def test_repeat_whole_tree_build_at_least_3x_faster():
+    """The satellite gate: warm engine builds over the real tree must
+    be >=3x faster than cold (content-hash cache; linking+summaries
+    included in the timing). Best-of-2 on each side to shed noise."""
+    files = Analyzer([], REPO).collect_files(
+        [os.path.join(REPO, "plenum_tpu")])
+    tmp = os.path.join(REPO, ".plenum_lint_cache.test.json")
+    try:
+        cold_s, warm_s = [], []
+        for _ in range(2):
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            cold = Engine.build(files, REPO, cache=FactsCache(tmp))
+            assert cold.stats["parsed"] == len(files)
+            cold_s.append(cold.stats["build_s"])
+            warm = Engine.build(files, REPO, cache=FactsCache(tmp))
+            assert warm.stats["parsed"] == 0
+            assert warm.stats["cached"] == len(files)
+            warm_s.append(warm.stats["build_s"])
+        ratio = min(cold_s) / max(min(warm_s), 1e-9)
+        assert ratio >= 3.0, (
+            "summary cache speedup %.1fx < 3x (cold %.3fs, warm "
+            "%.3fs)" % (ratio, min(cold_s), min(warm_s)))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+# -------------------------------------------------------------- SARIF
+
+def test_sarif_output_shape(tmp_path, capsys):
+    bad = tmp_path / "plenum_tpu" / "ops" / "sha3.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def _kern(words, n):
+            return words
+
+        def dispatch_raw(blobs):
+            arr = np.zeros((len(blobs), 17), dtype=np.uint32)
+            return _kern(jnp.asarray(arr), len(blobs))
+    """))
+    code = cli_main(["--sarif", "--no-baseline",
+                     "--root", str(tmp_path), str(tmp_path)])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert code == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "PT014" in rule_ids and "PT001" in rule_ids
+    results = run["results"]
+    assert any(r["ruleId"] == "PT014" for r in results)
+    r = [r for r in results if r["ruleId"] == "PT014"][0]
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == \
+        "plenum_tpu/ops/sha3.py"
+    assert loc["region"]["startLine"] >= 1
+    assert r["baselineState"] == "new"
+    assert "plenumLintKey/v1" in r["partialFingerprints"]
+
+
+def test_sarif_marks_baselined_unchanged(tmp_path, capsys):
+    bad = tmp_path / "plenum_tpu" / "server" / "svc.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import time
+
+        class S:
+            def process_propagate(self, msg, frm):
+                time.sleep(1)
+    """))
+    code = cli_main(["--json", "--no-baseline", "--root",
+                     str(tmp_path), str(tmp_path)])
+    capsys.readouterr()
+    assert code == 1
+    # grandfather it, then SARIF must carry baselineState unchanged
+    code = cli_main(["--write-baseline", "--root", str(tmp_path),
+                     str(tmp_path)])
+    capsys.readouterr()
+    base = json.load(open(tmp_path / "lint_baseline.json"))
+    for e in base["entries"]:
+        e["justification"] = "pinned for the SARIF test"
+    json.dump(base, open(tmp_path / "lint_baseline.json", "w"))
+    code = cli_main(["--sarif", "--root", str(tmp_path),
+                     str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert code == 0
+    states = [r["baselineState"] for r in doc["runs"][0]["results"]]
+    assert states and set(states) == {"unchanged"}
+
+
+# ----------------------------------------------------- --changed/renames
+
+def _git(tmp_path, *args):
+    subprocess.run(["git", "-C", str(tmp_path), "-c", "user.name=t",
+                    "-c", "user.email=t@t", *args], check=True,
+                   capture_output=True)
+
+
+def test_changed_follows_git_renames(tmp_path):
+    """A renamed file must stay in the --changed scan set under its
+    NEW name (the old --diff-filter scan dropped it, so a renamed
+    file with findings exited clean)."""
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    src = tmp_path / "mod_a.py"
+    src.write_text("def f():\n    return 1\n")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    _git(tmp_path, "mv", "mod_a.py", "mod_b.py")
+    files = changed_py_files(str(tmp_path))
+    rels = {os.path.relpath(f, str(tmp_path)) for f in files}
+    assert rels == {"mod_b.py"}
+
+
+def test_changed_rename_plus_edit_and_untracked(tmp_path):
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    (tmp_path / "keep.py").write_text("x = 1\n")
+    (tmp_path / "old.py").write_text("def g():\n    return 2\n")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    _git(tmp_path, "mv", "old.py", "new.py")
+    (tmp_path / "new.py").write_text("def g():\n    return 3\n")
+    (tmp_path / "fresh.py").write_text("y = 2\n")
+    (tmp_path / "keep.py").unlink()  # deletions never enter the scan
+    files = changed_py_files(str(tmp_path))
+    rels = {os.path.relpath(f, str(tmp_path)) for f in files}
+    assert rels == {"new.py", "fresh.py"}
+
+
+# ----------------------------------------------------------- --callgraph
+
+def test_cli_callgraph_mode_resolves_real_symbol(capsys):
+    code = cli_main(["--callgraph", "aggregate_dispatch",
+                     "--root", REPO])
+    out = capsys.readouterr().out
+    assert code == 0
+    assert "plenum_tpu.ops.bls381_jax.aggregate_dispatch" in out
+    assert "callees" in out and "callers" in out
+    assert "aggregate_g1_jobs" in out        # the known caller
+
+
+def test_cli_callgraph_unknown_symbol_errors(capsys):
+    code = cli_main(["--callgraph", "no_such_symbol_anywhere",
+                     "--root", REPO])
+    err = capsys.readouterr().err
+    assert code == 2
+    assert "no symbol matches" in err

@@ -1009,3 +1009,339 @@ def test_run_analysis_matches_shipped_baseline():
         baseline_path=os.path.join(REPO, "lint_baseline.json"))
     assert new == [], "\n".join(f.render() for f in new)
     assert len(baselined) > 0
+
+
+# ------------------------------------------------- PT012/13/14 (engine)
+
+def check_program(code, files, tmp_path):
+    """Run ONE whole-program rule over a fixture tree: files maps
+    repo-relative paths to sources (written under tmp_path, which
+    acts as the repo root — paths under plenum_tpu/... so root/rule
+    scoping matches production)."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    rule = rule_by_code(code)
+    analyzer = Analyzer([rule], str(tmp_path), use_engine_cache=False)
+    return analyzer.run_files(analyzer.collect_files([str(tmp_path)]))
+
+
+# PT012 — the literal pre-fix PR-7 jitter shape: retry delay derived
+# from hash() of a tuple CONTAINING THE NODE NAME (a str: salted by
+# PYTHONHASHSEED, so every replica computes a different delay stream
+# and seeded sims don't replay), reachable from a consensus root.
+PT012_BAD_JITTER = """
+    class LedgerLeecher:
+        def _schedule_retry(self, retry):
+            salt = str(self._name)
+            unit = hash((salt, self.lid, retry))
+            return (unit % 1000) / 1000.0
+"""
+
+# ...and the shipped fix (catchup.py today): crc32 of the name as an
+# int salt, hash() only over ints (stable in CPython) — stays silent.
+PT012_GOOD_JITTER = """
+    import zlib
+
+    class LedgerLeecher:
+        def __init__(self, name):
+            self._jitter_salt = zlib.crc32(name.encode())
+
+        def _schedule_retry(self, retry):
+            unit = hash((self._jitter_salt, self.lid, retry))
+            return (unit % 1000) / 1000.0
+"""
+
+PT012_ROOT_CALLER = """
+    from plenum_tpu.server.catchup import LedgerLeecher
+
+    class ViewChangeService:
+        def _request_catchup(self, retry):
+            leecher = LedgerLeecher()
+            return leecher._schedule_retry(retry)
+"""
+
+
+def test_pt012_fires_on_prefix_pr7_jitter_shape(tmp_path):
+    findings = check_program("PT012", {
+        "plenum_tpu/server/catchup.py": PT012_BAD_JITTER,
+        "plenum_tpu/consensus/view_change_service.py":
+            PT012_ROOT_CALLER,
+    }, tmp_path)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == "plenum_tpu/server/catchup.py"
+    assert f.symbol == "LedgerLeecher._schedule_retry"
+    assert "hash()" in f.message and "PYTHONHASHSEED" in f.message
+
+
+def test_pt012_silent_on_shipped_crc32_fix(tmp_path):
+    findings = check_program("PT012", {
+        "plenum_tpu/server/catchup.py": PT012_GOOD_JITTER,
+        "plenum_tpu/consensus/view_change_service.py":
+            PT012_ROOT_CALLER,
+    }, tmp_path)
+    assert findings == []
+
+
+def test_pt012_unreachable_source_stays_silent(tmp_path):
+    """Reach-specificity: the same salted hash with NO path from any
+    consensus root must not fire."""
+    findings = check_program("PT012", {
+        "plenum_tpu/server/catchup.py": PT012_BAD_JITTER,
+    }, tmp_path)
+    assert findings == []
+
+
+def test_pt012_set_iteration_in_root_fires_and_sorted_passes(tmp_path):
+    bad = """
+        class ViewChangeService:
+            def _finish_view_change(self, nv):
+                referenced = {tuple(x) for x in nv.viewChanges}
+                return [frm for frm, digest in referenced]
+    """
+    good = """
+        class ViewChangeService:
+            def _finish_view_change(self, nv):
+                referenced = sorted({tuple(x) for x in nv.viewChanges})
+                return [frm for frm, digest in referenced]
+    """
+    path = "plenum_tpu/consensus/view_change_service.py"
+    fired = check_program("PT012", {path: bad}, tmp_path)
+    assert len(fired) == 1 and "set" in fired[0].message
+    assert check_program("PT012", {path: good}, tmp_path) == []
+
+
+def test_pt012_unseeded_random_and_time_value(tmp_path):
+    src = """
+        import random
+        import time
+
+        def plan_lanes(touches):
+            lane = random.choice(touches)
+            return lane
+
+        def _stamp():
+            return time.time()
+
+        def plan_more(touches):
+            return _stamp()
+
+        def _timer_delta_ok(t0):
+            elapsed = time.time() - t0
+            return len([elapsed])
+    """
+    findings = check_program("PT012", {
+        "plenum_tpu/server/execution_lanes.py": src}, tmp_path)
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 2
+    assert any("random.choice" in m for m in msgs)
+    assert any("time.time() escapes" in m for m in msgs)
+
+
+def test_pt012_pragma_suppresses_program_finding(tmp_path):
+    src = """
+        import random
+
+        def plan_lanes(touches):
+            return random.choice(touches)  # plenum-lint: disable=PT012
+    """
+    assert check_program("PT012", {
+        "plenum_tpu/server/execution_lanes.py": src}, tmp_path) == []
+
+
+# PT013 — dispatch halves must reach their collect, including handles
+# handed across functions (the PR 8 fused-window / PR 13 merged-resolve
+# shape).
+PT013_BAD = """
+    from plenum_tpu.ops.trie_jax import dispatch_node_hash_batch
+
+    def stage_level(blobs):
+        handle = dispatch_node_hash_batch(blobs)
+        return len(blobs)
+
+    def fire_and_forget(blobs):
+        dispatch_node_hash_batch(blobs)
+"""
+
+PT013_BAD_CROSS = """
+    def stage_level(blobs):
+        return dispatch_node_hash_batch(blobs)
+
+    def apply_batch(blobs):
+        stage_level(blobs)
+        return True
+"""
+
+PT013_GOOD = """
+    from plenum_tpu.ops.trie_jax import (
+        collect_node_hash_batch, dispatch_node_hash_batch)
+
+    def stage_level(blobs):
+        handle = dispatch_node_hash_batch(blobs)
+        return collect_node_hash_batch(handle)
+
+    def stage_pipelined(self, blobs):
+        self._inflight = dispatch_node_hash_batch(blobs)
+
+    def stage_handoff(blobs):
+        return dispatch_node_hash_batch(blobs)
+
+    def apply_batch(blobs):
+        h = stage_handoff(blobs)
+        return collect_node_hash_batch(h)
+"""
+
+
+def test_pt013_fires_on_dropped_and_discarded_handles(tmp_path):
+    findings = check_program("PT013", {
+        "plenum_tpu/state/device_state.py": PT013_BAD}, tmp_path)
+    assert len(findings) == 2
+    assert {f.symbol for f in findings} == {"stage_level",
+                                           "fire_and_forget"}
+    assert all("node_hash_batch" in f.message for f in findings)
+
+
+def test_pt013_fires_interprocedurally_on_dropped_handoff(tmp_path):
+    """stage_level returns the open generation; apply_batch discards
+    it — the finding lands at the frame that dropped it."""
+    findings = check_program("PT013", {
+        "plenum_tpu/state/device_state.py": PT013_BAD_CROSS},
+        tmp_path)
+    assert len(findings) == 1
+    assert findings[0].symbol == "apply_batch"
+
+
+def test_pt013_silent_on_collected_stored_and_handed_off(tmp_path):
+    assert check_program("PT013", {
+        "plenum_tpu/state/device_state.py": PT013_GOOD},
+        tmp_path) == []
+
+
+# PT014 — the literal pre-fix per-level Keccak shape (PR 6 review):
+# batch rows = raw len(blobs), block axis = raw max(need) — one XLA
+# compile per distinct level size.
+PT014_BAD_KECCAK = """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @functools.partial(jax.jit, static_argnames=("nblocks",))
+    def _keccak_kernel(words, nblocks):
+        return words
+
+    def dispatch_level_hash(blobs):
+        need = [len(b) // 136 + 1 for b in blobs]
+        nblocks = max(need)
+        arr = np.zeros((len(blobs), nblocks, 17), dtype=np.uint32)
+        return _keccak_kernel(jnp.asarray(arr), nblocks)
+"""
+
+PT014_GOOD_KECCAK = """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from plenum_tpu.ops import pow2_at_least
+
+    @functools.partial(jax.jit, static_argnames=("nblocks",))
+    def _keccak_kernel(words, nblocks):
+        return words
+
+    def dispatch_level_hash(blobs):
+        need = [len(b) // 136 + 1 for b in blobs]
+        nblocks = pow2_at_least(max(need))
+        bp = pow2_at_least(len(blobs))
+        arr = np.zeros((bp, nblocks, 17), dtype=np.uint32)
+        return _keccak_kernel(jnp.asarray(arr), nblocks)
+"""
+
+# the r05 / bls381 shape: bucketed on one branch, raw on the other
+PT014_BAD_CONDITIONAL = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from plenum_tpu.ops import pow2_at_least
+
+    @jax.jit
+    def _kernel(rows):
+        return rows
+
+    def dispatch_jobs(jobs, sharded):
+        bp = pow2_at_least(len(jobs)) if sharded else len(jobs)
+        arr = np.zeros((bp, 48), dtype=np.uint8)
+        return _kernel(jnp.asarray(arr))
+"""
+
+
+def test_pt014_fires_on_prefix_keccak_shape(tmp_path):
+    findings = check_program("PT014", {
+        "plenum_tpu/ops/sha3.py": PT014_BAD_KECCAK}, tmp_path)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == "dispatch_level_hash"
+    assert "_keccak_kernel" in f.message
+    assert "compile" in f.message
+
+
+def test_pt014_silent_on_bucketed_shapes(tmp_path):
+    assert check_program("PT014", {
+        "plenum_tpu/ops/sha3.py": PT014_GOOD_KECCAK}, tmp_path) == []
+
+
+def test_pt014_fires_on_conditional_bucketing(tmp_path):
+    """The exact r05/bls381 bug: padded_size(B) on the sharded branch,
+    raw B on the other — flagged even though a bucket helper appears
+    in the function."""
+    findings = check_program("PT014", {
+        "plenum_tpu/ops/bls.py": PT014_BAD_CONDITIONAL}, tmp_path)
+    assert len(findings) == 1
+    assert "CONDITIONALLY" in findings[0].message
+
+
+def test_pt014_param_passthrough_lifts_to_caller(tmp_path):
+    """A seam forwarding caller-shaped operands verbatim is not the
+    owner of the bucket obligation — its un-bucketed CALLER is."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def _kernel(rows):
+            return rows
+
+        def compress(rows, nvalid):
+            return _kernel(rows)
+
+        def caller_raw(msgs):
+            arr = np.zeros((len(msgs), 64), dtype=np.uint8)
+            return compress(jnp.asarray(arr), len(msgs))
+    """
+    findings = check_program("PT014", {
+        "plenum_tpu/ops/shim.py": src}, tmp_path)
+    assert len(findings) == 1
+    assert findings[0].symbol == "caller_raw"
+    assert "compress" in findings[0].message
+
+
+def test_pt012_to_pt014_report_through_baseline(tmp_path):
+    """Program-rule findings ride the ordinary baseline machinery."""
+    for rel, src in {
+            "plenum_tpu/ops/sha3.py": PT014_BAD_KECCAK}.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    rule = rule_by_code("PT014")
+    analyzer = Analyzer([rule], str(tmp_path), use_engine_cache=False)
+    findings = analyzer.run_files(
+        analyzer.collect_files([str(tmp_path)]))
+    base = Baseline.from_findings(findings, justification="pinned")
+    new, old = base.match(findings)
+    assert new == [] and len(old) == 1
