@@ -1639,6 +1639,312 @@ def pool25_both():
     return tpu
 
 
+def gateway_open_loop():
+    """Gateway-tier config: OPEN-LOOP Poisson arrivals (the arrival
+    process never waits for the pool — sustained offered load, unlike
+    the closed-loop backlog drains above) through the client-facing
+    gateway into a BLS-enabled 4-node sim pool. Mixed read/write with
+    hot-key skew: hot GET_NYMs exercise the signed-read cache (replay
+    of proof-carrying answers, invalidated as new signed roots land),
+    a retry fraction exercises dedup, a touch-update fraction gives
+    the lane pre-planner real write conflicts, and the backlog signal
+    feeds admission control live. Tail latency (p50/p99/p999) comes
+    from the gateway telemetry hub's log-linear histograms —
+    gateway_gate() hard-gates the headline fields."""
+    import msgpack
+    import random as _random
+    from plenum_tpu.bootstrap import node_genesis_txn
+    from plenum_tpu.client.client import PoolClient
+    from plenum_tpu.client.wallet import Wallet
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
+    from plenum_tpu.common.request import Request
+    from plenum_tpu.common.serializers import flat_wire as fw
+    from plenum_tpu.crypto.batch_verifier import CoalescingVerifierHub
+    from plenum_tpu.crypto.bls import (
+        BlsCryptoSignerPlenum, BlsCryptoVerifierPlenum)
+    from plenum_tpu.crypto.signer import SimpleSigner
+    from plenum_tpu.gateway import Gateway
+    from plenum_tpu.observability.telemetry import TM, TelemetryHub
+    from plenum_tpu.runtime.sim_random import DefaultSimRandom
+    from plenum_tpu.server.node import Node
+    from plenum_tpu.testing.mock_timer import MockTimer
+    from plenum_tpu.testing.sim_network import SimNetwork
+
+    n_nodes = int(os.environ.get("BENCH_GW_NODES", "4"))
+    rate = float(os.environ.get("BENCH_GW_RATE", "600"))     # req/s sim
+    secs = float(os.environ.get("BENCH_GW_SECS", "8"))       # sim s
+    read_pct = float(os.environ.get("BENCH_GW_READ_PCT", "0.3"))
+    dup_pct = 0.02          # client retries the dedup window absorbs
+    touch_pct = 0.10        # of writes: updates to a hot dest (lanes)
+    hot_n = 16              # hot-key set for reads + touch updates
+    wall_budget = float(os.environ.get("BENCH_GW_WALL", "150"))
+    tick_dt = 0.05
+
+    names = ["G%02d" % i for i in range(n_nodes)]
+    bls_signers = {}
+    for i, name in enumerate(names):
+        s, _ = BlsCryptoSignerPlenum.generate(bytes([0x30 + i]) * 32)
+        bls_signers[name] = s
+    timer = MockTimer()
+    timer.set_time(SIM_EPOCH)
+    net = SimNetwork(timer, DefaultSimRandom(77), min_latency=0.001,
+                     max_latency=0.005)
+    conf = Config(Max3PCBatchSize=200, Max3PCBatchWait=0.05,
+                  CHK_FREQ=10, LOG_SIZE=30, HEARTBEAT_FREQ=10 ** 6,
+                  GATEWAY_BACKLOG_HIGH=float(os.environ.get(
+                      "BENCH_GW_BACKLOG_HIGH", "150")),
+                  GATEWAY_BACKLOG_LOW=float(os.environ.get(
+                      "BENCH_GW_BACKLOG_LOW", "75")),
+                  GATEWAY_BACKLOG_HARD=float(os.environ.get(
+                      "BENCH_GW_BACKLOG_HARD", "1000")))
+    genesis = []
+    for i, name in enumerate(names):
+        genesis.append(node_genesis_txn(
+            name, verkey="v%d" % i, node_ip="127.0.0.1", node_port=1,
+            client_ip="127.0.0.1", client_port=2,
+            steward_nym="S%d" % i, bls_key=bls_signers[name].pk))
+    nodes = [Node(name, names, timer, net.create_peer(name),
+                  config=conf, bls_signer=bls_signers[name],
+                  genesis_txns=genesis)
+             for name in names]
+    primary = nodes[0]
+
+    # ---- seed the hot-key set so reads and touch updates resolve
+    author = SimpleSigner(seed=b"\x71" * 32)
+    hot = ["gwhot-%04d" % i + "h" * 12 for i in range(hot_n)]
+    seed_reqs = []
+    for i, dest in enumerate(hot):
+        req = {"identifier": author.identifier, "reqId": i + 1,
+               "protocolVersion": 2,
+               "operation": {"type": NYM, TARGET_NYM: dest}}
+        req["signature"] = author.sign(dict(req))
+        seed_reqs.append(req)
+    for n in nodes:
+        n.process_client_batch([(dict(r), "seed") for r in seed_reqs])
+    for _ in range(200):
+        for n in nodes:
+            n.service()
+        timer.run_for(tick_dt)
+        if all(n.domain_ledger.size >= hot_n for n in nodes):
+            break
+    base_size = min(n.domain_ledger.size for n in nodes)
+
+    # ---- open-loop arrival schedule (relative sim seconds)
+    rng = _random.Random(4242)
+    sched = []                       # (t_rel, request dict)
+    req_id = 1000
+    write_history = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= secs:
+            break
+        req_id += 1
+        draw = rng.random()
+        if write_history and draw < dup_pct:
+            sched.append((t, rng.choice(write_history)))   # a retry
+            continue
+        if draw < dup_pct + read_pct:
+            # hot-skewed read: 80% hit the hot set
+            if rng.random() < 0.8:
+                dest = hot[min(int(rng.expovariate(0.5)), hot_n - 1)]
+            elif write_history:
+                dest = rng.choice(write_history)[
+                    "operation"][TARGET_NYM]
+            else:
+                dest = hot[0]
+            sched.append((t, {"identifier": author.identifier,
+                              "reqId": req_id,
+                              "operation": {"type": "105",
+                                            TARGET_NYM: dest}}))
+            continue
+        if rng.random() < touch_pct:
+            dest = hot[rng.randrange(hot_n)]   # conflicting update
+            op = {"type": NYM, TARGET_NYM: dest}
+        else:
+            dest = "gw-%06d" % req_id + "u" * 10
+            op = {"type": NYM, TARGET_NYM: dest, VERKEY: "~" + dest[:22]}
+        req = {"identifier": author.identifier, "reqId": req_id,
+               "protocolVersion": 2, "operation": op}
+        req["signature"] = author.sign(dict(req))
+        sched.append((t, req))
+        write_history.append(req)
+
+    # ---- gateway wiring: standalone coalescing hub for the
+    # pre-screen, proof checking through the REAL PoolClient path
+    gw_hub = TelemetryHub(name="gateway")
+    verifier_kind = os.environ.get("BENCH_GW_VERIFIER", "tpu_hub")
+    gw_verifier = CoalescingVerifierHub(telemetry=gw_hub) \
+        if verifier_kind == "tpu_hub" else None
+    if gw_verifier is not None:
+        from plenum_tpu.crypto.fixtures import make_signed_batch
+        from plenum_tpu.ops import ed25519_jax as edj
+        for bucket in (32, 64, 128):
+            wm, ws, wv = make_signed_batch(bucket, seed=3)
+            edj.verify_batch(wm, ws, wv)
+    wallet = Wallet()
+    wallet.add_identifier(signer=SimpleSigner(seed=b"\x72" * 32))
+    proof_client = PoolClient(
+        wallet, names, send_fn=lambda n, m: None,
+        bls_verifier=BlsCryptoVerifierPlenum(),
+        bls_key_provider=lambda n: bls_signers[n].pk)
+
+    def serve_read(msg, _client):
+        try:
+            return primary.read_manager.get_result(
+                Request.from_dict(dict(msg)))
+        except Exception:
+            return None
+
+    outbound = []
+    gw = Gateway(forward_writes=outbound.append, serve_read=serve_read,
+                 check_proof=proof_client.check_proof_dict,
+                 verifier=gw_verifier, config=conf, telemetry=gw_hub)
+
+    # ---- the open loop
+    t0 = time.perf_counter()
+    stats = {"arrivals": 0, "reads_arrived": 0, "writes_arrived": 0,
+             "admitted_writes": 0, "shed_reads": 0, "shed_writes": 0,
+             "cache_hits": 0, "sig_rejects": 0}
+    levels_seen = set()
+    pool_p99 = None
+    now_rel = 0.0
+    idx = 0
+    tick_i = 0
+    completed = True
+    while True:
+        if time.perf_counter() - t0 > wall_budget:
+            completed = False
+            break
+        ordered = min(n.domain_ledger.size for n in nodes) - base_size
+        if idx >= len(sched) and ordered >= stats["admitted_writes"]:
+            break
+        if idx >= len(sched) and tick_i > len(sched) + 2000:
+            completed = False
+            break
+        now_rel += tick_dt
+        tick_i += 1
+        due = []
+        while idx < len(sched) and sched[idx][0] <= now_rel:
+            due.append(sched[idx])
+            idx += 1
+        envs = []
+        for lo in range(0, len(due), 64):
+            group = due[lo:lo + 64]
+            blobs = [msgpack.packb(m, use_bin_type=True)
+                     for _, m in group]
+            clients = ["c%d" % (i & 7) for i in range(len(group))]
+            envs.append((fw.encode_propagate_envelope(blobs, clients),
+                         "lb-%d" % ((lo >> 6) & 3), group[0][0]))
+        for _, msg in due:
+            stats["arrivals"] += 1
+            if msg["operation"]["type"] == "105":
+                stats["reads_arrived"] += 1
+            else:
+                stats["writes_arrived"] += 1
+        backlog = stats["admitted_writes"] - ordered
+        tick = gw.pump(envs, now=now_rel, backlog=backlog,
+                       pool_p99_ms=pool_p99)
+        levels_seen.add(tick.level)
+        stats["admitted_writes"] += len(tick.admitted_writes)
+        stats["shed_reads"] += tick.shed_reads
+        stats["shed_writes"] += tick.shed_writes
+        stats["cache_hits"] += tick.cache_hits
+        stats["sig_rejects"] += tick.sig_rejects
+        for env in outbound:
+            for n in nodes:
+                n.process_gateway_envelope(env, "gw-front")
+        del outbound[:]
+        for n in nodes:
+            n.service()
+        timer.run_for(tick_dt)
+        if tick_i % 20 == 0:
+            _p50, pool_p99, _cnt = pool_latency_summary(nodes)
+    elapsed = time.perf_counter() - t0
+    ordered = min(n.domain_ledger.size for n in nodes) - base_size
+
+    snap = gw_hub.snapshot()
+    e2e = (snap.get("histograms") or {}).get(TM.GATEWAY_E2E_MS) or {}
+    dedup_hits = (snap.get("counters") or {}).get(
+        TM.GATEWAY_DEDUP_HITS, 0)
+    p50_pool, p99_pool, _ = pool_latency_summary(nodes)
+    shed = stats["shed_reads"] + stats["shed_writes"]
+    return {
+        "nodes": n_nodes,
+        "offered_rate_per_s": rate,
+        "sim_secs": secs,
+        "wall_s": round(elapsed, 1),
+        "completed": completed,
+        "arrivals": stats["arrivals"],
+        "reads_arrived": stats["reads_arrived"],
+        "writes_arrived": stats["writes_arrived"],
+        "admitted_writes": stats["admitted_writes"],
+        "ordered_writes": ordered,
+        "shed_reads": stats["shed_reads"],
+        "shed_writes": stats["shed_writes"],
+        "cache_hits": stats["cache_hits"],
+        "dedup_hits": dedup_hits,
+        "sig_rejects": stats["sig_rejects"],
+        "shed_levels_seen": sorted(levels_seen),
+        # headline fields (gateway_gate hard-gates their presence)
+        "gateway_p50_ms": e2e.get("p50"),
+        "gateway_p99_ms": e2e.get("p99"),
+        "gateway_p999_ms": e2e.get("p999"),
+        "e2e_samples": e2e.get("count", 0),
+        "gateway_shed_pct": round(
+            100.0 * shed / max(1, stats["arrivals"]), 2),
+        "gateway_cache_hit_pct": round(
+            100.0 * stats["cache_hits"]
+            / max(1, stats["reads_arrived"]), 2),
+        "ordered_p50_ms": p50_pool,
+        "ordered_p99_ms": p99_pool,
+    }
+
+
+def gate_enforced(env_var):
+    """True when the named gate should hard-fail the run — the
+    operator downgrades it to warn-only with <env_var>=warn. Pure
+    read of the environment so tier-1 can pin the override contract."""
+    return os.environ.get(env_var) != "warn"
+
+
+def gateway_gate(result):
+    """HARD headline gate for the gateway tier: the three headline
+    fields must be present (p99 additionally backed by p999 and real
+    samples), the percentage fields must be sane, and the admission
+    ladder's ordering must hold in the observed run — writes shed
+    implies reads were already being shed (reads degrade FIRST).
+    Returns the list of failures; main() records them in the headline
+    and exits nonzero unless BENCH_GATEWAY_GATE=warn. Pure function of
+    the gateway_open_loop dict, so tier-1 gates the gate itself
+    (tests/test_bench_gate.py) without running a bench."""
+    if not isinstance(result, dict):
+        return ["gateway_open_loop produced no result dict"]
+    failures = []
+    for field in ("gateway_p99_ms", "gateway_p999_ms",
+                  "gateway_shed_pct", "gateway_cache_hit_pct"):
+        if result.get(field) is None:
+            failures.append("%s missing from gateway_open_loop" % field)
+    samples = result.get("e2e_samples") or 0
+    p99 = result.get("gateway_p99_ms")
+    if samples and isinstance(p99, (int, float)) and p99 < 0:
+        failures.append("gateway_p99_ms %.3f negative with %d samples"
+                        % (p99, samples))
+    for field in ("gateway_shed_pct", "gateway_cache_hit_pct"):
+        value = result.get(field)
+        if isinstance(value, (int, float)) \
+                and not 0.0 <= value <= 100.0:
+            failures.append("%s %.2f outside [0, 100]" % (field, value))
+    if (result.get("shed_writes") or 0) > 0 \
+            and (result.get("reads_arrived") or 0) > 0 \
+            and (result.get("shed_reads") or 0) == 0:
+        failures.append(
+            "writes were shed while no read was shed — the admission "
+            "ladder must degrade reads before writes")
+    return failures
+
+
 def bench_recovery():
     """Recovery SLO config (ROADMAP item 4): a 25-node sim pool
     measures (a) failover latency — primary goes silent under load →
@@ -2117,6 +2423,8 @@ def main():
     state_res = micro_state()
     exec_res = micro_executor()
     p25 = pool25_both()
+    gw = gateway_open_loop()
+    gw_gate_failures = gateway_gate(gw)
 
     print(json.dumps({
         "metric": "ordered write-reqs/s, 4-node MULTI-PROCESS pool over "
@@ -2162,6 +2470,7 @@ def main():
             "state": state_res,
             "executor": exec_res,
             "pool25_backlog": p25,
+            "gateway": gw,
             "tracing_overhead": tracing,
             "host_ms_regression": host_ms_regression,
             "wire_flat_ab": wire_ab,
@@ -2245,6 +2554,15 @@ def main():
                 for seam, entry in sorted(
                     (p25.get("lane_occupancy") or {}).items())}
             if isinstance(p25, dict) else None,
+            # gateway tier: open-loop Poisson tail + shed/cache rates
+            # (gateway_gate hard-fails the run when a field goes
+            # missing or the shed ladder inverts)
+            "gateway_p99_ms": gw.get("gateway_p99_ms"),
+            "gateway_p999_ms": gw.get("gateway_p999_ms"),
+            "gateway_shed_pct": gw.get("gateway_shed_pct"),
+            "gateway_cache_hit_pct": gw.get("gateway_cache_hit_pct"),
+            "gateway_gate_ok": not gw_gate_failures,
+            "gateway_gate_failures": gw_gate_failures or None,
             "telemetry_overhead_pct": telemetry["overhead_pct"],
             "telemetry_gate_ok": not telemetry_gate_failures,
             "telemetry_gate_failures": telemetry_gate_failures or None,
@@ -2269,6 +2587,10 @@ def main():
             and os.environ.get("BENCH_TELEMETRY_GATE") != "warn":
         print("TELEMETRY OVERHEAD GATE FAILED: "
               + "; ".join(telemetry_gate_failures), file=sys.stderr)
+        sys.exit(2)
+    if gw_gate_failures and gate_enforced("BENCH_GATEWAY_GATE"):
+        print("GATEWAY GATE FAILED: "
+              + "; ".join(gw_gate_failures), file=sys.stderr)
         sys.exit(2)
 
 

@@ -37,7 +37,8 @@ class BlockingCallRule(Rule):
 
     def applies(self, rel_path: str) -> bool:
         return rel_path.startswith(("plenum_tpu/server/",
-                                    "plenum_tpu/consensus/"))
+                                    "plenum_tpu/consensus/",
+                                    "plenum_tpu/gateway/"))
 
     def check(self, ctx: ModuleContext) -> List[Finding]:
         out: List[Finding] = []

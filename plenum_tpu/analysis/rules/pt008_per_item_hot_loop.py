@@ -69,7 +69,8 @@ class PerItemHotLoopRule(Rule):
     name = "per-item-loop-in-hot-3pc-handler"
 
     def applies(self, rel_path: str) -> bool:
-        return rel_path.startswith("plenum_tpu/consensus/")
+        return rel_path.startswith(("plenum_tpu/consensus/",
+                                    "plenum_tpu/gateway/"))
 
     @staticmethod
     def _is_hot_handler(name: str) -> bool:

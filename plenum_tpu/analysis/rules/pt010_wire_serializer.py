@@ -87,8 +87,9 @@ class WireSerializerLoopRule(Rule):
     name = "per-message-serializer-call-in-hot-wire-path"
 
     def applies(self, rel_path: str) -> bool:
-        return rel_path.startswith("plenum_tpu/network/") \
-            or rel_path.startswith("plenum_tpu/server/")
+        return rel_path.startswith(("plenum_tpu/network/",
+                                    "plenum_tpu/server/",
+                                    "plenum_tpu/gateway/"))
 
     def check(self, ctx: ModuleContext) -> List[Finding]:
         out: List[Finding] = []

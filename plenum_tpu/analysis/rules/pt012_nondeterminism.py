@@ -45,6 +45,9 @@ DEFAULT_ROOTS = (
     ("plenum_tpu/consensus/primary_selector.py", r".*"),
     ("plenum_tpu/consensus/ordering_service.py",
      r"(digest|_order$|_send_batch_of)"),
+    # the gateway's lane pre-planning must agree with the node-side
+    # planner on the identical admitted stream — same determinism bar
+    ("plenum_tpu/gateway/lane_router.py", r".*"),
 )
 
 _MESSAGES = {

@@ -273,60 +273,103 @@ class PoolClient:
         must verify against n-f registered pool keys AND vouch for
         exactly the proof's root on `ledger_id` (domain by default),
         and the proof nodes must tie `value` (or its absence, value
-        None) to that root. Every check fails closed."""
+        None) to that root. Every check fails closed; callers that
+        need to KNOW which check failed (the gateway's signed-read
+        cache, diagnostics) use ``check_proof_dict``."""
+        return self.check_proof_dict(sp, key, value, ledger_id=ledger_id,
+                                     max_age=max_age, now=now) is None
+
+    def check_proof_dict(self, sp, key: bytes, value: Optional[bytes],
+                         ledger_id: Optional[int] = None,
+                         max_age: Optional[float] = None,
+                         now: Optional[float] = None) -> Optional[str]:
+        """``verify_proof_dict`` with an attributable verdict: None on
+        success, else a message NAMING the first failed check — a root
+        mismatch (the multi-sig vouches for a different root than the
+        proof claims), proof-node corruption (undecodable trie data or
+        nodes that do not tie the value to the root) and an invalid
+        multi-signature are different operational facts: the first is
+        a stale/substituted answer, the second a mangled proof, the
+        third a forged (or mis-keyed) signature."""
         if self._bls_verifier is None or self._bls_keys is None:
-            return False
+            return "no BLS verifier/keys configured"
         from plenum_tpu.common.constants import (
             DOMAIN_LEDGER_ID, MULTI_SIGNATURE, PROOF_NODES, ROOT_HASH)
         if ledger_id is None:
             ledger_id = DOMAIN_LEDGER_ID
         if not isinstance(sp, dict) or MULTI_SIGNATURE not in sp:
-            return False
+            return "malformed state proof: not a dict with a " \
+                   "multi-signature"
         try:
             from plenum_tpu.crypto.bls import MultiSignature
             multi = MultiSignature.from_dict(sp[MULTI_SIGNATURE])
-        except Exception:
-            return False
+        except Exception as e:
+            return "multi-sig invalid: unparseable multi-signature " \
+                   "(%s)" % e
         # 2. the multi-sig must vouch for exactly the proof's root, on
         # the ledger this read serves, and recently enough
         if multi.value.state_root_hash != sp.get(ROOT_HASH):
-            return False
+            return "root mismatch: multi-signature vouches for root " \
+                   "%r, proof claims %r" % (multi.value.state_root_hash,
+                                            sp.get(ROOT_HASH))
         if multi.value.ledger_id != ledger_id:
-            return False
+            return "ledger mismatch: multi-signature covers ledger " \
+                   "%r, read serves %r" % (multi.value.ledger_id,
+                                           ledger_id)
         if max_age is not None:
             ts = multi.value.timestamp
             ref = now if now is not None else __import__("time").time()
             if not isinstance(ts, (int, float)) or ref - ts > max_age:
-                return False
+                return "stale proof: multi-signature timestamp %r " \
+                       "outside the %.0fs freshness window" % (ts,
+                                                               max_age)
         # 3. enough distinct, registered signers (n-f)
         participants = list(multi.participants)
         if len(set(participants)) != len(participants):
-            return False
+            return "multi-sig invalid: duplicate participants"
         if not self.quorums.bls_signatures.is_reached(len(participants)):
-            return False
+            return "multi-sig invalid: %d signers below the n-f " \
+                   "quorum" % len(participants)
         keys = []
         for name in participants:
-            pk = self._bls_keys(name)
+            # participant names are proof-controlled input: a provider
+            # that raises on a stranger (dict lookup) must read as
+            # "unregistered", not as a crash
+            try:
+                pk = self._bls_keys(name)
+            except (KeyError, TypeError, AttributeError):
+                pk = None
             if pk is None:
-                return False
+                return "multi-sig invalid: unregistered signer %r" % name
             keys.append(pk)
         # 4. the aggregated signature itself (the expensive pairing)
         try:
-            if not self._bls_verifier.verify_multi_sig(
-                    multi.signature, multi.value.as_single_value(), keys):
-                return False
-        except Exception:
-            return False
+            sig_ok = self._bls_verifier.verify_multi_sig(
+                multi.signature, multi.value.as_single_value(), keys)
+        except Exception as e:
+            return "multi-sig invalid: aggregate verification " \
+                   "raised (%s)" % e
+        if not sig_ok:
+            return "multi-sig invalid: aggregate signature does not " \
+                   "verify against the registered keys"
         # 5. proof nodes: claimed value (or absence) under the root
         try:
             from plenum_tpu.common.serializers.base58 import b58decode
             from plenum_tpu.state.pruning_state import PruningState
             root = b58decode(sp[ROOT_HASH])
             nodes = PruningState.deserialize_proof(sp[PROOF_NODES])
-            return PruningState.verify_state_proof(
+        except Exception as e:
+            return "proof-node corruption: undecodable proof data " \
+                   "(%s)" % e
+        try:
+            proven = PruningState.verify_state_proof(
                 root, key, value, nodes)
-        except Exception:
-            return False
+        except Exception as e:
+            return "proof-node corruption: proof walk raised (%s)" % e
+        if not proven:
+            return "proof-node corruption: proof nodes do not tie " \
+                   "the claimed value to the signed root"
+        return None
 
     @staticmethod
     def _expected_state_kv(result: dict):

@@ -203,6 +203,34 @@ class Config:
     # the prod loop harvests it blocking (wedged daemon/device fallback)
     CLIENT_AUTH_TIMEOUT = 10.0
 
+    # ---- gateway tier (plenum_tpu/gateway/): the client-facing front
+    # door — device-batched ed25519 pre-screen, admission control and
+    # the signed-read cache. GATEWAY_BATCH_MAX bounds one intake
+    # batch's fused verify dispatch; the admission ladder degrades
+    # READS first when either pressure signal crosses its high-water
+    # mark (backlog depth in requests, ordered p99 in ms) and WRITES
+    # only past the hard marks; recovery needs BOTH signals back under
+    # the low-water marks (hysteresis — a gauge oscillating around one
+    # mark must not flap the shed decision per batch).
+    GATEWAY_BATCH_MAX = 2048
+    GATEWAY_BACKLOG_HIGH = 6000      # shed reads above this backlog
+    GATEWAY_BACKLOG_LOW = 4000      # readmit reads below this
+    GATEWAY_BACKLOG_HARD = 12000    # shed writes too above this
+    GATEWAY_P99_HIGH_MS = 4000.0    # shed reads above this ordered p99
+    GATEWAY_P99_LOW_MS = 2000.0     # readmit reads below this
+    GATEWAY_P99_HARD_MS = 12000.0   # shed writes too above this
+    # signed-read cache: entries carry a BLS-multi-signed state proof;
+    # a hit is served only while the proof's multi-sig timestamp is
+    # inside the freshness window (seconds) AND the entry's root is
+    # still the newest root the cache has observed for its ledger
+    GATEWAY_CACHE_MAX = 9216
+    GATEWAY_CACHE_FRESH_S = 300.0
+    # misbehaving-sender registry: a sender shed after this many
+    # structural wire violations (FlatWireError envelopes); bounded
+    # registry so client-chosen sender ids cannot grow it unboundedly
+    GATEWAY_SENDER_STRIKES = 3
+    GATEWAY_SENDER_REGISTRY_MAX = 16384
+
     # ---- quotas per prod tick (reference stp_core/config.py:29+,
     # plenum/server/quota_control.py)
     NODE_TO_NODE_STACK_QUOTA = 1024

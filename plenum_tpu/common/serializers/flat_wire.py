@@ -537,13 +537,24 @@ class ParsedEnvelope:
         self.nbytes = nbytes
 
 
-def parse_envelope(data) -> ParsedEnvelope:
+def parse_envelope(data, max_bytes: Optional[int] = None
+                   ) -> ParsedEnvelope:
     """One flat envelope → parsed sections (numpy views, zero copies).
-    Raises FlatWireError on ANY structural violation."""
+    Raises FlatWireError on ANY structural violation.
+
+    ``max_bytes`` bounds the whole envelope BEFORE any section header
+    is trusted — client-facing intakes (the gateway tier) pass their
+    wire limit (Config.MSG_LEN_LIMIT) so an over-length envelope is a
+    sender-attributable FlatWireError, not a memory bill. Node-to-node
+    callers already ride the transport's frame limit and pass None."""
     if isinstance(data, (bytearray, memoryview)):
         data = bytes(data)
     if not isinstance(data, bytes):
         raise FlatWireError("envelope is not bytes")
+    if max_bytes is not None and len(data) > max_bytes:
+        raise FlatWireError(
+            "envelope of %d bytes exceeds the %d-byte limit"
+            % (len(data), max_bytes))
     if len(data) < 4 or data[:2] != MAGIC:
         raise FlatWireError("bad magic")
     if data[2] != VERSION:

@@ -207,6 +207,7 @@ class _HubGeneration:
         self._index = None  # per-item slot in the deduped launch
         self._tm_device = False     # launched on the device path
         self._tm_new_shape = False  # that launch compiled a new bucket
+        self._tm_hub = None         # telemetry hub stamped at flush
 
     def dedup(self) -> List[VerifyItem]:
         order, self._index = dedup_items(self.items)
@@ -217,7 +218,7 @@ class _HubGeneration:
             if self._tm_device:
                 # the materialization below IS this generation's
                 # dispatch→collect round trip as the host sees it
-                hub = _telemetry.get_seam_hub()
+                hub = self._tm_hub or _telemetry.get_seam_hub()
                 t0 = hub.clock()
                 res = self.pending.collect()
                 hub.record_roundtrip(
@@ -245,16 +246,35 @@ class CoalescingVerifierHub:
 
     Same dispatch()/verify_batch() interface as the other providers, so
     it drops into ClientAuthNr unchanged.
+
+    Standalone construction (the gateway tier, tests, tools): every
+    collaborator is an explicit ctor argument — ``tracer`` (flight
+    recorder; NullTracer default), ``telemetry`` (the hub that receives
+    the SEAM_HUB launch/round-trip accounting; defaults to the lazy
+    process-wide seam hub so node-owned wiring is unchanged) and
+    ``threshold`` (Config single-source default). Nothing here reaches
+    into a Node.
     """
 
     name = "tpu_hub"
 
-    def __init__(self, batch=None, scalar=None, threshold: int = None):
+    def __init__(self, batch=None, scalar=None, threshold: int = None,
+                 tracer=None, telemetry=None):
         self._batch = batch or JaxBatchVerifier()
         self._scalar = scalar or OpenSSLVerifier()
         self.threshold = _default_threshold(threshold)
         self._gen = _HubGeneration()
-        self.tracer = NullTracer()   # node/bench attaches a recorder
+        # node/bench may still attach a recorder post-ctor (plain
+        # attribute); explicit injection is the standalone path
+        self.tracer = tracer if tracer is not None else NullTracer()
+        self._telemetry = telemetry  # None = lazy process seam hub
+
+    @property
+    def telemetry(self):
+        """The telemetry hub this hub's SEAM_HUB accounting lands in:
+        the injected one, or (default) the process-wide seam hub."""
+        return self._telemetry if self._telemetry is not None \
+            else _telemetry.get_seam_hub()
 
     def dispatch(self, items: Sequence[VerifyItem]) -> _HubPending:
         gen = self._gen
@@ -300,9 +320,10 @@ class CoalescingVerifierHub:
                 from plenum_tpu.ops.ed25519_jax import launch_lanes
                 lanes = launch_lanes(len(launch_items))
                 gen._tm_device = True
-                gen._tm_new_shape = _telemetry.get_seam_hub() \
-                    .record_launch(_telemetry.SEAM_HUB,
-                                   len(launch_items), lanes, shape=lanes)
+                gen._tm_hub = self.telemetry
+                gen._tm_new_shape = gen._tm_hub.record_launch(
+                    _telemetry.SEAM_HUB,
+                    len(launch_items), lanes, shape=lanes)
                 gen.pending = self._batch.dispatch(launch_items)
 
     def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:

@@ -101,6 +101,21 @@ class TM:
     WIRE_VOTE_BYTES_PREPREPARE = "wire_vote_bytes_preprepare"
     WIRE_MALFORMED = "wire_malformed"          # counter: rejected envs
 
+    # ---- gateway tier (plenum_tpu/gateway/): the client-facing front
+    # door — admission verdicts, shed ladder, signed-read cache and the
+    # gateway-side tail the open-loop bench gates on
+    GATEWAY_E2E_MS = "gateway_e2e_ms"            # hist: arrive→outcome
+    GATEWAY_ADMITTED = "gateway_admitted"        # counter: entered pool
+    GATEWAY_SHED_READS = "gateway_shed_reads"    # counter: degraded 1st
+    GATEWAY_SHED_WRITES = "gateway_shed_writes"  # counter: degraded 2nd
+    GATEWAY_DEDUP_HITS = "gateway_dedup_hits"    # counter: dup payloads
+    GATEWAY_SIG_REJECTS = "gateway_sig_rejects"  # counter: pre-screen
+    GATEWAY_CACHE_HITS = "gateway_cache_hits"    # counter: signed reads
+    GATEWAY_CACHE_MISSES = "gateway_cache_misses"  # counter
+    GATEWAY_SHED_SENDERS = "gateway_shed_senders"  # counter: wire abuse
+    GATEWAY_BACKLOG = "gateway_backlog"          # gauge: in-flight
+    GATEWAY_LANES_PER_BATCH = "gateway_lanes_per_batch"  # hist
+
     # ---- pool health
     BACKLOG_DEPTH = "backlog_depth"            # gauge: in-flight requests
     REQUEST_QUEUE_DEPTH = "request_queue_depth"  # gauge: finalised queue

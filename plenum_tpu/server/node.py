@@ -1017,6 +1017,63 @@ class Node:
         # ship the whole intake batch's propagates as one wire message
         self.propagator.flush()
 
+    # ------------------------------------------------- gateway intake
+
+    def process_gateway_envelope(self, data, frm: str):
+        """Client-tier FLAT_WIRE intake: one PROPAGATE-only envelope
+        from a gateway becomes one batched client intake. The gateway's
+        pre-screen is only a filter — every request re-authenticates
+        here through the same ``process_client_batch`` path direct
+        client traffic takes, so the ledger/state roots produced from a
+        gateway-fed stream are byte-identical to feeding the same
+        admitted requests directly."""
+        msgs = self.unpack_gateway_batch(data, frm)
+        if msgs:
+            self.process_client_batch(msgs)
+
+    def unpack_gateway_batch(self, data,
+                             frm: str) -> List[Tuple[dict, str]]:
+        """Parse one gateway→node envelope into [(request dict, client
+        id)]. Structural violations (bad magic/version, truncation,
+        over-length, non-PROPAGATE sections — a gateway never forwards
+        3PC traffic) raise a per-sender suspicion and drop the envelope
+        whole; a bad request ENTRY costs only itself."""
+        hub = get_seam_hub()
+        try:
+            env = flat_wire.parse_envelope(
+                data, max_bytes=self.config.MSG_LEN_LIMIT)
+        except flat_wire.FlatWireError as e:
+            hub.count(TM.WIRE_MALFORMED, 1)
+            logger.warning("%s: malformed gateway envelope from %s: %s",
+                           self.name, frm, e)
+            self.blacklister.report_suspicion(
+                frm, Suspicions.WIRE_MALFORMED, str(e),
+                auto_blacklist=self.config.BLACKLIST_ON_SUSPICION)
+            return []
+        hub.count(TM.WIRE_BYTES_RECV, env.nbytes)
+        msgs: List[Tuple[dict, str]] = []
+        for sec in env.sections:
+            if sec.kind != flat_wire.KIND_PROPAGATE:
+                hub.count(TM.WIRE_MALFORMED, 1)
+                logger.warning(
+                    "%s: non-PROPAGATE section %d in gateway envelope "
+                    "from %s", self.name, sec.kind, frm)
+                self.blacklister.report_suspicion(
+                    frm, Suspicions.WIRE_MALFORMED,
+                    "gateway section kind %d" % sec.kind,
+                    auto_blacklist=self.config.BLACKLIST_ON_SUSPICION)
+                return []
+            for i in range(sec.n):
+                try:
+                    req = sec.request(i)
+                except Exception:
+                    logger.warning("%s: bad request entry in gateway "
+                                   "envelope from %s — dropped",
+                                   self.name, frm)
+                    continue
+                msgs.append((req, sec.client(i) or frm))
+        return msgs
+
     def _process_write(self, request: Request, client_id: str):
         try:
             self.req_authenticator.authenticate(request)

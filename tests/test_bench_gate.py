@@ -119,6 +119,72 @@ def test_host_ms_tripwire_tolerates_missing_current():
     assert flags["warn"] is None
 
 
+def _gw_result(**over):
+    """A healthy gateway_open_loop result; override fields per test."""
+    base = {
+        "gateway_p99_ms": 48.2, "gateway_p999_ms": 95.1,
+        "gateway_shed_pct": 3.4, "gateway_cache_hit_pct": 31.0,
+        "e2e_samples": 600, "shed_reads": 20, "shed_writes": 0,
+        "reads_arrived": 150,
+    }
+    base.update(over)
+    return base
+
+
+def test_gateway_gate_passes_on_healthy_run():
+    bench = _gate()
+    assert bench.gateway_gate(_gw_result()) == []
+    # zero shedding and zero cache hits are healthy too (light load)
+    assert bench.gateway_gate(_gw_result(
+        gateway_shed_pct=0.0, gateway_cache_hit_pct=0.0,
+        shed_reads=0)) == []
+
+
+def test_gateway_gate_fails_on_missing_headline_field():
+    """Dropping/renaming any of the three headline fields (or the
+    p999 backing the tail claim) must fail loudly, not skip."""
+    bench = _gate()
+    for field in ("gateway_p99_ms", "gateway_p999_ms",
+                  "gateway_shed_pct", "gateway_cache_hit_pct"):
+        failures = bench.gateway_gate(_gw_result(**{field: None}))
+        assert any(field in f for f in failures), field
+    assert bench.gateway_gate(None) != []
+
+
+def test_gateway_gate_fails_on_inverted_shed_ladder():
+    """Writes shed while reads flowed freely inverts the admission
+    ladder — the degrade-reads-first contract is gate-enforced."""
+    bench = _gate()
+    failures = bench.gateway_gate(_gw_result(
+        shed_writes=10, shed_reads=0))
+    assert any("reads before writes" in f for f in failures)
+    # writes shed AFTER reads: the intended ladder, passes
+    assert bench.gateway_gate(_gw_result(
+        shed_writes=10, shed_reads=40)) == []
+    # no reads arrived at all: the ladder claim is vacuous, passes
+    assert bench.gateway_gate(_gw_result(
+        shed_writes=10, shed_reads=0, reads_arrived=0)) == []
+
+
+def test_gateway_gate_fails_on_insane_percentages():
+    bench = _gate()
+    assert bench.gateway_gate(_gw_result(gateway_shed_pct=101.0)) != []
+    assert bench.gateway_gate(
+        _gw_result(gateway_cache_hit_pct=-1.0)) != []
+
+
+def test_gateway_gate_warn_override_honored(monkeypatch):
+    """BENCH_GATEWAY_GATE=warn downgrades the hard gate to warn-only;
+    any other value (or unset) keeps it enforcing."""
+    bench = _gate()
+    monkeypatch.delenv("BENCH_GATEWAY_GATE", raising=False)
+    assert bench.gate_enforced("BENCH_GATEWAY_GATE")
+    monkeypatch.setenv("BENCH_GATEWAY_GATE", "warn")
+    assert not bench.gate_enforced("BENCH_GATEWAY_GATE")
+    monkeypatch.setenv("BENCH_GATEWAY_GATE", "1")
+    assert bench.gate_enforced("BENCH_GATEWAY_GATE")
+
+
 def test_host_ms_tripwire_covers_execute_stage():
     """ISSUE 13: the best-prior tripwire extends to the execute stage
     the conflict-lane executor owns — a worse current execute warns

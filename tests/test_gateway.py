@@ -1,0 +1,591 @@
+"""Gateway tier (ISSUE 16): batched-verify front door in front of the
+pool — intake wire guard, admission ladder, signed-read cache — plus
+the acceptance contract: under induced backlog the gateway degrades
+READS before WRITES, and the admitted write stream produces ledger and
+state roots BYTE-EQUAL to a gateway-less pool fed the same stream (the
+pre-screen is a filter, never an authority).
+"""
+import copy
+
+import msgpack
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.common.constants import (
+    MULTI_SIGNATURE, NYM, PROOF_NODES, ROOT_HASH, STATE_PROOF,
+    TARGET_NYM, VERKEY)
+from plenum_tpu.common.serializers import flat_wire
+from plenum_tpu.crypto.batch_verifier import (
+    CoalescingVerifierHub, OpenSSLVerifier)
+from plenum_tpu.crypto.bls import (
+    BlsCryptoSignerPlenum, BlsCryptoVerifierPlenum)
+from plenum_tpu.crypto.signer import SimpleSigner
+from plenum_tpu.gateway import (
+    ADMIT_ALL, SHED_READS, SHED_WRITES, AdmissionController, Gateway,
+    GatewayIntake, SenderRegistry, SignedReadCache, cache_key_for,
+    is_read)
+from plenum_tpu.observability.telemetry import SEAM_HUB, TM, TelemetryHub
+from plenum_tpu.testing.mock_timer import MockTimer
+
+from tests.test_bls_consensus import _bls_pool, _pump_nodes
+
+
+# ----------------------------------------------------------- fixtures
+
+
+@pytest.fixture(scope="module")
+def signers():
+    out = {}
+    for i in range(1, 5):
+        s, _ = BlsCryptoSignerPlenum.generate(bytes([0x40 + i]) * 32)
+        out["Node%d" % i] = s
+    return out
+
+
+def _write_req(author, rid, dest=None, verkey=None):
+    op = {"type": NYM, TARGET_NYM: dest or author.identifier}
+    if verkey is not None:
+        op[VERKEY] = verkey
+    req = {"identifier": author.identifier, "reqId": rid,
+           "protocolVersion": 2, "operation": op}
+    req["signature"] = author.sign(dict(req))
+    return req
+
+
+def _read_req(idr, rid, dest):
+    return {"identifier": idr, "reqId": rid,
+            "operation": {"type": "105", TARGET_NYM: dest}}
+
+
+def _envelope(msgs, clients=None):
+    raw = [msgpack.packb(m, use_bin_type=True) for m in msgs]
+    return flat_wire.encode_propagate_envelope(
+        raw, clients or ["c%d" % i for i in range(len(msgs))])
+
+
+@pytest.fixture(scope="module")
+def proof_ctx(signers):
+    """One BLS pool, one ordered NYM, one proof-bearing GET_NYM reply:
+    the raw material for pinning every check_proof_dict verdict."""
+    from plenum_tpu.client.client import PoolClient
+    from plenum_tpu.client.wallet import Wallet
+    from plenum_tpu.common.messages.node_messages import Reply
+    from plenum_tpu.common.state_codec import (
+        encode_state_value, nym_to_state_key)
+
+    names = list(signers)
+    nodes, sinks, timer = _bls_pool(MockTimer(), names, signers)
+    author = SimpleSigner(seed=b"\x82" * 32)
+    req = _write_req(author, 1, verkey=author.verkey)
+    for n in nodes.values():
+        n.process_client_request(dict(req), "w1")
+    _pump_nodes(timer, nodes, 6.0)
+    first = names[0]
+    nodes[first].process_client_request(
+        _read_req(author.identifier, 2, author.identifier), "r1")
+    result = [m for _, m in sinks[first]
+              if isinstance(m, Reply)][-1].result
+    wallet = Wallet()
+    wallet.add_identifier(signer=SimpleSigner(seed=b"\x83" * 32))
+    client = PoolClient(
+        wallet, names, send_fn=lambda n, m: None,
+        bls_verifier=BlsCryptoVerifierPlenum(),
+        bls_key_provider=lambda n: signers[n].pk)
+    return {
+        "names": names, "client": client, "result": result,
+        "sp": result["state_proof"],
+        "key": nym_to_state_key(result["dest"]),
+        "value": encode_state_value(result["data"], result["seqNo"],
+                                    result["txnTime"]),
+    }
+
+
+# -------------------------------------------- satellite 1: hub reuse
+
+
+def test_hub_standalone_construction_and_injected_telemetry():
+    """CoalescingVerifierHub builds with every collaborator injected —
+    no Node, no process-global seam hub — and its SEAM_HUB launch
+    accounting lands in the INJECTED telemetry hub."""
+    from plenum_tpu.crypto.fixtures import make_signed_batch
+
+    tm = TelemetryHub(name="gw-hub-test")
+    hub = CoalescingVerifierHub(batch=OpenSSLVerifier(),
+                                scalar=OpenSSLVerifier(),
+                                threshold=2, telemetry=tm)
+    assert hub.telemetry is tm
+    msgs, sigs, vks = make_signed_batch(5, seed=9)
+    items = list(zip(msgs, sigs, vks))
+    # corrupt one signature: the verdict must be slot-accurate
+    bad = bytearray(items[3][1])
+    bad[0] ^= 0xFF
+    items[3] = (items[3][0], bytes(bad), items[3][2])
+    pending = hub.dispatch(items)
+    hub.flush()
+    assert pending.collect() == [True, True, True, False, True]
+    seams = tm.snapshot()["seams"]
+    assert SEAM_HUB in seams and seams[SEAM_HUB]["launches"] == 1
+    # default construction still reaches the lazy process seam hub
+    from plenum_tpu.observability import telemetry as _t
+    assert CoalescingVerifierHub().telemetry is _t.get_seam_hub()
+
+
+# ------------------------------------- satellite 2: adversarial wire
+
+
+def test_intake_never_raises_and_sheds_structural_offenders():
+    tm = TelemetryHub(name="intake-adv")
+    intake = GatewayIntake(
+        verifier=OpenSSLVerifier(),
+        senders=SenderRegistry(strikes=3, telemetry=tm), telemetry=tm)
+    good = _envelope([_read_req("idr1", 1, "someone")])
+
+    out = intake.unpack_client(good, "friendly")
+    assert len(out) == 1 and out[0][0]["reqId"] == 1
+
+    # version skew: byte 2 is the version field
+    skew = bytearray(good)
+    skew[2] ^= 0x07
+    assert intake.unpack_client(bytes(skew), "attacker") is None
+    # truncation: offset tables now point past the end
+    assert intake.unpack_client(good[:-3], "attacker") is None
+    # plain garbage: third strike -> the sender is shed
+    assert intake.unpack_client(b"\x00" * 40, "attacker") is None
+    assert intake.senders.is_shed("attacker")
+    # ...so even a WELL-FORMED envelope from it is dropped unread
+    assert intake.unpack_client(good, "attacker") is None
+    snap = tm.snapshot()["counters"]
+    assert snap[TM.WIRE_MALFORMED] == 3
+    assert snap[TM.GATEWAY_SHED_SENDERS] == 1
+    # the intake loop survived it all: another sender is unaffected
+    out = intake.unpack_client(
+        _envelope([_read_req("idr1", 2, "someone")]), "friendly2")
+    assert len(out) == 1
+
+
+def test_intake_over_length_envelope_strikes_sender():
+    intake = GatewayIntake(verifier=OpenSSLVerifier(),
+                           senders=SenderRegistry(strikes=1),
+                           max_envelope_bytes=64)
+    big = _envelope([_read_req("idr1", i, "d" * 40) for i in range(8)])
+    assert len(big) > 64
+    assert intake.unpack_client(big, "flooder") is None
+    assert intake.senders.is_shed("flooder")
+    # the bound is on the envelope, not the session: small ones pass
+    intake2 = GatewayIntake(verifier=OpenSSLVerifier(),
+                            max_envelope_bytes=len(big))
+    assert intake2.unpack_client(big, "ok") is not None
+
+
+def test_intake_entry_garbage_costs_only_that_entry():
+    intake = GatewayIntake(verifier=OpenSSLVerifier())
+    good_raw = msgpack.packb(_read_req("idr1", 7, "x"),
+                             use_bin_type=True)
+    env = flat_wire.encode_propagate_envelope(
+        [good_raw, b"\xc1\xff\x00"], ["a", "b"])
+    out = intake.unpack_client(env, "mixed")
+    assert [m["reqId"] for m, _ in out] == [7]
+    assert not intake.senders.is_shed("mixed")
+    assert not intake.senders._counts.get("mixed")  # no strike either
+
+
+def test_intake_non_propagate_section_is_sender_attributable():
+    """A client-facing sender has no business shipping 3PC sections —
+    the whole envelope is dropped and the sender struck."""
+    from plenum_tpu.common.messages.node_messages import Commit
+    tm = TelemetryHub(name="intake-3pc")
+    intake = GatewayIntake(
+        verifier=OpenSSLVerifier(),
+        senders=SenderRegistry(strikes=1, telemetry=tm), telemetry=tm)
+    env = flat_wire.encode_three_pc(
+        [], [], [Commit(instId=0, viewNo=0, ppSeqNo=1)])
+    assert intake.unpack_client(env, "sneaky") is None
+    assert intake.senders.is_shed("sneaky")
+
+
+def test_intake_dedup_and_prescreen_rejects_bad_signature():
+    tm = TelemetryHub(name="intake-screen")
+    intake = GatewayIntake(verifier=OpenSSLVerifier(), telemetry=tm)
+    a = SimpleSigner(seed=b"\x91" * 32)
+    b = SimpleSigner(seed=b"\x92" * 32)
+    w1 = _write_req(a, 1, verkey=a.verkey)
+    w2 = _write_req(b, 1, verkey=b.verkey)
+    # dedup: a co-arriving retry of w1 needs one verdict
+    msgs = intake.fresh_only([(w1, "c1"), (w2, "c2"),
+                              (dict(w1), "c1-retry")])
+    assert [m["reqId"] for m, _ in msgs] == [1, 1]
+    assert intake.fresh_only([(dict(w1), "c1")]) == []
+    assert tm.snapshot()["counters"][TM.GATEWAY_DEDUP_HITS] == 2
+    # pre-screen: a tampered signature is dropped, the rest survive;
+    # a read (no signature at all) is unscreenable and passes through
+    forged = dict(w2)
+    forged["signature"] = w1["signature"]
+    read = _read_req(a.identifier, 9, b.identifier)
+    handle = intake.screen_dispatch(
+        [(w1, "c1"), (forged, "evil"), (read, "r")])
+    intake.screen_flush()
+    kept = intake.screen_conclude(handle)
+    assert [(m.get("reqId"), c) for m, c in kept] == [(1, "c1"),
+                                                      (9, "r")]
+    assert tm.snapshot()["counters"][TM.GATEWAY_SIG_REJECTS] == 1
+
+
+# --------------------------------------------------- admission ladder
+
+
+def test_admission_ladder_degrades_reads_first_with_hysteresis():
+    conf = Config(GATEWAY_BACKLOG_HIGH=100, GATEWAY_BACKLOG_LOW=50,
+                  GATEWAY_BACKLOG_HARD=1000, GATEWAY_P99_HIGH_MS=400.0,
+                  GATEWAY_P99_LOW_MS=200.0, GATEWAY_P99_HARD_MS=1200.0)
+    ac = AdmissionController(conf)
+    assert ac.level == ADMIT_ALL and ac.admits_read() \
+        and ac.admits_write()
+    # backlog over high: reads degrade FIRST, writes still flow
+    assert ac.observe(150, None) == SHED_READS
+    assert not ac.admits_read() and ac.admits_write()
+    # either signal escalates: p99 alone does too
+    ac.observe(10, None)
+    assert ac.level == ADMIT_ALL
+    assert ac.observe(0, 500.0) == SHED_READS
+    # hard mark: writes shed too, from ANY level, immediately
+    assert ac.observe(2000, None) == SHED_WRITES
+    assert not ac.admits_write()
+    assert AdmissionController(conf).observe(0, 5000.0) == SHED_WRITES
+    # between low and high: HOLD (no flapping around one mark)
+    assert ac.observe(70, 300.0) == SHED_WRITES
+    # recovery is one level per observation, both signals under low
+    assert ac.observe(10, 100.0) == SHED_READS
+    assert ac.admits_write() and not ac.admits_read()
+    assert ac.observe(10, 100.0) == ADMIT_ALL
+    assert ac.snapshot() == {"level": "admit_all", "backlog": 10.0,
+                             "ordered_p99_ms": 100.0}
+
+
+# --------------------------------------------------- signed-read cache
+
+
+def _sp_stub(root, ts):
+    return {ROOT_HASH: root, PROOF_NODES: "pn",
+            MULTI_SIGNATURE: {"value": {"timestamp": ts}}}
+
+
+def test_signed_read_cache_verifies_ages_and_pins_roots():
+    verdict = {"err": None}
+    seen = []
+
+    def check(sp, key, value, ledger_id=None, max_age=None, now=None):
+        seen.append((key, value, ledger_id, max_age, now))
+        return verdict["err"]
+
+    tm = TelemetryHub(name="cache")
+    cache = SignedReadCache(check, fresh_s=30.0, max_entries=2,
+                            telemetry=tm)
+    r1 = {"data": {"x": 1}, STATE_PROOF: _sp_stub("rootA", 100.0)}
+    assert cache.put(1, b"k1", b"v1", r1, now=101.0) is None
+    # insert-time verification went through check_proof with the
+    # cache's own freshness window
+    assert seen[-1] == (b"k1", b"v1", 1, 30.0, 101.0)
+    assert cache.get(1, b"k1", now=105.0) is r1
+    # freshness window is on the SIGNED timestamp, not insert time
+    assert cache.get(1, b"k1", now=100.0 + 31.0) is None
+    assert len(cache) == 0
+    # a named check failure is surfaced and nothing is stored
+    verdict["err"] = "root mismatch: forged"
+    assert cache.put(1, b"k1", b"v1", r1, 101.0) == \
+        "root mismatch: forged"
+    assert len(cache) == 0
+    verdict["err"] = None
+    # a result with no proof can never enter the cache
+    assert cache.put(1, b"k", None, {"data": 1}, 0.0) == \
+        "no state proof attached"
+    assert cache.put(1, b"k", None,
+                     {STATE_PROOF: {ROOT_HASH: "r"}}, 0.0) == \
+        "malformed state proof: no usable timestamp/root"
+    # root pinning: a newer signed root on the ledger invalidates
+    # older-root entries lazily on lookup
+    ra = {STATE_PROOF: _sp_stub("rootA", 100.0)}
+    rb = {STATE_PROOF: _sp_stub("rootB", 120.0)}
+    assert cache.put(1, b"k1", b"v", ra, 101.0) is None
+    assert cache.put(1, b"k2", b"v", rb, 121.0) is None
+    assert cache.get(1, b"k1", 122.0) is None
+    assert cache.get(1, b"k2", 122.0) is rb
+    # LRU bound: state keys are client-chosen
+    assert cache.put(1, b"k3", b"v",
+                     {STATE_PROOF: _sp_stub("rootB", 121.0)},
+                     122.0) is None
+    assert cache.put(1, b"k4", b"v",
+                     {STATE_PROOF: _sp_stub("rootB", 122.0)},
+                     123.0) is None
+    assert len(cache) == 2
+    counters = tm.snapshot()["counters"]
+    assert counters[TM.GATEWAY_CACHE_HITS] >= 2
+    assert counters[TM.GATEWAY_CACHE_MISSES] >= 2
+
+
+# ------------------------------- satellite 3: named proof-check verdicts
+
+
+def test_check_proof_dict_names_each_failed_check(proof_ctx):
+    """Every check_proof_dict failure path returns a message NAMING the
+    failed check — the cache (and any operator reading its logs) can
+    tell a stale answer from a mangled proof from a forged signature."""
+    from plenum_tpu.client.client import PoolClient
+    from plenum_tpu.client.wallet import Wallet
+    from plenum_tpu.common.serializers.base58 import b58encode
+
+    client, sp = proof_ctx["client"], proof_ctx["sp"]
+    key, value = proof_ctx["key"], proof_ctx["value"]
+    check = client.check_proof_dict
+    # the honest proof passes
+    assert check(sp, key, value) is None
+
+    # no BLS wiring at all
+    w = Wallet()
+    w.add_identifier(signer=SimpleSigner(seed=b"\x84" * 32))
+    plain = PoolClient(w, proof_ctx["names"],
+                       send_fn=lambda n, m: None)
+    assert plain.check_proof_dict(sp, key, value) == \
+        "no BLS verifier/keys configured"
+
+    # structurally not a proof
+    assert check(None, key, value) == \
+        "malformed state proof: not a dict with a multi-signature"
+    no_ms = {ROOT_HASH: sp[ROOT_HASH], PROOF_NODES: sp[PROOF_NODES]}
+    assert check(no_ms, key, value) == \
+        "malformed state proof: not a dict with a multi-signature"
+
+    # unparseable multi-signature
+    bad_ms = copy.deepcopy(sp)
+    bad_ms[MULTI_SIGNATURE] = {"garbage": 1}
+    assert check(bad_ms, key, value).startswith(
+        "multi-sig invalid: unparseable multi-signature")
+
+    # the multi-sig vouches for a DIFFERENT root than the proof claims
+    wrong_root = copy.deepcopy(sp)
+    wrong_root[ROOT_HASH] = b58encode(b"\x37" * 32)
+    assert check(wrong_root, key, value).startswith(
+        "root mismatch: multi-signature vouches for root")
+
+    # right root, wrong ledger
+    assert check(sp, key, value, ledger_id=0).startswith(
+        "ledger mismatch: multi-signature covers ledger")
+
+    # staleness (only with a window)
+    ts = sp[MULTI_SIGNATURE]["value"]["timestamp"]
+    assert check(sp, key, value, max_age=300, now=ts + 10) is None
+    assert check(sp, key, value, max_age=300,
+                 now=ts + 10000).startswith("stale proof:")
+
+    # participant-set abuse: duplicates, thin quorums, strangers
+    dup = copy.deepcopy(sp)
+    parts = dup[MULTI_SIGNATURE]["participants"]
+    parts[-1] = parts[0]
+    assert check(dup, key, value) == \
+        "multi-sig invalid: duplicate participants"
+    thin = copy.deepcopy(sp)
+    thin[MULTI_SIGNATURE]["participants"] = \
+        thin[MULTI_SIGNATURE]["participants"][:1]
+    assert check(thin, key, value) == \
+        "multi-sig invalid: 1 signers below the n-f quorum"
+    stranger = copy.deepcopy(sp)
+    stranger[MULTI_SIGNATURE]["participants"][0] = "NodeX"
+    assert check(stranger, key, value) == \
+        "multi-sig invalid: unregistered signer 'NodeX'"
+
+    # forged aggregate signature
+    forged = copy.deepcopy(sp)
+    ms = forged[MULTI_SIGNATURE]
+    ms["signature"] = ms["signature"][:-4] + "1111"
+    assert check(forged, key, value).startswith(
+        "multi-sig invalid: aggregate")
+
+    # proof-node corruption: undecodable data, and genuine nodes that
+    # do not tie the CLAIMED value to the signed root
+    mangled = copy.deepcopy(sp)
+    mangled[PROOF_NODES] = "!!!not-a-proof!!!"
+    assert check(mangled, key, value).startswith(
+        "proof-node corruption: undecodable proof data")
+    assert check(sp, key, b"forged-value") == \
+        "proof-node corruption: proof nodes do not tie the claimed " \
+        "value to the signed root"
+    assert check(sp, key, None).startswith("proof-node corruption:")
+
+    # and the boolean wrapper is exactly "no named failure"
+    assert client.verify_proof_dict(sp, key, value)
+    assert not client.verify_proof_dict(sp, key, b"forged-value")
+
+
+# ----------------------------------------------- acceptance: end to end
+
+
+def test_gateway_e2e_reads_shed_before_writes_roots_byte_equal(signers):
+    """The ISSUE 16 acceptance contract, end to end: a gateway-fed BLS
+    pool under induced backlog (1) sheds reads while writes still flow,
+    then writes only past the hard mark, (2) serves cached proof-
+    bearing reads at EVERY shed level, and (3) leaves ledger AND state
+    roots byte-equal to a gateway-less pool fed the same admitted
+    stream — the pre-screen filters, the nodes stay the authority."""
+    from plenum_tpu.client.client import PoolClient
+    from plenum_tpu.client.wallet import Wallet
+    from plenum_tpu.common.request import Request
+
+    names = list(signers)
+    nodes, sinks, timer = _bls_pool(MockTimer(), names, signers)
+    first = names[0]
+
+    wallet = Wallet()
+    wallet.add_identifier(signer=SimpleSigner(seed=b"\x85" * 32))
+    proof_client = PoolClient(
+        wallet, names, send_fn=lambda n, m: None,
+        bls_verifier=BlsCryptoVerifierPlenum(),
+        bls_key_provider=lambda n: signers[n].pk)
+
+    def serve_read(msg, _client):
+        try:
+            return nodes[first].read_manager.get_result(
+                Request.from_dict(dict(msg)))
+        except Exception:
+            return None
+
+    outbound = []
+    conf = Config(GATEWAY_BACKLOG_HIGH=100, GATEWAY_BACKLOG_LOW=10,
+                  GATEWAY_BACKLOG_HARD=1000)
+    gw = Gateway(forward_writes=outbound.append, serve_read=serve_read,
+                 check_proof=proof_client.check_proof_dict,
+                 verifier=OpenSSLVerifier(), config=conf)
+
+    hot = SimpleSigner(seed=b"\x86" * 32)
+    authors = [SimpleSigner(seed=bytes([0xA0 + i]) * 32)
+               for i in range(6)]
+    rid = iter(range(1, 100))
+
+    admitted_stream = []   # per tick: the replay input for pool B
+    ticks = []
+
+    def pump_tick(arrival_msgs, backlog):
+        arrivals = []
+        chunk = 2  # several envelopes a tick, like a real LB fleet
+        for lo in range(0, len(arrival_msgs), chunk):
+            part = arrival_msgs[lo:lo + chunk]
+            arrivals.append((_envelope(part), "lb-%d" % (lo % 3),
+                             timer.get_current_time()))
+        tick = gw.pump(arrivals, now=timer.get_current_time(),
+                       backlog=backlog)
+        for env in outbound:
+            for n in nodes.values():
+                n.process_gateway_envelope(env, "gw-front")
+        outbound.clear()
+        admitted_stream.append([(dict(m), c)
+                                for m, c in tick.admitted_writes])
+        ticks.append(tick)
+        _pump_nodes(timer, nodes, 3.0)
+        return tick
+
+    # tick 0 (healthy): create the hot NYM + two others
+    t0 = pump_tick([_write_req(hot, next(rid), verkey=hot.verkey),
+                    _write_req(authors[0], next(rid),
+                               verkey=authors[0].verkey),
+                    _write_req(authors[1], next(rid),
+                               verkey=authors[1].verkey)], backlog=0)
+    assert len(t0.admitted_writes) == 3 and t0.level == "admit_all"
+    assert all(n.domain_ledger.size == 3 for n in nodes.values())
+
+    # tick 1 (healthy): a read of the hot NYM is served by the pool,
+    # proof-checked, and cached
+    t1 = pump_tick([_read_req(hot.identifier, next(rid),
+                              hot.identifier)], backlog=0)
+    assert len(t1.replies) == 1 and t1.cache_hits == 0
+    client_id, reply = t1.replies[0]
+    assert reply["data"][VERKEY] == hot.verkey
+    assert MULTI_SIGNATURE in reply["state_proof"]
+    assert len(gw.cache) == 1
+
+    # tick 2 (backlog over HIGH): fresh reads shed, writes still
+    # admitted, the CACHED hot read still served — plus one forged-
+    # signature write screened out and one duplicate collapsed
+    w_next = _write_req(authors[2], next(rid), verkey=authors[2].verkey)
+    forged = _write_req(authors[3], next(rid), verkey=authors[3].verkey)
+    forged["signature"] = w_next["signature"]
+    t2 = pump_tick([w_next, dict(w_next), forged,
+                    _read_req(hot.identifier, next(rid),
+                              hot.identifier),
+                    _read_req(authors[0].identifier, next(rid),
+                              authors[0].identifier)], backlog=500)
+    assert t2.level == "shed_reads"
+    assert t2.shed_reads == 1 and t2.shed_writes == 0
+    assert t2.sig_rejects == 1
+    assert [m["reqId"] for m, _ in t2.admitted_writes] == \
+        [w_next["reqId"]]
+    assert t2.cache_hits == 1  # the hot read, served while shedding
+    assert t2.replies[0][1]["data"][VERKEY] == hot.verkey
+    # the contract sentence: reads degraded while writes flowed
+    assert t2.shed_reads > 0 and len(t2.admitted_writes) > 0
+
+    # tick 3 (backlog past HARD): writes shed too; ONLY the cache
+    # still answers
+    t3 = pump_tick([_write_req(authors[4], next(rid),
+                               verkey=authors[4].verkey),
+                    _read_req(hot.identifier, next(rid),
+                              hot.identifier),
+                    _read_req(authors[1].identifier, next(rid),
+                              authors[1].identifier)], backlog=5000)
+    assert t3.level == "shed_writes"
+    assert t3.shed_writes == 1 and t3.shed_reads == 1
+    assert t3.cache_hits == 1 and t3.admitted_writes == []
+
+    # ticks 4-5: pressure gone — hysteretic one-level-per-tick recovery
+    t4 = pump_tick([], backlog=0)
+    assert t4.level == "shed_reads"
+    t5 = pump_tick([_write_req(authors[5], next(rid),
+                               verkey=authors[5].verkey)], backlog=0)
+    assert t5.level == "admit_all"
+    assert len(t5.admitted_writes) == 1
+
+    total_admitted = sum(len(a) for a in admitted_stream)
+    assert total_admitted == 5
+    assert all(n.domain_ledger.size == total_admitted
+               for n in nodes.values())
+
+    # the node-side wire guard holds on its own too: garbage and 3PC
+    # sections from a "gateway" are suspicion, not a crash
+    assert nodes[first].unpack_gateway_batch(b"\x00junk", "evil") == []
+    env = flat_wire.encode_three_pc(
+        [], [], [__import__("plenum_tpu.common.messages.node_messages",
+                            fromlist=["Commit"]).Commit(
+            instId=0, viewNo=0, ppSeqNo=9)])
+    assert nodes[first].unpack_gateway_batch(env, "evil") == []
+
+    # ---- pool B: identical genesis, NO gateway — fed the recorded
+    # admitted stream on the same tick cadence
+    nodes_b, _sinks_b, timer_b = _bls_pool(MockTimer(), names, signers)
+    for batch in admitted_stream:
+        if batch:
+            for n in nodes_b.values():
+                n.process_client_batch(
+                    [(copy.deepcopy(m), c) for m, c in batch])
+        _pump_nodes(timer_b, nodes_b, 3.0)
+
+    for name in names:
+        a, b = nodes[name], nodes_b[name]
+        assert a.domain_ledger.size == b.domain_ledger.size
+        assert a.domain_ledger.root_hash == b.domain_ledger.root_hash
+        assert a.db_manager.get_state(1).committedHeadHash == \
+            b.db_manager.get_state(1).committedHeadHash
+    # and byte-equal ACROSS pools means across nodes as well
+    assert len({n.domain_ledger.root_hash
+                for n in list(nodes.values())
+                + list(nodes_b.values())}) == 1
+
+
+def test_gateway_helpers_classify_reads_and_cache_keys():
+    a = SimpleSigner(seed=b"\x93" * 32)
+    read = _read_req(a.identifier, 1, a.identifier)
+    write = _write_req(a, 2, verkey=a.verkey)
+    assert is_read(read) and not is_read(write)
+    key = cache_key_for(read)
+    assert key is not None and key[0] == 1
+    # a timestamped (state-at-a-time) read must bypass the cache
+    ts_read = _read_req(a.identifier, 3, a.identifier)
+    ts_read["operation"]["timestamp"] = 12345
+    assert cache_key_for(ts_read) is None
+    assert cache_key_for(write) is None

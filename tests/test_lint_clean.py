@@ -57,6 +57,32 @@ def test_baseline_has_no_stale_entries():
         "fixed — prune them): %r" % (baseline.stale(),))
 
 
+def test_gateway_tier_is_covered_by_path_scoped_rules():
+    """The client-facing gateway tier must sit inside the blast radius
+    of every path-scoped rule that guards the pool tiers it fronts:
+    PT001 (blocking calls in intake handlers), PT008 (per-item hot
+    loops), PT010 (per-item wire serialization) apply to
+    ``plenum_tpu/gateway/``, and the PT012 whole-program
+    nondeterminism walk is rooted at the gateway lane planner — so a
+    regression in the new tier fails THIS gate, not a code review."""
+    from plenum_tpu.analysis.rules.pt001_blocking import BlockingCallRule
+    from plenum_tpu.analysis.rules.pt008_per_item_hot_loop import (
+        PerItemHotLoopRule)
+    from plenum_tpu.analysis.rules.pt010_wire_serializer import (
+        WireSerializerLoopRule)
+    from plenum_tpu.analysis.rules.pt012_nondeterminism import (
+        DEFAULT_ROOTS)
+    probe = "plenum_tpu/gateway/intake.py"
+    assert BlockingCallRule().applies(probe)
+    assert PerItemHotLoopRule().applies(probe)
+    assert WireSerializerLoopRule().applies(probe)
+    assert any(path == "plenum_tpu/gateway/lane_router.py"
+               for path, _ in DEFAULT_ROOTS), (
+        "PT012 must treat the gateway lane planner as a determinism "
+        "root — it must compute the identical partition as the "
+        "node-side planner")
+
+
 def test_baseline_entries_are_justified():
     from plenum_tpu.analysis.baseline import Baseline
     base = Baseline.load(BASELINE)
