@@ -2298,9 +2298,9 @@ def micro_bls():
     # SURVEY §2.9 ursa mapping): B independent 100-share aggregations
     # per dispatch, pipelined depth 2 to overlap host packing with
     # device compute. Cross-checked against the C path every run.
-    from plenum_tpu.crypto.bls import _unb58
+    from plenum_tpu.crypto.bls import b58_decode
     from plenum_tpu.ops import bls381_jax as bjk
-    raw100 = [_unb58(s) for s in sigs_by_n[100]]
+    raw100 = [b58_decode(s) for s in sigs_by_n[100]]
     want = bls_ops.g1_aggregate_compressed(raw100)
     B_JOBS = 256
     jobs = [raw100] * B_JOBS
@@ -2330,6 +2330,49 @@ def micro_bls():
         "cpu_batch_floor_per_s": round(c_rate, 1),
         "vs_cpu_floor": round(B_JOBS / best / c_rate, 2),
     }
+    # ---- device pairing verify (ops/bls381_pairing behind the
+    # bls_ops routing): a batch of signature checks becomes ONE
+    # bucketed Miller-loop launch with a shared final exponentiation.
+    # Verdict parity against the scalar backend is asserted BEFORE any
+    # timing — a fast wrong kernel must never post a headline number.
+    # On a CPU host this is a validation rate, not a win (the kernel
+    # is shaped for the TPU's 8-wide mesh; the native C scalar path
+    # above is the CPU money path) — bls_regression_gate checks the
+    # number EXISTS and the verdicts matched, not that CPU beats C.
+    n_dev = 8
+    dev = {"jobs_per_launch": n_dev,
+           "desc": "batched device pairing verify (one Miller launch "
+                   "+ shared final exp per batch); parity vs the "
+                   "scalar backend asserted before timing"}
+    if not bls_ops.pairing_device_ready(n_dev):
+        dev["skipped"] = ("device pairing unavailable (jax missing, "
+                         "feature off, or family stepped down)")
+    else:
+        dsigners = [BlsCryptoSignerPlenum.generate(
+            bytes([0x60 + i]) * 32)[0] for i in range(n_dev)]
+        checks = [(s.sign(msg), msg, s.pk) for s in dsigners]
+        # adversarial rows keep the parity assertion honest: a wrong
+        # message and a signature over a different message must both
+        # come back False from the SAME launch that verifies the rest
+        checks[-1] = (dsigners[-1].sign(b"tampered"), msg,
+                      dsigners[-1].pk)
+        checks[-2] = (dsigners[-2].sign(msg), b"other",
+                      dsigners[-2].pk)
+        want = [verifier.verify_sig(*c) for c in checks]
+        got = verifier.verify_sigs_batch(checks)   # compile + warm
+        dev["parity_ok"] = got == want
+        if dev["parity_ok"]:
+            times = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                assert verifier.verify_sigs_batch(checks) == want
+                times.append(time.perf_counter() - t0)
+            best_s = min(times)
+            dev["bls_verifies_per_s"] = round(n_dev / best_s, 2)
+            dev["launch_ms"] = round(best_s * 1e3, 1)
+            dev["vs_scalar_native"] = round(
+                n_dev / best_s / out["4"]["verify_per_s"], 4)
+    results["device_pairing"] = dev
     # ---- floors. Pure-Python pairing measured; optimized-library
     # (ursa/blst-class) verify is a DOCUMENTED estimate: those libraries
     # pair in ~1.3-2 ms => ~500-770 verifies/s on one core. Neither
@@ -2352,6 +2395,54 @@ def micro_bls():
     results["vs_optimized_floor_est"] = round(
         out["100"]["verify_per_s"] / 700, 2)
     return results
+
+
+# absolute floor for the scalar (native C) multi-sig verify rate at
+# n=100 — prior rounds measured 120-360/s, so 25/s means the backend
+# silently fell back to pure Python or the money path regressed ~5x
+# (bls_regression_gate)
+BLS_VERIFY_FLOOR = 25.0
+
+
+def bls_regression_gate(bls, floor=None):
+    """HARD headline gate for the BLS verify path: the device pairing
+    batch must have been measured (``bls_verifies_per_s`` present and
+    positive) with verdict parity against the scalar backend asserted
+    BEFORE timing (``parity_ok``), and the scalar n=100 multi-sig
+    verify rate must hold at or above BLS_VERIFY_FLOOR. Returns the
+    list of failures; main() records them in the headline and exits
+    nonzero unless BENCH_BLS_GATE=warn (diagnostic runs on degraded
+    hosts — the headline still records the failures). Pure function of
+    the micro_bls dict, so tier-1 gates the gate itself
+    (tests/test_bench_gate.py) without running a bench."""
+    floor = BLS_VERIFY_FLOOR if floor is None else floor
+    if not isinstance(bls, dict):
+        return ["micro_bls produced no result dict"]
+    failures = []
+    dev = bls.get("device_pairing")
+    if not isinstance(dev, dict):
+        failures.append("device_pairing missing from micro_bls")
+    else:
+        if dev.get("skipped"):
+            failures.append("device pairing was skipped: %s"
+                            % (dev["skipped"],))
+        elif dev.get("parity_ok") is not True:
+            failures.append(
+                "device_pairing parity_ok is not True — device "
+                "verdicts diverged from the scalar backend")
+        rate = dev.get("bls_verifies_per_s")
+        if not dev.get("skipped") \
+                and (not isinstance(rate, (int, float)) or rate <= 0):
+            failures.append(
+                "bls_verifies_per_s missing or non-positive")
+    scalar = ((bls.get("by_n") or {}).get("100") or {}) \
+        .get("verify_per_s")
+    if scalar is None:
+        failures.append("by_n.100.verify_per_s missing from micro_bls")
+    elif scalar < floor:
+        failures.append("by_n.100.verify_per_s %.1f < required %.1f"
+                        % (scalar, floor))
+    return failures
 
 
 def main():
@@ -2420,6 +2511,7 @@ def main():
     mk_gate_failures = merkle_regression_gate(mk)
     mesh_res = micro_mesh()
     bls_results = micro_bls()
+    bls_gate_failures = bls_regression_gate(bls_results)
     state_res = micro_state()
     exec_res = micro_executor()
     p25 = pool25_both()
@@ -2500,6 +2592,13 @@ def main():
             "bls_n100_aggregate": (bls_results.get("by_n", {})
                                    .get("100", {})
                                    .get("aggregate_per_s")),
+            # device pairing verify (one Miller launch per batch);
+            # bls_regression_gate hard-fails when the measurement is
+            # missing or device verdicts diverge from the scalar path
+            "bls_verifies_per_s": (bls_results.get("device_pairing")
+                                   or {}).get("bls_verifies_per_s"),
+            "bls_gate_ok": not bls_gate_failures,
+            "bls_gate_failures": bls_gate_failures or None,
             "state_proofs_per_s": state_res["proofs_per_s"],
             "state_vs_python_proofs": state_res["vs_python_proofs"],
             "state_vs_python_apply": state_res["vs_python_apply"],
@@ -2591,6 +2690,10 @@ def main():
     if gw_gate_failures and gate_enforced("BENCH_GATEWAY_GATE"):
         print("GATEWAY GATE FAILED: "
               + "; ".join(gw_gate_failures), file=sys.stderr)
+        sys.exit(2)
+    if bls_gate_failures and gate_enforced("BENCH_BLS_GATE"):
+        print("BLS REGRESSION GATE FAILED: "
+              + "; ".join(bls_gate_failures), file=sys.stderr)
         sys.exit(2)
 
 

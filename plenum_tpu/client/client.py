@@ -291,6 +291,66 @@ class PoolClient:
         multi-signature are different operational facts: the first is
         a stale/substituted answer, the second a mangled proof, the
         third a forged (or mis-keyed) signature."""
+        pre = self._check_proof_pre(sp, ledger_id, max_age, now)
+        if isinstance(pre, str):
+            return pre
+        multi, keys = pre
+        # 4. the aggregated signature itself (the expensive pairing)
+        try:
+            sig_ok = self._bls_verifier.verify_multi_sig(
+                multi.signature, multi.value.as_single_value(), keys)
+        except Exception as e:
+            return "multi-sig invalid: aggregate verification " \
+                   "raised (%s)" % e
+        if not sig_ok:
+            return "multi-sig invalid: aggregate signature does not " \
+                   "verify against the registered keys"
+        return self._check_proof_nodes(sp, key, value)
+
+    def check_proof_dicts(self, checks,
+                          ledger_id: Optional[int] = None,
+                          max_age: Optional[float] = None,
+                          now: Optional[float] = None) -> list:
+        """``check_proof_dict`` over a batch of (sp, key, value)
+        triples sharing one ledger/freshness context → verdict per
+        item. The cheap structural checks run per proof; every
+        surviving proof's aggregate pairing then goes through ONE
+        ``verify_multi_sigs_batch`` call — a single device launch above
+        Config.BLS_PAIRING_DEVICE_MIN (the signed-read seam this
+        batches is the same check the gateway cache admits on)."""
+        results = [None] * len(checks)
+        pending = []
+        for i, (sp, key, value) in enumerate(checks):
+            pre = self._check_proof_pre(sp, ledger_id, max_age, now)
+            if isinstance(pre, str):
+                results[i] = pre
+            else:
+                pending.append((i, pre[0], pre[1]))
+        if not pending:
+            return results
+        try:
+            verdicts = self._bls_verifier.verify_multi_sigs_batch(
+                [(m.signature, m.value.as_single_value(), keys)
+                 for _, m, keys in pending])
+        except Exception as e:
+            msg = "multi-sig invalid: aggregate verification " \
+                  "raised (%s)" % e
+            for i, _, _ in pending:
+                results[i] = msg
+            return results
+        for (i, _, _), ok in zip(pending, verdicts):
+            if not ok:
+                results[i] = "multi-sig invalid: aggregate signature " \
+                             "does not verify against the registered keys"
+            else:
+                sp, key, value = checks[i]
+                results[i] = self._check_proof_nodes(sp, key, value)
+        return results
+
+    def _check_proof_pre(self, sp, ledger_id, max_age, now):
+        """Steps 1-3 of ``check_proof_dict`` (everything before the
+        pairing): an error string, or (MultiSignature, keys) ready for
+        the aggregate check."""
         if self._bls_verifier is None or self._bls_keys is None:
             return "no BLS verifier/keys configured"
         from plenum_tpu.common.constants import (
@@ -342,17 +402,14 @@ class PoolClient:
             if pk is None:
                 return "multi-sig invalid: unregistered signer %r" % name
             keys.append(pk)
-        # 4. the aggregated signature itself (the expensive pairing)
-        try:
-            sig_ok = self._bls_verifier.verify_multi_sig(
-                multi.signature, multi.value.as_single_value(), keys)
-        except Exception as e:
-            return "multi-sig invalid: aggregate verification " \
-                   "raised (%s)" % e
-        if not sig_ok:
-            return "multi-sig invalid: aggregate signature does not " \
-                   "verify against the registered keys"
-        # 5. proof nodes: claimed value (or absence) under the root
+        return multi, keys
+
+    @staticmethod
+    def _check_proof_nodes(sp, key: bytes,
+                           value: Optional[bytes]) -> Optional[str]:
+        """Step 5 of ``check_proof_dict``: the proof nodes must tie
+        `value` (or its absence) to the signed root."""
+        from plenum_tpu.common.constants import PROOF_NODES, ROOT_HASH
         try:
             from plenum_tpu.common.serializers.base58 import b58decode
             from plenum_tpu.state.pruning_state import PruningState

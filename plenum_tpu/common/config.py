@@ -297,6 +297,16 @@ class Config:
     SHA256_TPU_MIN_BATCH = 256
     BLS_PROVIDER = "cpu"
 
+    # ---- device BLS12-381 pairing / MSM (ops/bls381_pairing.py behind
+    # crypto/bls_ops): batches of pairing-product checks run as one
+    # bucketed Miller-loop launch with a SINGLE shared final
+    # exponentiation; below the MIN the native scalar path (prepared
+    # Miller lines, cached decompressions) wins on latency. Env
+    # PLENUM_TPU_BLS_TOWER=native|off forces the host path.
+    BLS_DEVICE_PAIRING = True
+    BLS_PAIRING_DEVICE_MIN = 4
+    BLS_MSM_DEVICE_MIN = 8       # Σ sᵢ·Pᵢ points below this stay host
+
     # batch size at which AdaptiveVerifier / CoalescingVerifierHub leave
     # the scalar CPU floor for a device launch (single-sourced here,
     # like the MERKLE_DEVICE_* knobs)

@@ -272,11 +272,17 @@ class BlsBftReplica:
                     self._pending_backfill[key] = True
                 self._gc(key[1])
                 return
+            # the unroll is the batch seam: every deferred share gets
+            # its own pairing check, and above BLS_PAIRING_DEVICE_MIN
+            # they all run as ONE device launch (bls.verify_sigs_batch)
+            verdicts = dict(zip(deferred_unchecked,
+                                self._verifier.verify_sigs_batch(
+                                    [(sigs[i], signed, pks[i])
+                                     for i in deferred_unchecked])))
             keep = []
             for i, (sig, sender, pk) in enumerate(
                     zip(sigs, participants, pks)):
-                if i not in deferred_unchecked \
-                        or self._verifier.verify_sig(sig, signed, pk):
+                if verdicts.get(i, True):
                     keep.append(i)
                 else:
                     logger.warning(

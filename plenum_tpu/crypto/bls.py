@@ -24,14 +24,22 @@ _DST_SIG = b"PLENUM_TPU_BLS_SIG"
 _DST_POP = b"PLENUM_TPU_BLS_POP"
 
 
-def _b58(data: bytes) -> str:
+def b58_encode(data: bytes) -> str:
+    """Public codec for the b58 wire form of sigs/keys (tooling and
+    benches use these instead of reaching into module privates)."""
     from plenum_tpu.common.serializers.base58 import b58encode
     return b58encode(data)
 
 
-def _unb58(s: str) -> bytes:
+def b58_decode(s: str) -> bytes:
+    """Inverse of ``b58_encode``; raises ValueError on bad input."""
     from plenum_tpu.common.serializers.base58 import b58decode
     return b58decode(s)
+
+
+# historic internal names, kept for in-module brevity
+_b58 = b58_encode
+_unb58 = b58_decode
 
 
 class BlsCryptoVerifier(ABC):
@@ -49,6 +57,21 @@ class BlsCryptoVerifier(ABC):
 
     @abstractmethod
     def verify_key_proof_of_possession(self, key_proof: str, pk: str) -> bool: ...
+
+    # batch seams with scalar-loop defaults: callers (consensus share
+    # unroll, client proof batches) call these unconditionally; backends
+    # that can amortize (one device pairing launch per batch) override
+    def verify_sigs_batch(
+            self, checks: Sequence[tuple]) -> List[bool]:
+        """checks: (signature, message, pk) triples → per-item verdicts
+        identical to mapping ``verify_sig``."""
+        return [self.verify_sig(s, m, pk) for (s, m, pk) in checks]
+
+    def verify_multi_sigs_batch(
+            self, checks: Sequence[tuple]) -> List[bool]:
+        """checks: (signature, message, pks) triples → per-item verdicts
+        identical to mapping ``verify_multi_sig``."""
+        return [self.verify_multi_sig(s, m, pks) for (s, m, pks) in checks]
 
 
 class BlsCryptoSigner(ABC):
@@ -208,14 +231,21 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
         self._agg_cache[key] = agg
         return agg
 
+    def _sig_cached(self, signature: str):
+        """Decompressed share point, memoized (ordering re-reads every
+        share create_multi_sig-side; never pay the sqrt twice). May
+        raise ValueError/KeyError on undecodable input."""
+        sig = self._sig_point_cache.get(signature)
+        if sig is None:
+            sig = self._g1(signature)
+            if len(self._sig_point_cache) > 8192:
+                self._sig_point_cache.clear()
+            self._sig_point_cache[signature] = sig
+        return sig
+
     def verify_sig(self, signature: str, message: bytes, pk: str) -> bool:
         try:
-            sig = self._sig_point_cache.get(signature)
-            if sig is None:
-                sig = self._g1(signature)
-                if len(self._sig_point_cache) > 8192:
-                    self._sig_point_cache.clear()
-                self._sig_point_cache[signature] = sig
+            sig = self._sig_cached(signature)
         except (ValueError, KeyError):
             return False
         pub, valid = self._pk_point(pk)
@@ -243,6 +273,77 @@ class BlsCryptoVerifierPlenum(BlsCryptoVerifier):
             return False
         h = bls.hash_to_g1(message, _DST_SIG)
         return self._pairing_is_one(sig, h, key, agg_pk)
+
+    # ------------------------------------------------------ batch verify
+    # One device pairing launch per batch (ops/bls381_pairing via
+    # bls_ops.multi_pairing_is_one_jobs) when the batch clears
+    # Config.BLS_PAIRING_DEVICE_MIN; below it the scalar path with its
+    # prepared Miller lines wins. Every host-side pre-check (decode,
+    # subgroup, key validity) runs EXACTLY as in the scalar methods, so
+    # batch and scalar verdicts agree item-for-item — only the pairing
+    # product itself moves to the device.
+
+    _neg_g2_c = None     # compressed -G2: fixed first pair of every job
+
+    @classmethod
+    def _neg_g2_bytes(cls) -> bytes:
+        if cls._neg_g2_c is None:
+            cls._neg_g2_c = bls.g2_compress(bls.g2_neg(bls.G2_GEN))
+        return cls._neg_g2_c
+
+    def _job_pairs(self, signature: str, message: bytes, pub):
+        """The 2-pair job e(sig,-G2)·e(H(m),pub) in compressed bytes;
+        pre-checks already passed, so both pairs decode live on device."""
+        h = bls.hash_to_g1(message, _DST_SIG)
+        return [(b58_decode(signature), self._neg_g2_bytes()),
+                (bls.g1_compress(h), bls.g2_compress(pub))]
+
+    def _job_single(self, signature: str, message: bytes, pk: str):
+        """verify_sig's pre-checks → job, or None for an immediate
+        False verdict (mirrors the scalar early-outs line for line)."""
+        try:
+            sig = self._sig_cached(signature)
+        except (ValueError, KeyError):
+            return None
+        pub, valid = self._pk_point(pk)
+        if sig is None or not valid or not bls.g1_in_subgroup(sig):
+            return None
+        return self._job_pairs(signature, message, pub)
+
+    def _job_multi(self, signature: str, message: bytes, pks):
+        if not pks:
+            return None
+        agg_pk = self._aggregate_pks(tuple(pks))
+        try:
+            sig = self._g1(signature)
+        except (ValueError, KeyError):
+            return None
+        if sig is None or agg_pk is None or not bls.g1_in_subgroup(sig):
+            return None
+        return self._job_pairs(signature, message, agg_pk)
+
+    def _verify_batch(self, checks, job_of):
+        results = [False] * len(checks)
+        jobs, live = [], []
+        for i, check in enumerate(checks):
+            job = job_of(*check)
+            if job is not None:
+                jobs.append(job)
+                live.append(i)
+        for i, ok in zip(live, bls.multi_pairing_is_one_jobs(jobs)):
+            results[i] = bool(ok)
+        return results
+
+    def verify_sigs_batch(self, checks) -> List[bool]:
+        if not bls.pairing_device_ready(len(checks)):
+            return [self.verify_sig(s, m, pk) for (s, m, pk) in checks]
+        return self._verify_batch(checks, self._job_single)
+
+    def verify_multi_sigs_batch(self, checks) -> List[bool]:
+        if not bls.pairing_device_ready(len(checks)):
+            return [self.verify_multi_sig(s, m, pks)
+                    for (s, m, pks) in checks]
+        return self._verify_batch(checks, self._job_multi)
 
     def create_multi_sig(self, signatures: Sequence[str]) -> str:
         """One backend call for the whole share-set: Jacobian

@@ -93,6 +93,141 @@ else:
         return agg
 
 
+# ---------------------------------------------------------------- device
+# Batched pairing / MSM (ops/bls381_pairing.py). Jobs of compressed
+# (G1, G2) byte pairs run as one bucketed Miller-loop launch with a
+# single shared final exponentiation; the host path below implements
+# the SAME verdict semantics pair-for-pair, so a device step-down is
+# invisible to callers. The heavy ops/ imports stay lazy — this module
+# loads on every node, jax only on the first batch above threshold.
+
+# env knob shared with ops/bls381_pairing: "native"/"off" pins the host
+# path; runtime failures step the family down permanently through the
+# same mesh registry as the Pallas kernels
+BLS_TOWER_ENV = "PLENUM_TPU_BLS_TOWER"
+
+
+def pairing_device_ready(n_jobs: int) -> bool:
+    """True when a batch of ``n_jobs`` pairing-product checks should
+    take the device kernel: batch clears Config.BLS_PAIRING_DEVICE_MIN,
+    the feature is on, and the tower backend has not been pinned off or
+    stepped down."""
+    from plenum_tpu.common.config import Config
+    if not getattr(Config, "BLS_DEVICE_PAIRING", True):
+        return False
+    if n_jobs < int(getattr(Config, "BLS_PAIRING_DEVICE_MIN", 4)):
+        return False
+    try:
+        from plenum_tpu.ops import mesh
+    except ImportError:  # pragma: no cover - jax-less deployment
+        return False
+    return mesh.xla_backend_enabled(BLS_TOWER_ENV)
+
+
+def pairing_job_host(pairs) -> bool:
+    """Host reference semantics for ONE pairing-product job — the
+    contract the device kernel is pinned byte-equal to: a both-infinity
+    pair is neutral (skipped), a one-sided infinity fails the job, any
+    undecodable / off-curve point fails the job, else the product over
+    the decoded pairs must be exactly 1. NO subgroup checks — callers
+    (crypto/bls.py) gate those before building jobs, identically on
+    both paths."""
+    try:
+        decoded = []
+        for s1, s2 in pairs:
+            p = g1_decompress(bytes(s1))
+            q = _py.g2_decompress(bytes(s2))
+            if (p is None) != (q is None):
+                return False
+            if p is None:
+                continue
+            decoded.append((p, q))
+        if not decoded:
+            return True
+        return multi_pairing_is_one(decoded)
+    except (ValueError, KeyError, TypeError, ZeroDivisionError):
+        # undecodable bytes, or a degenerate inversion inside the
+        # Python Miller loop on an adversarial (e.g. 2-torsion) point
+        return False
+
+
+def multi_pairing_is_one_jobs(jobs) -> list:
+    """Batch of independent pairing-product checks → verdict per job.
+    Each job is a sequence of (compressed G1, compressed G2) byte
+    pairs. One device launch for the whole batch above the threshold;
+    per-job host evaluation (``pairing_job_host``) otherwise, and as
+    the permanent step-down after a device failure."""
+    jobs = [list(j) for j in jobs]
+    if not jobs:
+        return []
+    if pairing_device_ready(len(jobs)):
+        try:
+            from plenum_tpu.ops import bls381_pairing as _bp
+            verdict, _ok = _bp.pairing_jobs(jobs)
+            return [bool(v) for v in verdict]
+        except Exception as e:  # pragma: no cover  # plenum-lint: disable=PT006
+            # any device-side failure (OOM, compile, runtime) must step
+            # the family down and serve host verdicts, never crash a
+            # verify path — same contract as the sha256/ed25519 Pallas
+            # fallbacks
+            import logging
+            from plenum_tpu.ops import mesh
+            mesh.disable_pallas_backend(BLS_TOWER_ENV)
+            logging.getLogger(__name__).warning(
+                "device BLS pairing failed (%s); stepped down to the "
+                "host path permanently", e)
+    return [pairing_job_host(j) for j in jobs]
+
+
+def g1_msm(points: Sequence[bytes], scalars: Sequence[int]):
+    """Σ sᵢ·Pᵢ over G1 — windowed multi-scalar multiplication. Device
+    kernel (shared doubling chain across the whole batch) above
+    Config.BLS_MSM_DEVICE_MIN when the tower backend is up; host
+    double-and-add per point otherwise. ``points`` are compressed
+    bytes; scalars are reduced mod r on both paths. Returns an affine
+    point, or None for the identity; raises ValueError on undecodable
+    input (both paths)."""
+    if len(points) != len(scalars):
+        raise ValueError("points/scalars length mismatch")
+    if not points:
+        return None
+    from plenum_tpu.common.config import Config
+    n_min = int(getattr(Config, "BLS_MSM_DEVICE_MIN", 8))
+    use_device = len(points) >= n_min \
+        and getattr(Config, "BLS_DEVICE_PAIRING", True)
+    if use_device:
+        try:
+            from plenum_tpu.ops import mesh
+            use_device = mesh.xla_backend_enabled(BLS_TOWER_ENV)
+        except ImportError:  # pragma: no cover - jax-less deployment
+            use_device = False
+    if use_device:
+        try:
+            from plenum_tpu.ops import bls381_pairing as _bp
+            point, ok = _bp.msm_g1(points, scalars)
+            if not ok:
+                raise ValueError("undecodable point in MSM input")
+            return point
+        except ValueError:
+            raise
+        except Exception as e:  # pragma: no cover  # plenum-lint: disable=PT006
+            # step-down, not crash: the host double-and-add below
+            # serves every MSM the device path would have
+            import logging
+            from plenum_tpu.ops import mesh
+            mesh.disable_pallas_backend(BLS_TOWER_ENV)
+            logging.getLogger(__name__).warning(
+                "device BLS MSM failed (%s); stepped down to the host "
+                "path permanently", e)
+    agg = None
+    for raw, s in zip(points, scalars):
+        p = g1_decompress(bytes(raw))
+        if p is None:
+            continue
+        agg = g1_add(agg, g1_mul(p, s % R))
+    return agg
+
+
 def hash_to_g1(msg: bytes, dst: bytes = b"PLENUM_TPU_BLS_G1") -> G1Point:
     """The single shared try-and-increment construction from bls12_381;
     fully native when the C backend is up (sha256 + sqrt + cofactor in

@@ -124,14 +124,19 @@ class Gateway:
     def __init__(self, forward_writes: Callable[[bytes], None],
                  serve_read: Callable[[dict, str], Optional[dict]] = None,
                  check_proof=None, verifier=None, verkey_provider=None,
-                 config=None, telemetry=None):
+                 config=None, telemetry=None, pool_hubs=None):
         """``forward_writes(envelope_bytes)`` delivers a packed write
         envelope to the pool; ``serve_read(msg, client)`` performs one
         pool read and returns the proof-bearing result dict (None =
         unavailable); ``check_proof`` is ``PoolClient.check_proof_dict``
-        (enables the signed-read cache when given)."""
+        (enables the signed-read cache when given). ``pool_hubs`` is an
+        iterable of pool TelemetryHubs — or a callable returning one —
+        that ``pump()`` self-sources pressure from when the driver does
+        not measure backlog/p99 itself (defaults to the gateway's own
+        hub)."""
         self._tm = telemetry if telemetry is not None \
             else NullTelemetryHub()
+        self._pool_hubs = pool_hubs
         self.intake = GatewayIntake(
             verifier=verifier, verkey_provider=verkey_provider,
             senders=SenderRegistry(telemetry=self._tm),
@@ -145,12 +150,22 @@ class Gateway:
     # ---------------------------------------------------- service tick
 
     def pump(self, arrivals: List[Tuple[bytes, str, float]], now: float,
-             backlog: float = 0.0,
+             backlog: Optional[float] = None,
              pool_p99_ms: Optional[float] = None) -> GatewayTick:
         """Serve one tick's arrivals ``[(envelope bytes, sender,
-        arrival time)]`` under the current pool pressure. Never raises
-        on sender-controlled input."""
+        arrival time)]`` under the current pool pressure. A driver that
+        measures pressure itself passes ``backlog``/``pool_p99_ms``;
+        left None, each is read live from the pool hubs (newest
+        ``TM.BACKLOG_DEPTH`` sample, p99 of the merged
+        ``TM.ORDERED_E2E_MS`` histograms). Never raises on
+        sender-controlled input."""
         tick = GatewayTick()
+        if backlog is None or pool_p99_ms is None:
+            live_backlog, live_p99 = self._live_pressure()
+            if backlog is None:
+                backlog = live_backlog
+            if pool_p99_ms is None:
+                pool_p99_ms = live_p99
         self.admission.observe(backlog, pool_p99_ms)
         tick.level = self.admission.level_name()
         self._tm.gauge(TM.GATEWAY_BACKLOG, backlog)
@@ -234,6 +249,31 @@ class Gateway:
             (msg, rec.client) for msg, rec in admitted)
 
     # ------------------------------------------------------- telemetry
+
+    def _live_pressure(self) -> Tuple[float, Optional[float]]:
+        """(backlog, ordered_p99_ms) read from the live pool hubs with
+        the same merge semantics ``merged_snapshot`` applies: the
+        newest ``BACKLOG_DEPTH`` gauge sample wins, ``ORDERED_E2E_MS``
+        histograms add before the quantile. No hub has recorded either
+        → (0.0, None), the pre-pressure defaults."""
+        from plenum_tpu.observability.telemetry import LogLinearHistogram
+        hubs = self._pool_hubs() if callable(self._pool_hubs) \
+            else self._pool_hubs
+        if not hubs:
+            hubs = (self._tm,)
+        backlog_ts, backlog = None, 0.0
+        scratch = None
+        for hub in hubs:
+            s = hub.gauge_sample(TM.BACKLOG_DEPTH)
+            if s is not None and (backlog_ts is None or s[0] >= backlog_ts):
+                backlog_ts, backlog = s
+            h = hub.histogram(TM.ORDERED_E2E_MS)
+            if h is not None:
+                if scratch is None:
+                    scratch = LogLinearHistogram()
+                scratch.merge(h)
+        p99 = scratch.quantile(0.99) if scratch is not None else None
+        return float(backlog), p99
 
     def _mark_done(self, rec: "_Rec", now: float) -> None:
         self._tm.observe(TM.GATEWAY_E2E_MS,

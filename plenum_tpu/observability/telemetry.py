@@ -139,6 +139,8 @@ SEAM_TRIE = "trie_jax"                # MPT level batch-axis buckets
 SEAM_MERKLE_APPEND = "merkle_append"  # per-level append buckets
 SEAM_MERKLE_BUILD = "merkle_build"    # pow2 capacity builds
 SEAM_BLS = "bls_jobs"                 # BLS job-axis identity padding
+SEAM_BLS_PAIR = "bls_pairing"         # pairing verify (job, pair) buckets
+SEAM_BLS_MSM = "bls_msm"              # windowed MSM point-axis buckets
 
 
 def _cfg(name: str, default):
@@ -482,6 +484,19 @@ class TelemetryHub:
             self._seam(seam).merge(stats)
         return self
 
+    def gauge_sample(self, name: str):
+        """The (timestamp, value) sample of a gauge, or None if it was
+        never set — read seam for live pressure consumers (the gateway
+        admission ladder) that must not pay a full snapshot per tick."""
+        with self._lock:
+            return self._gauges.get(name)
+
+    def histogram(self, name: str):
+        """The live histogram recorded under ``name`` (None if never
+        recorded). Read-only for callers: mergers fold it into their
+        own scratch histogram (LogLinearHistogram.merge is add-only)."""
+        return self._hists.get(name)
+
     def snapshot(self, buckets: bool = False) -> dict:
         """Node-local state dump. With ``buckets`` the histograms carry
         their sparse bucket arrays (what Prometheus exposition needs)."""
@@ -581,6 +596,12 @@ class NullTelemetryHub:
 
     def record_roundtrip(self, seam, ms, first_call=False) -> None:
         pass
+
+    def gauge_sample(self, name):
+        return None
+
+    def histogram(self, name):
+        return None
 
     def merge(self, other):
         return self

@@ -137,15 +137,28 @@ def _carry_par(c):
 
 
 def _carry_seq(x):
-    """Exact sequential carry chain (32 unrolled elementwise steps —
-    noise next to a mul's 2k multiplies). Handles negative limbs via
-    arithmetic shifts; the final value must fit 32 limbs nonnegative."""
-    cols = [x[..., i] for i in range(NLIMB)]
-    for k in range(NLIMB - 1):
-        cr = cols[k] >> RADIX
-        cols[k] = cols[k] - (cr << RADIX)
-        cols[k + 1] = cols[k + 1] + cr
-    return jnp.stack(cols, axis=-1)
+    """Exact sequential carry chain as a lax.scan over the limb axis.
+    Handles negative limbs via arithmetic shifts; the final value must
+    fit 32 limbs nonnegative.
+
+    This used to be 32 unrolled elementwise steps ("noise next to a
+    mul's 2k multiplies") — true for runtime, catastrophically false
+    for COMPILE time once the pairing tower landed: every _cond_sub a
+    bound-normalization inserts and every _redc tail carries one of
+    these, so the unrolled form put ~130 HLO ops at hundreds of sites
+    inside the Miller fori body (104 s XLA compile for the loop alone,
+    measured on CPU). The scan body is ~4 ops traced once per site;
+    same arithmetic, ~8x smaller module."""
+    xm = jnp.moveaxis(x, -1, 0)
+
+    def step(c, col):
+        t = col + c
+        cr = t >> RADIX
+        return cr, t - (cr << RADIX)
+
+    cr, cols = lax.scan(step, jnp.zeros_like(xm[0]), xm[:-1])
+    last = (xm[-1] + cr)[None]
+    return jnp.moveaxis(jnp.concatenate([cols, last], axis=0), 0, -1)
 
 
 def _cond_sub(v, const_l: np.ndarray):

@@ -1,0 +1,656 @@
+"""BLS12-381 tower-field arithmetic (Fp2/Fp6/Fp12) on the u32-limb
+Montgomery representation of ops/bls381_jax.py — the field layer under
+the device pairing kernels (ops/bls381_pairing.py).
+
+Layout
+------
+An Fq element is [..., 32] int32 limbs, radix 2^12, Montgomery domain
+(same as bls381_jax). The tower stacks coefficients on extra axes:
+
+ - Fp2  : [..., 2, 32]      (c0 + c1·u,  u^2 = -1)
+ - Fp6  : [..., 3, 2, 32]   (e0 + e1·v + e2·v^2,  v^3 = ξ = 1+u)
+ - Fp12 : [..., 12, 32]     (flattened [6, 2, 32]: fp2 slot s = 3i+j
+                             for coefficient c_i.e_j of c0 + c1·w,
+                             w^2 = v)
+
+The whole point of the stacking: one Fp12 multiply issues ONE
+`mont_mul` call over 18 Karatsuba fp2-lanes (= 54 Fq lanes), not 54
+separate 32-limb multiplies — `mont_mul` broadcasts over every leading
+axis, so the fold-matmul and the 32-step REDC amortize across lanes,
+batch and pair axes in a single fused HLO region. On the v5e that is
+the difference between a Miller loop that is VPU-bound and one that is
+dispatch-bound; on CPU it divides XLA compile time by the lane count.
+
+Bound-tracked relaxed arithmetic
+--------------------------------
+Karatsuba needs sums and differences BETWEEN multiplies, and a full
+carry-normalize (`_carry_seq`, 32 unrolled steps) after each one would
+cost as much as the multiply. Instead every traced value carries a
+static Python-side bound (units of q) in a `TV` wrapper:
+
+ - limbs stay in [0, 4099) (one parallel carry round after add/sub),
+ - value < bound·q with bound <= 8,
+ - `_norm` inserts conditional subtracts of 4q/2q/q exactly where a
+   consumer's precondition requires it (mont_mul inputs < 4q, subtract
+   operands < 2q, fp2 equality canonical).
+
+The bounds are Python floats resolved at TRACE time, so the inserted
+normalizations are deterministic per compiled shape — the device graph
+is branchless. `_rsub` avoids the sequential borrow chain entirely by
+adding a redundant-limb representation of 4q (`_SUBPAD`, every limb
+big enough to absorb any subtrahend limb) and doing one parallel carry
+round: 5 elementwise HLO ops instead of ~100.
+
+Correctness of the discipline rests on three checked facts (asserted
+at import against exact integer arithmetic):
+ 1. q/2^384 < 0.102, so mont_mul on inputs < 4q yields < 2.64q.
+ 2. A value < 8q has top limb <= 3330, so parallel-carry adds of
+    bound-sum <= 8 never overflow the (dropped-carry) top column.
+ 3. `_SUBPAD` limbs are >= 4098 below the top (every relaxed limb is
+    <= 4098) and its top limb dominates any < 2q subtrahend's.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from plenum_tpu.ops.bls381_jax import (
+    MASK, NLIMB, Q, RADIX, R_MONT,
+    _carry_par, _carry_seq, _cond_sub, _exp_bits, _geq, _int_to_limbs,
+    _HALF_P1_L, _ONE_M_L, _Q_L, _R2_L, _2Q_L,
+    fpow, limbs_to_int, mont_mul)
+
+# ---------------------------------------------------------------- constants
+
+_4Q_L = _int_to_limbs(4 * Q)
+# mont_mul output bound factor: out < (a·b)/2^384 + q for inputs a, b
+_QR = 0.102
+assert Q / R_MONT < _QR
+
+
+def _mont_l(v: int) -> np.ndarray:
+    return _int_to_limbs(v * R_MONT % Q)
+
+
+def _build_subpad() -> np.ndarray:
+    """4q in a redundant-limb form: limbs[i] >= 4098 for i < 31 (any
+    relaxed limb is <= 4098, so per-column x + pad - y never borrows)
+    and a top limb that still dominates a < 2q subtrahend's top limb.
+    Built by borrowing 2^12-units downward from the top."""
+    limbs = [int(v) for v in _int_to_limbs(4 * Q)]
+    for i in range(30, -1, -1):
+        while limbs[i] < 4100:
+            limbs[i] += 1 << RADIX
+            limbs[i + 1] -= 1
+    assert sum(l << (RADIX * i) for i, l in enumerate(limbs)) == 4 * Q
+    assert all(4098 <= l <= 4100 + MASK for l in limbs[:31])
+    # top limb must cover a < 2q subtrahend's top limb (<= 841) and
+    # keep the top COLUMN of x + pad - y under 2^12 for x < 4q
+    assert 850 <= limbs[31] <= 1700
+    return np.array(limbs, dtype=np.int32)
+
+
+_SUBPAD = _build_subpad()
+
+# top-limb ceiling per unit of q: value < b·q  =>  limb31 <= _TOPL·b
+_TOPL = (Q >> (RADIX * 31)) + 3
+assert _TOPL * 8 < (1 << RADIX)                      # _radd, bound-sum 8
+assert _TOPL * 4 + _SUBPAD[31] < (1 << RADIX)        # _rsub, x < 4q
+assert _SUBPAD[31] >= _TOPL * 2                      # _rsub, y < 2q
+
+
+class TV:
+    """A traced field value with a static magnitude bound (units of q).
+    `a` is the limb array ([..., 32] trailing); `b` the bound. Shapes
+    and bounds are Python-side, so all normalization decisions resolve
+    at trace time."""
+    __slots__ = ("a", "b")
+
+    def __init__(self, a, b: float):
+        assert b <= 8.0, f"tower value bound {b} exceeds 8q invariant"
+        self.a = a
+        self.b = b
+
+
+def _norm(x: TV, limit: float) -> TV:
+    """Conditionally subtract multiples of q until value < limit·q."""
+    a, b = x.a, x.b
+    while b > limit:
+        if b > 4.0:
+            a = _cond_sub(a, _4Q_L)
+            b = max(4.0, b - 4.0)
+        elif b > 2.0:
+            a = _cond_sub(a, _2Q_L)
+            b = max(2.0, b - 2.0)
+        else:
+            a = _cond_sub(a, _Q_L)
+            b = 1.0
+    return TV(a, b)
+
+
+def _radd(x: TV, y: TV) -> TV:
+    """Relaxed add: one parallel carry round (4 HLO ops). Inputs are
+    normalized so the bound-sum stays <= 8 (top-column safety)."""
+    while x.b + y.b > 8.0:
+        if x.b >= y.b:
+            x = _norm(x, 2.0)
+        else:
+            y = _norm(y, 2.0)
+    return TV(_carry_par(x.a + y.a), x.b + y.b)
+
+
+def _rsub(x: TV, y: TV) -> TV:
+    """Relaxed subtract via the borrow-proof 4q pad: x + 4q - y with
+    one parallel carry round. Needs y < 2q (limb-dominated by the pad)
+    and x < 4q (top-column safety); result < (x.b + 4)·q."""
+    x = _norm(x, 4.0)
+    y = _norm(y, 2.0)
+    return TV(_carry_par(x.a + jnp.asarray(_SUBPAD) - y.a), x.b + 4.0)
+
+
+def tmul(x: TV, y: TV) -> TV:
+    """Montgomery product with bound tracking; output < 2q."""
+    x = _norm(x, 4.0)
+    y = _norm(y, 4.0)
+    raw = TV(mont_mul(x.a, y.a), x.b * y.b * _QR + 1.0)
+    return _norm(raw, 2.0)
+
+
+def tneg(x: TV) -> TV:
+    return _rsub(TV(jnp.zeros_like(x.a), 0.0), x)
+
+
+def tcanon(x: TV):
+    """Exact canonical limbs in [0, q) — for equality/compare only."""
+    v = _norm(TV(_carry_seq(x.a), x.b), 2.0)
+    return _cond_sub(v.a, _Q_L)
+
+
+def _tstack(tvs: Sequence[TV], axis: int) -> TV:
+    return TV(jnp.stack([t.a for t in tvs], axis=axis),
+              max(t.b for t in tvs))
+
+
+def _tcat(tvs: Sequence[TV], axis: int) -> TV:
+    return TV(jnp.concatenate([t.a for t in tvs], axis=axis),
+              max(t.b for t in tvs))
+
+
+# ---------------------------------------------------------------- Fp2
+#
+# Value layout [..., 2, 32]; a lane axis for stacked multiplies sits at
+# -3 ([..., S, 2, 32]). ξ = 1 + u is the cubic/sextic non-residue.
+
+_ONE2_M = np.stack([_ONE_M_L, np.zeros(NLIMB, np.int32)])
+_NEG1_M = _mont_l(Q - 1)
+_B_TWIST_M = np.stack([_mont_l(4), _mont_l(4)])        # E': y^2=x^3+4(1+u)
+_B3_TWIST_M = np.stack([_mont_l(12), _mont_l(12)])
+_SQRT34_BITS = _exp_bits((Q - 3) // 4)
+_HALFQ_BITS = _exp_bits((Q - 1) // 2)
+
+
+def _c0(x: TV) -> TV:
+    return TV(x.a[..., 0, :], x.b)
+
+
+def _c1(x: TV) -> TV:
+    return TV(x.a[..., 1, :], x.b)
+
+
+def fp2_mul_many(x: TV, y: TV) -> TV:
+    """Karatsuba fp2 product over any stacked shape [..., 2, 32]:
+    exactly ONE mont_mul call on 3 stacked Fq lanes per fp2 lane."""
+    x = _norm(x, 2.0)
+    y = _norm(y, 2.0)
+    a0, a1 = _c0(x), _c1(x)
+    b0, b1 = _c0(y), _c1(y)
+    left = _tstack([a0, a1, _radd(a0, a1)], -2)
+    right = _tstack([b0, b1, _radd(b0, b1)], -2)
+    p = tmul(left, right)                       # [..., 3, 32]
+    t0, t1, tc = (TV(p.a[..., k, :], p.b) for k in range(3))
+    r0 = _rsub(t0, t1)                          # a0·b0 - a1·b1
+    r1 = _rsub(tc, _radd(t0, t1))               # cross - t0 - t1
+    return _tstack([r0, r1], -2)
+
+
+def fp2_mul(x: TV, y: TV) -> TV:
+    """fp2 product normalized back to loop-normal form (< 2q)."""
+    return _norm(fp2_mul_many(x, y), 2.0)
+
+
+def fp2_add(x: TV, y: TV) -> TV:
+    return _radd(x, y)
+
+
+def fp2_sub(x: TV, y: TV) -> TV:
+    return _rsub(x, y)
+
+
+def fp2_neg(x: TV) -> TV:
+    return tneg(x)
+
+
+def fp2_mul_xi(x: TV) -> TV:
+    """Multiply by ξ = 1 + u: (c0 - c1) + (c0 + c1)·u."""
+    x = _norm(x, 2.0)
+    a, b = _c0(x), _c1(x)
+    return _tstack([_rsub(a, b), _radd(a, b)], -2)
+
+
+def fp2_conj(x: TV) -> TV:
+    x = _norm(x, 2.0)
+    return _tstack([_c0(x), tneg(_c1(x))], -2)
+
+
+def fp2_canon(x: TV):
+    return tcanon(x)
+
+
+def fp2_eq(x: TV, y: TV):
+    return jnp.all(tcanon(x) == tcanon(y), axis=(-2, -1))
+
+
+def fp2_is_zero(x: TV):
+    return jnp.all(tcanon(x) == 0, axis=(-2, -1))
+
+
+def fp2_pow(x: TV, bits: np.ndarray) -> TV:
+    """x^e for a fixed msb-first public exponent; one fori_loop whose
+    body is two stacked fp2 multiplies (square + conditional mul)."""
+    x = _norm(x, 2.0)
+    bits_j = jnp.asarray(bits)
+    one = jnp.broadcast_to(jnp.asarray(_ONE2_M), x.a.shape)
+
+    def body(i, acc):
+        sq = fp2_mul(TV(acc, 2.0), TV(acc, 2.0))
+        m = fp2_mul(sq, TV(x.a, x.b))
+        return jnp.where(bits_j[i] == 1, m.a, sq.a)
+
+    return TV(lax.fori_loop(0, len(bits), body, one), 2.0)
+
+
+def fp2_inv(x: TV) -> TV:
+    """(c0 - c1·u) / (c0^2 + c1^2); the Fq inversion is a fixed
+    fpow(q-2) chain. Zero maps to zero (garbage-in tolerated: callers
+    gate on a validity mask, never on a trap)."""
+    x = _norm(x, 2.0)
+    a, b = _c0(x), _c1(x)
+    n = _norm(_radd(tmul(a, a), tmul(b, b)), 2.0)
+    ni = TV(fpow(n.a, _INV_BITS), 2.0)
+    return _tstack([tmul(a, ni), tneg(tmul(b, ni))], -2)
+
+
+_INV_BITS = _exp_bits(Q - 2)
+
+
+def fp2_sqrt(x: TV) -> Tuple[TV, jnp.ndarray]:
+    """Square root in Fp2 for q ≡ 3 (mod 4) (same algorithm as the
+    scalar reference `Fq2.sqrt`). Returns (root, ok[...]); ok is False
+    for non-residues (the root array is then garbage, masked off by
+    the caller). Cost: two fixed-exponent fp2 power loops."""
+    x = _norm(x, 2.0)
+    a1 = fp2_pow(x, _SQRT34_BITS)                    # x^((q-3)/4)
+    alpha = fp2_mul(fp2_mul(a1, a1), x)              # a1^2 · x
+    x0 = fp2_mul(a1, x)                              # a1 · x
+    # alpha == -1  ->  root is u·x0 = (-x0.c1, x0.c0)
+    neg1 = jnp.concatenate(
+        [jnp.broadcast_to(jnp.asarray(_NEG1_M), x0.a[..., :1, :].shape),
+         jnp.zeros_like(x0.a[..., :1, :])], axis=-2)
+    is_neg1 = fp2_eq(alpha, TV(neg1, 1.0))
+    ux0 = _norm(_tstack([tneg(_c1(x0)), _c0(x0)], -2), 2.0)
+    one2 = jnp.broadcast_to(jnp.asarray(_ONE2_M), x0.a.shape)
+    t = _radd(alpha, TV(one2, 1.0))
+    cand = fp2_mul(fp2_pow(t, _HALFQ_BITS), x0)
+    root = TV(jnp.where(is_neg1[..., None, None], ux0.a, cand.a), 2.0)
+    ok = fp2_eq(fp2_mul(root, root), x)
+    return root, ok
+
+
+# ---------------------------------------------------------------- Fp6
+#
+# Only the operations the inversion chain needs run at fp6 granularity
+# (one final-exp easy part per batch); the Miller-loop hot path goes
+# straight to the 18-lane fp12 multiply below.
+
+def _fp6c(x: TV, k: int) -> TV:
+    return TV(x.a[..., k, :, :], x.b)
+
+
+def fp6_mul_xi(x: TV) -> TV:
+    """v-multiplication: (e0, e1, e2) -> (ξ·e2, e0, e1)."""
+    x = _norm(x, 2.0)
+    return _tstack([_norm(fp2_mul_xi(_fp6c(x, 2)), 2.0),
+                    _fp6c(x, 0), _fp6c(x, 1)], -3)
+
+
+def fp6_mul(x: TV, y: TV) -> TV:
+    """Karatsuba fp6 product: one 6-lane stacked fp2 multiply."""
+    x = _norm(x, 2.0)
+    y = _norm(y, 2.0)
+    a = [_fp6c(x, k) for k in range(3)]
+    b = [_fp6c(y, k) for k in range(3)]
+    left = _tstack(a + [_radd(a[1], a[2]), _radd(a[0], a[1]),
+                        _radd(a[0], a[2])], -3)
+    right = _tstack(b + [_radd(b[1], b[2]), _radd(b[0], b[1]),
+                         _radd(b[0], b[2])], -3)
+    p = fp2_mul_many(left, right)               # [..., 6, 2, 32]
+    t0, t1, t2, s0, s1, s2 = (TV(p.a[..., k, :, :], p.b)
+                              for k in range(6))
+    c0 = _radd(_norm(fp2_mul_xi(_rsub(s0, _radd(t1, t2))), 2.0), t0)
+    c1 = _radd(_rsub(s1, _radd(t0, t1)), _norm(fp2_mul_xi(t2), 2.0))
+    c2 = _radd(_rsub(s2, _radd(t0, t2)), t1)
+    return _tstack([_norm(c0, 2.0), _norm(c1, 2.0), _norm(c2, 2.0)],
+                   -3)
+
+
+def fp6_inv(x: TV) -> TV:
+    """Reference `Fq6.inv` ported term for term."""
+    x = _norm(x, 2.0)
+    a0, a1, a2 = (_fp6c(x, k) for k in range(3))
+    t0 = _rsub(fp2_mul(a0, a0), fp2_mul_xi(fp2_mul(a1, a2)))
+    t1 = _rsub(fp2_mul_xi(fp2_mul(a2, a2)), fp2_mul(a0, a1))
+    t2 = _rsub(fp2_mul(a1, a1), fp2_mul(a0, a2))
+    den = fp2_add(
+        fp2_mul(a0, t0),
+        fp2_mul_xi(fp2_add(fp2_mul(a2, t1), fp2_mul(a1, t2))))
+    di = fp2_inv(_norm(den, 2.0))
+    return _tstack([fp2_mul(t0, di), fp2_mul(t1, di), fp2_mul(t2, di)],
+                   -3)
+
+
+# ---------------------------------------------------------------- Fp12
+#
+# Flat [..., 12, 32]; fp2 slot s = 3i + j holds coefficient c_i.e_j.
+# The w-power of slot s is k = i + 2j (w^2 = v, w^6 = ξ) — the order
+# the Frobenius constant table is laid out in.
+
+_ONE12_M = np.zeros((12, NLIMB), np.int32)
+_ONE12_M[0] = _ONE_M_L
+
+
+def _as6(x: TV) -> TV:
+    """[..., 12, 32] -> [..., 6, 2, 32] fp2-slot view."""
+    return TV(x.a.reshape(x.a.shape[:-2] + (6, 2, NLIMB)), x.b)
+
+
+def _as12(x: TV) -> TV:
+    return TV(x.a.reshape(x.a.shape[:-3] + (12, NLIMB)), x.b)
+
+
+def fp12_one(shape: Tuple[int, ...]) -> jnp.ndarray:
+    return jnp.broadcast_to(jnp.asarray(_ONE12_M),
+                            tuple(shape) + (12, NLIMB))
+
+
+def fp12_mul(x: TV, y: TV) -> TV:
+    """Full fp12 product as ONE 18-lane stacked fp2 multiply: three
+    Karatsuba fp6 products (c0·d0, c1·d1, (c0+c1)(d0+d1)), each itself
+    6 Karatsuba fp2 lanes, evaluated in a single mont_mul launch."""
+    xs = _norm(_as6(x), 2.0)
+    ys = _norm(_as6(y), 2.0)
+    lanes_l: List[TV] = []
+    lanes_r: List[TV] = []
+    for src, lanes in ((xs, lanes_l), (ys, lanes_r)):
+        h0 = TV(src.a[..., 0:3, :, :], src.b)
+        h1 = TV(src.a[..., 3:6, :, :], src.b)
+        hs = _radd(h0, h1)                       # fp6 half-sum
+        for g in (h0, h1, hs):
+            a = [TV(g.a[..., k, :, :], g.b) for k in range(3)]
+            lanes.append(_tstack(
+                a + [_radd(a[1], a[2]), _radd(a[0], a[1]),
+                     _radd(a[0], a[2])], -3))
+    left = _tcat(lanes_l, -3)                    # [..., 18, 2, 32]
+    right = _tcat(lanes_r, -3)
+    p = fp2_mul_many(left, right)
+    pg = TV(p.a.reshape(p.a.shape[:-3] + (3, 6, 2, NLIMB)), p.b)
+    # fp6 combine, vectorized over the 3 product groups
+    t0, t1, t2, s0, s1, s2 = (TV(pg.a[..., k, :, :], pg.b)
+                              for k in range(6))
+    c0 = _radd(_norm(fp2_mul_xi(_rsub(s0, _radd(t1, t2))), 2.0), t0)
+    c1 = _radd(_rsub(s1, _radd(t0, t1)), _norm(fp2_mul_xi(t2), 2.0))
+    c2 = _radd(_rsub(s2, _radd(t0, t2)), t1)
+    v = _tstack([_norm(c0, 2.0), _norm(c1, 2.0), _norm(c2, 2.0)], -3)
+    # v: [..., 3(group), 3(coeff), 2, 32] -> fp12 combine
+    v0, v1, v2 = (TV(v.a[..., g, :, :, :], v.b) for g in range(3))
+    r0 = _radd(v0, fp6_mul_xi(v1))               # c0·d0 + v·(c1·d1)
+    r1 = _rsub(v2, _radd(v0, v1))                # cross - both
+    out = _tcat([_norm(r0, 2.0), _norm(r1, 2.0)], -3)
+    return _as12(out)
+
+
+def fp12_sq(x: TV) -> TV:
+    return fp12_mul(x, x)
+
+
+def fp12_conj(x: TV) -> TV:
+    """x -> x^(q^6): negate the c1 (odd w-power) half."""
+    xs = _norm(_as6(x), 2.0)
+    h0 = TV(xs.a[..., 0:3, :, :], xs.b)
+    h1 = _norm(tneg(TV(xs.a[..., 3:6, :, :], xs.b)), 2.0)
+    return _as12(_tcat([h0, h1], -3))
+
+
+def fp12_inv(x: TV) -> TV:
+    """Reference `Fq12.inv`: (c0^2 - v·c1^2)^-1 through the fp6/fp2
+    inversion chain. One call per final exponentiation."""
+    xs = _norm(_as6(x), 2.0)
+    h0 = TV(xs.a[..., 0:3, :, :], xs.b)
+    h1 = TV(xs.a[..., 3:6, :, :], xs.b)
+    t = fp6_inv(_norm(
+        _rsub(fp6_mul(h0, h0), fp6_mul_xi(fp6_mul(h1, h1))), 2.0))
+    r0 = fp6_mul(h0, t)
+    r1 = _norm(tneg(fp6_mul(h1, t)), 2.0)
+    return _as12(_tcat([r0, r1], -3))
+
+
+def fp12_eq_one(x: TV):
+    """x == 1 (canonical compare), collapsing all coefficient axes."""
+    one = jnp.broadcast_to(jnp.asarray(_ONE12_M), x.a.shape)
+    return jnp.all(tcanon(x) == tcanon(TV(one, 1.0)), axis=(-2, -1))
+
+
+# Frobenius^2: w-power k picks up δ_k = ξ^(k(q^2-1)/6), which lands in
+# Fq (checked below), so the whole map is ONE stacked mont_mul by a
+# per-slot constant vector.
+
+def _fq2_pow_int(c0: int, c1: int, e: int) -> Tuple[int, int]:
+    r0, r1 = 1, 0
+    b0, b1 = c0 % Q, c1 % Q
+    while e:
+        if e & 1:
+            r0, r1 = (r0 * b0 - r1 * b1) % Q, (r0 * b1 + r1 * b0) % Q
+        b0, b1 = (b0 * b0 - b1 * b1) % Q, (2 * b0 * b1) % Q
+        e >>= 1
+    return r0, r1
+
+
+def _build_frob2() -> np.ndarray:
+    rows = np.zeros((12, NLIMB), np.int32)
+    for i in range(2):
+        for j in range(3):
+            k = i + 2 * j
+            d0, d1 = _fq2_pow_int(1, 1, k * (Q * Q - 1) // 6)
+            assert d1 == 0, "frobenius^2 delta not in Fq"
+            s = 3 * i + j
+            rows[2 * s] = rows[2 * s + 1] = _mont_l(d0)
+    return rows
+
+
+_FROB2_M = _build_frob2()
+
+
+def fp12_frob2(x: TV) -> TV:
+    return tmul(x, TV(jnp.asarray(_FROB2_M), 1.0))
+
+
+# ------------------------------------------------------ G2 decompress
+#
+# Affine decompression on the twist E'(Fp2): y^2 = x^3 + 4(1+u). The
+# Miller loop consumes affine (x, y), so no inversion is needed — the
+# sqrt IS the whole cost, two fixed-exponent fp2 power loops batched
+# over every point in the dispatch.
+
+def g2_decompress(c1_std, c0_std, sign_big, is_inf, valid_in):
+    """[..., 32] standard-domain x-coordinate limbs (c1/c0 halves,
+    both < q enforced host-side) + flag vectors -> ((x, y) Montgomery
+    fp2 TVs, valid[...]). Infinity rows carry garbage coordinates;
+    callers mask with is_inf."""
+    x_std = jnp.stack([c0_std, c1_std], axis=-2)
+    x = TV(mont_mul(x_std, jnp.broadcast_to(jnp.asarray(_R2_L),
+                                            x_std.shape)), 2.0)
+    yy = fp2_add(fp2_mul(fp2_mul(x, x), x),
+                 TV(jnp.broadcast_to(jnp.asarray(_B_TWIST_M),
+                                     x.a.shape), 1.0))
+    y, on_curve = fp2_sqrt(_norm(yy, 2.0))
+    # sign: lexicographic (c1, c0) compare against (q-1)/2, matching
+    # the byte-level convention of crypto.bls12_381.g2_compress
+    yc = tcanon(y)
+    y0_std = mont_mul(yc[..., 0, :],
+                      jnp.broadcast_to(jnp.asarray(
+                          _int_to_limbs(1)), yc[..., 0, :].shape))
+    y1_std = mont_mul(yc[..., 1, :],
+                      jnp.broadcast_to(jnp.asarray(
+                          _int_to_limbs(1)), yc[..., 1, :].shape))
+    y0c = _cond_sub(y0_std, _Q_L)
+    y1c = _cond_sub(y1_std, _Q_L)
+    c1_zero = jnp.all(y1c == 0, axis=-1)
+    got_big = jnp.where(c1_zero, _geq(y0c, _HALF_P1_L),
+                        _geq(y1c, _HALF_P1_L))
+    flip = got_big != sign_big
+    yn = _norm(fp2_neg(y), 2.0)
+    y = TV(jnp.where(flip[..., None, None], yn.a, y.a), 2.0)
+    valid = valid_in & (on_curve | is_inf)
+    return x, y, valid
+
+
+# --------------------------------------------- complete addition (RCB)
+#
+# One generic Renes-Costello-Batina complete-addition ladder rung,
+# parameterized over the base field so G1 ([..., 32] Fq lanes) and G2
+# on the twist ([..., 2, 32] fp2 lanes) share the formula. Each layer
+# of independent products is ONE stacked multiply.
+
+class _FqField:
+    lane_axis = -2
+    b3 = TV(jnp.asarray(_int_to_limbs(12 * R_MONT % Q)), 1.0)
+
+    @staticmethod
+    def mul_many(x, y):
+        return tmul(x, y)
+
+    @staticmethod
+    def lane(p, k):
+        return TV(p.a[..., k, :], p.b)
+
+
+class _Fp2Field:
+    lane_axis = -3
+    b3 = TV(jnp.asarray(_B3_TWIST_M), 1.0)
+
+    @staticmethod
+    def mul_many(x, y):
+        return fp2_mul_many(x, y)
+
+    @staticmethod
+    def lane(p, k):
+        return TV(p.a[..., k, :, :], p.b)
+
+
+def padd_rcb(P1, P2, field=_FqField):
+    """Complete addition (RCB 2016 Alg. 7, a=0, b3=12·(1 or 1+u)) in
+    three stacked-multiply layers: 6 + 2 + 6 lanes. P1/P2 are (X, Y,
+    Z) TV triples in the field's layout; identity is (0, 1, 0)."""
+    X1, Y1, Z1 = (_norm(c, 2.0) for c in P1)
+    X2, Y2, Z2 = (_norm(c, 2.0) for c in P2)
+    ax = field.lane_axis
+    l1 = _tstack([X1, Y1, Z1, _radd(X1, Y1), _radd(Y1, Z1),
+                  _radd(X1, Z1)], ax)
+    r1 = _tstack([X2, Y2, Z2, _radd(X2, Y2), _radd(Y2, Z2),
+                  _radd(X2, Z2)], ax)
+    p = field.mul_many(l1, r1)
+    t0, t1, t2, t3l, t4l, xl = (field.lane(p, k) for k in range(6))
+    t3 = _rsub(t3l, _radd(t0, t1))               # X1Y2 + X2Y1
+    t4 = _rsub(t4l, _radd(t1, t2))               # Y1Z2 + Y2Z1
+    y3 = _rsub(xl, _radd(t0, t2))                # X1Z2 + X2Z1
+    t0_3 = _radd(_radd(t0, t0), t0)              # 3·t0
+    b3b = TV(jnp.broadcast_to(
+        field.b3.a, _norm(t2, 2.0).a.shape), field.b3.b)
+    p2 = field.mul_many(_tstack([_norm(t2, 2.0), _norm(y3, 2.0)], ax),
+                        _tstack([b3b, b3b], ax))
+    b3t2, y3m = field.lane(p2, 0), field.lane(p2, 1)
+    z3 = _radd(t1, b3t2)
+    t1m = _rsub(t1, b3t2)
+    l3 = _tstack([_norm(t4, 2.0), _norm(t3, 2.0), _norm(y3m, 2.0),
+                  _norm(t1m, 2.0), _norm(z3, 2.0), _norm(t0_3, 2.0)],
+                 ax)
+    r3 = _tstack([_norm(y3m, 2.0), _norm(t1m, 2.0), _norm(t0_3, 2.0),
+                  _norm(z3, 2.0), _norm(t4, 2.0), _norm(t3, 2.0)], ax)
+    q = field.mul_many(l3, r3)
+    q0, q1, q2, q3, q4, q5 = (field.lane(q, k) for k in range(6))
+    X3 = _norm(_rsub(q1, q0), 2.0)               # t3·t1m - t4·y3m
+    Y3 = _norm(_radd(q2, q3), 2.0)               # y3m·t0_3 + t1m·z3
+    Z3 = _norm(_radd(q4, q5), 2.0)               # z3·t4 + t0_3·t3
+    return X3, Y3, Z3
+
+
+def g2_identity(shape: Tuple[int, ...]):
+    """Projective identity (0 : 1 : 0) on the twist, Montgomery fp2."""
+    z = jnp.zeros(tuple(shape) + (2, NLIMB), dtype=jnp.int32)
+    one = jnp.broadcast_to(jnp.asarray(_ONE2_M),
+                           tuple(shape) + (2, NLIMB))
+    return TV(z, 1.0), TV(one, 1.0), TV(z, 1.0)
+
+
+# ------------------------------------------------- host byte plumbing
+
+def _be48_to_limbs(body: np.ndarray) -> np.ndarray:
+    """[N, 48] big-endian bytes (flags already masked) -> [N, 32]
+    limbs; vectorized (3 bytes = 2 limbs), no Python bigints."""
+    N = body.shape[0]
+    le = body[:, ::-1].astype(np.int32)
+    groups = le.reshape(N, 16, 3)
+    v24 = groups[:, :, 0] + (groups[:, :, 1] << 8) \
+        + (groups[:, :, 2] << 16)
+    limbs = np.empty((N, NLIMB), dtype=np.int32)
+    limbs[:, 0::2] = v24 & MASK
+    limbs[:, 1::2] = v24 >> RADIX
+    return limbs
+
+
+def _limbs_lt_q(limbs: np.ndarray) -> np.ndarray:
+    lt = np.zeros(limbs.shape[0], dtype=bool)
+    decided = np.zeros(limbs.shape[0], dtype=bool)
+    for i in range(NLIMB - 1, -1, -1):
+        qi = int(_Q_L[i])
+        lt |= (~decided) & (limbs[:, i] < qi)
+        decided |= limbs[:, i] != qi
+    return lt
+
+
+def pack_g2_compressed(raws: np.ndarray):
+    """[N, 96] uint8 big-endian compressed G2 -> (c1 limbs [N, 32],
+    c0 limbs [N, 32], sign_big [N], is_inf [N], valid [N]). Mirrors
+    `pack_compressed` for the 96-byte two-coordinate encoding: flags
+    ride the first byte of the c1 half."""
+    raws = np.asarray(raws, dtype=np.uint8)
+    N = raws.shape[0]
+    flags = raws[:, 0]
+    compressed = (flags & 0x80) != 0
+    is_inf = (flags & 0x40) != 0
+    sign_big = (flags & 0x20) != 0
+    b1 = raws[:, :48].copy()
+    b1[:, 0] &= 0x1F
+    c1 = _be48_to_limbs(b1)
+    c0 = _be48_to_limbs(raws[:, 48:])
+    inf_ok = is_inf & (flags == 0xC0) & ~np.any(raws[:, 1:], axis=1)
+    valid = compressed & (inf_ok
+                          | (~is_inf & _limbs_lt_q(c1)
+                             & _limbs_lt_q(c0)))
+    bad = ~valid | is_inf
+    c1[bad] = 0
+    c0[bad] = 0
+    return c1, c0, sign_big & ~is_inf, is_inf & valid, valid

@@ -126,6 +126,23 @@ def pallas_backend_enabled(env_var: str) -> bool:
     return state
 
 
+def xla_backend_enabled(env_var: str) -> bool:
+    """Availability gate for device kernels written as plain XLA (the
+    bls381 pairing/MSM path): these run on ANY backend — CPU included —
+    so, unlike ``pallas_backend_enabled``, no accelerator is required.
+    Enabled unless the kernel's env var pins the native/scalar path
+    (``"native"``/``"off"``) or a runtime failure stepped it down
+    (``disable_pallas_backend`` — same registry, same permanence)."""
+    with _PROBE_LOCK:
+        state = _PALLAS_BACKENDS.get(env_var)
+    if state is None:
+        state = os.environ.get(env_var, "").lower() \
+            not in ("native", "off", "0")
+        with _PROBE_LOCK:
+            state = _PALLAS_BACKENDS.setdefault(env_var, state)
+    return state
+
+
 def disable_pallas_backend(env_var: str) -> None:
     """Permanent step-down for one kernel family — the fallback engine
     (ops/ed25519_jax._dispatch_kernel, ops/sha256 routing) calls this

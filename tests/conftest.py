@@ -12,6 +12,11 @@ if "xla_force_host_platform_device_count" not in flags:
 # the suite exists to exercise the sharded code paths, so force them.
 # Env (not Config) so node subprocesses spawned by e2e tests inherit it.
 os.environ.setdefault("PLENUM_TPU_MESH_CPU_SHARD", "1")
+# device BLS pairing stays OFF suite-wide: any consensus/client test
+# with >= BLS_PAIRING_DEVICE_MIN checks would otherwise pay a Miller
+# kernel compile mid-test. The dedicated tests (test_bls381_pairing.py)
+# force-enable the family through the mesh step-down registry.
+os.environ.setdefault("PLENUM_TPU_BLS_TOWER", "native")
 
 import pytest  # noqa: E402
 

@@ -199,3 +199,77 @@ def test_host_ms_tripwire_covers_execute_stage():
     # both stages clean -> silent
     flags = bench.host_ms_regression_flags(0.00001, 0.00001)
     assert flags["warn"] is None
+
+
+# ------------------------------------------------ bls regression gate
+# (ISSUE 17: device pairing verify must be measured with verdict
+# parity asserted, and the scalar money path must hold its floor;
+# same gate-of-the-gate contract as the merkle gate above)
+
+
+def _bls_ok():
+    return {"by_n": {"100": {"verify_per_s": 120.0}},
+            "device_pairing": {"bls_verifies_per_s": 0.5,
+                               "parity_ok": True}}
+
+
+def test_bls_gate_passes_on_healthy_run():
+    bench = _gate()
+    assert bench.bls_regression_gate(_bls_ok()) == []
+
+
+def test_bls_gate_fails_on_missing_device_measurement():
+    bench = _gate()
+    res = _bls_ok()
+    del res["device_pairing"]
+    assert any("device_pairing missing" in f
+               for f in bench.bls_regression_gate(res))
+    res = _bls_ok()
+    del res["device_pairing"]["bls_verifies_per_s"]
+    assert any("bls_verifies_per_s" in f
+               for f in bench.bls_regression_gate(res))
+    res = _bls_ok()
+    res["device_pairing"] = {"skipped": "jax missing",
+                             "jobs_per_launch": 8}
+    assert any("skipped" in f for f in bench.bls_regression_gate(res))
+
+
+def test_bls_gate_fails_on_verdict_divergence():
+    """parity_ok False (or absent) means the device kernel disagreed
+    with the scalar backend — a fast wrong kernel must never pass."""
+    bench = _gate()
+    res = _bls_ok()
+    res["device_pairing"]["parity_ok"] = False
+    assert any("parity_ok" in f for f in bench.bls_regression_gate(res))
+    del res["device_pairing"]["parity_ok"]
+    assert bench.bls_regression_gate(res) != []
+
+
+def test_bls_gate_fails_under_scalar_floor():
+    bench = _gate()
+    res = _bls_ok()
+    res["by_n"]["100"]["verify_per_s"] = 24.9
+    failures = bench.bls_regression_gate(res)
+    assert any("verify_per_s 24.9 < required" in f for f in failures)
+    res["by_n"] = {}
+    assert any("by_n.100.verify_per_s missing" in f
+               for f in bench.bls_regression_gate(res))
+    assert bench.bls_regression_gate(None) \
+        == ["micro_bls produced no result dict"]
+
+
+def test_bls_gate_warn_override_honored(monkeypatch):
+    bench = _gate()
+    monkeypatch.delenv("BENCH_BLS_GATE", raising=False)
+    assert bench.gate_enforced("BENCH_BLS_GATE")
+    monkeypatch.setenv("BENCH_BLS_GATE", "warn")
+    assert not bench.gate_enforced("BENCH_BLS_GATE")
+
+
+def test_bls_gate_floor_is_sane():
+    """The floor must stay an honest fraction of what prior rounds
+    measured (120-360/s native) — high enough to catch a silent
+    pure-Python fallback (~0.5/s), low enough not to flap on slow
+    containers."""
+    bench = _gate()
+    assert 1.0 <= bench.BLS_VERIFY_FLOOR <= 60.0

@@ -261,6 +261,71 @@ def test_admission_ladder_degrades_reads_first_with_hysteresis():
                              "ordered_p99_ms": 100.0}
 
 
+def test_pump_reads_live_pressure_from_pool_hubs():
+    """With no driver-measured backlog/p99, pump() self-sources
+    pressure from the pool hubs with the merged-snapshot semantics:
+    newest BACKLOG_DEPTH gauge wins across hubs, ORDERED_E2E_MS
+    histograms add before the p99 — so admission escalates off REAL
+    recorded node state, not arguments."""
+    t = [0.0]
+    hub1 = TelemetryHub(name="n1", clock=lambda: t[0])
+    hub2 = TelemetryHub(name="n2", clock=lambda: t[0])
+    conf = Config(GATEWAY_BACKLOG_HIGH=100, GATEWAY_BACKLOG_LOW=50,
+                  GATEWAY_BACKLOG_HARD=1000, GATEWAY_P99_HIGH_MS=400.0,
+                  GATEWAY_P99_LOW_MS=200.0, GATEWAY_P99_HARD_MS=1200.0)
+    gw = Gateway(forward_writes=lambda env: None, config=conf,
+                 pool_hubs=lambda: [hub1, hub2])
+    # nothing recorded anywhere: the pre-pressure defaults
+    assert gw.pump([], now=0.0).level == "admit_all"
+    # one node publishes a deep backlog gauge -> reads degrade
+    t[0] = 1.0
+    hub1.gauge(TM.BACKLOG_DEPTH, 150)
+    assert gw.pump([], now=1.0).level == "shed_reads"
+    assert gw.admission.snapshot()["backlog"] == 150.0
+    # NEWEST sample wins across hubs: another node reports the queue
+    # drained, and recovery steps down (p99 still unrecorded)
+    t[0] = 2.0
+    hub2.gauge(TM.BACKLOG_DEPTH, 5)
+    assert gw.pump([], now=2.0).level == "admit_all"
+    # merged e2e histograms: a slow tail on ONE node moves the pool p99
+    for _ in range(50):
+        hub1.observe(TM.ORDERED_E2E_MS, 500.0)
+    tick = gw.pump([], now=3.0)
+    assert tick.level == "shed_reads"
+    p99 = gw.admission.snapshot()["ordered_p99_ms"]
+    assert p99 is not None and p99 >= 400.0
+    # hard backlog mark from the gauge sheds writes from any level
+    t[0] = 3.0
+    hub1.gauge(TM.BACKLOG_DEPTH, 5000)
+    assert gw.pump([], now=4.0).level == "shed_writes"
+    # a driver-measured signal overrides the live read (per argument)
+    gw.pump([], now=5.0, backlog=0.0, pool_p99_ms=0.0)
+    assert gw.admission.snapshot() == {"level": "shed_reads",
+                                       "backlog": 0.0,
+                                       "ordered_p99_ms": 0.0}
+    # ...and a partial override still live-sources the other signal
+    gw.pump([], now=6.0, backlog=0.0)
+    assert gw.admission.snapshot()["ordered_p99_ms"] == pytest.approx(
+        p99)
+
+
+def test_pump_live_pressure_defaults_to_own_hub():
+    """No pool_hubs wired -> the gateway's own hub is the source, and
+    a hub-less gateway (NullTelemetryHub) stays at the pre-pressure
+    defaults forever."""
+    tm = TelemetryHub(name="gw")
+    conf = Config(GATEWAY_BACKLOG_HIGH=100, GATEWAY_BACKLOG_LOW=50,
+                  GATEWAY_BACKLOG_HARD=1000)
+    gw = Gateway(forward_writes=lambda env: None, config=conf,
+                 telemetry=tm)
+    assert gw.pump([], now=0.0).level == "admit_all"
+    tm.gauge(TM.BACKLOG_DEPTH, 2000)
+    assert gw.pump([], now=1.0).level == "shed_writes"
+    bare = Gateway(forward_writes=lambda env: None, config=conf)
+    assert bare.pump([], now=0.0).level == "admit_all"
+    assert bare.admission.snapshot()["ordered_p99_ms"] is None
+
+
 # --------------------------------------------------- signed-read cache
 
 

@@ -1331,6 +1331,92 @@ def test_pt014_param_passthrough_lifts_to_caller(tmp_path):
     assert "compress" in findings[0].message
 
 
+def test_pt013_covers_bls_pairing_and_msm_seam_names(tmp_path):
+    """ISSUE 17: the device pairing/MSM seams (ops/bls381_pairing) use
+    the X_dispatch/X_collect name shape — a pairing handle dropped on
+    the floor or fired-and-forgotten must flag, while the collect,
+    store-on-self and cross-function handoff shapes stay clean."""
+    bad = """
+        from plenum_tpu.ops.bls381_pairing import (
+            msm_dispatch, pairing_dispatch)
+
+        def check_batch(jobs):
+            handles = pairing_dispatch(jobs, 2)
+            return len(jobs)
+
+        def msm_fire(points, scalars):
+            msm_dispatch(points, scalars)
+    """
+    findings = check_program("PT013", {
+        "plenum_tpu/crypto/bls_router.py": bad}, tmp_path)
+    assert len(findings) == 2
+    assert {f.symbol for f in findings} == {"check_batch", "msm_fire"}
+
+    good = """
+        from plenum_tpu.ops.bls381_pairing import (
+            msm_collect, msm_dispatch, pairing_collect,
+            pairing_dispatch)
+
+        def check_batch(jobs):
+            return pairing_collect(pairing_dispatch(jobs, 2))
+
+        def msm_start(self, points, scalars):
+            self._inflight = msm_dispatch(points, scalars)
+
+        def msm_handoff(points, scalars):
+            return msm_dispatch(points, scalars)
+
+        def msm_run(points, scalars):
+            return msm_collect(msm_handoff(points, scalars))
+    """
+    assert check_program("PT013", {
+        "plenum_tpu/crypto/bls_router.py": good}, tmp_path) == []
+
+
+def test_pt014_covers_bls_pairing_bucket_obligation(tmp_path):
+    """ISSUE 17: a pairing dispatch shaping its job axis from raw
+    len(jobs) — one Miller-loop compile per distinct batch size — must
+    flag; the pow2 bucket the real seam uses stays clean."""
+    bad = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def _pairing_kernel(rows):
+            return rows
+
+        def pairing_dispatch(jobs, n_pairs):
+            arr = np.zeros((len(jobs), n_pairs, 48), dtype=np.uint8)
+            return _pairing_kernel(jnp.asarray(arr))
+    """
+    findings = check_program("PT014", {
+        "plenum_tpu/ops/bls381_pairing.py": bad}, tmp_path)
+    assert len(findings) == 1
+    assert findings[0].symbol == "pairing_dispatch"
+    assert "_pairing_kernel" in findings[0].message
+
+    good = """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from plenum_tpu.ops import pow2_at_least
+
+        @jax.jit
+        def _pairing_kernel(rows):
+            return rows
+
+        def pairing_dispatch(jobs, n_pairs):
+            bp = pow2_at_least(len(jobs))
+            pp = pow2_at_least(n_pairs)
+            arr = np.zeros((bp, pp, 48), dtype=np.uint8)
+            return _pairing_kernel(jnp.asarray(arr))
+    """
+    assert check_program("PT014", {
+        "plenum_tpu/ops/bls381_pairing.py": good}, tmp_path) == []
+
+
 def test_pt012_to_pt014_report_through_baseline(tmp_path):
     """Program-rule findings ride the ordinary baseline machinery."""
     for rel, src in {
