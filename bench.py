@@ -485,7 +485,7 @@ def _drive_mp_client(base_dir, reqs, procs):
 
 
 def run_pool(reqs, verifier_name, tracing=False, return_nodes=False,
-             telemetry=True):
+             telemetry=True, extra_conf=None):
     """→ (elapsed_wall_seconds, ordered_count) for ordering all reqs
     (+ the pool's nodes when return_nodes — the traced run hands its
     ring buffers to the per-stage budget aggregation).
@@ -497,7 +497,8 @@ def run_pool(reqs, verifier_name, tracing=False, return_nodes=False,
     dispatch/conclude split the Node's intake API exposes for the
     production prod loop."""
     nodes, timer = make_sim_pool(NAMES, verifier_name, tracing=tracing,
-                                 telemetry=telemetry)
+                                 telemetry=telemetry,
+                                 extra_conf=extra_conf)
 
     target = len(reqs)
     t0 = time.perf_counter()
@@ -666,6 +667,146 @@ def telemetry_overhead_gate(result, ceiling=None):
         return ["telemetry_overhead_pct %.2f >= allowed %.2f"
                 % (value, ceiling)]
     return []
+
+
+def trace_context_overhead():
+    """Journey-plane stamp overhead gate: the IDENTICAL traced 4-node
+    pool + ordering workload with wire trace context ON vs OFF — the
+    telemetry_overhead methodology (CPU verifier both sides,
+    interleaved best-of-2). BOTH sides run with the flight recorder on,
+    so the delta isolates exactly what the journey plane adds: stamp
+    encode on every envelope flush, stamp decode + wire_send/wire_recv
+    instants, and the quorum-close vote attribution. The ON side's ring
+    buffers also yield the journey report itself (complete-request
+    count + causal check), proving the measured configuration actually
+    produces journeys."""
+    from plenum_tpu.crypto.signer import SimpleSigner
+    from plenum_tpu.observability.export import pool_tracers
+    from plenum_tpu.observability.journey import (
+        causal_violations, journeys_from_tracers)
+
+    n = int(os.environ.get("BENCH_TRACE_CTX_REQS",
+                           str(min(POOL_REQS, 2000))))
+    rounds = int(os.environ.get("BENCH_TRACE_CTX_ROUNDS", "3"))
+    reqs = make_requests(n, SimpleSigner(seed=b"\x54" * 32))
+    off_runs, on_runs = [], []
+    on_nodes = None
+    # the stamp cost itself is tiny (a few hundred clock samples +
+    # instants per thousand ordered requests), so host jitter dominates
+    # a 2-round A/B — interleave MORE rounds than the other overhead
+    # configs and alternate which side goes first so slow load drift
+    # cancels instead of landing on one side
+    for i in range(max(2, rounds)):
+        def run_off():
+            off_runs.append(run_pool(
+                reqs, "cpu", tracing=True,
+                extra_conf={"TRACE_CONTEXT_ENABLED": False}))
+
+        def run_on():
+            nonlocal on_nodes
+            on_elapsed_i, on_ordered_i, on_nodes = run_pool(
+                reqs, "cpu", tracing=True, return_nodes=True,
+                extra_conf={"TRACE_CONTEXT_ENABLED": True})
+            on_runs.append((on_elapsed_i, on_ordered_i))
+
+        first, second = (run_off, run_on) if i % 2 == 0 \
+            else (run_on, run_off)
+        first()
+        second()
+    off_elapsed, off_ordered = best_of_runs(off_runs, n, "trace-ctx-off")
+    on_elapsed, on_ordered = best_of_runs(on_runs, n, "trace-ctx-on")
+    off_rate = off_ordered / off_elapsed
+    on_rate = on_ordered / on_elapsed
+    report = journeys_from_tracers(pool_tracers(on_nodes or []))
+    return {
+        "reqs": n,
+        "stamped_req_per_s": round(on_rate, 1),
+        "unstamped_req_per_s": round(off_rate, 1),
+        "overhead_pct": round(100.0 * (1.0 - on_rate / off_rate), 2),
+        "journey_requests": len(report.get("requests") or {}),
+        "journey_complete": report.get("complete_requests", 0),
+        "causal_violations": len(causal_violations(report)),
+        "critical_path": report.get("breakdown"),
+    }
+
+
+# the journey plane's hard ceiling, same bar as the telemetry plane:
+# wire stamps must cost less than this on the identical-pool A/B
+TRACE_CONTEXT_OVERHEAD_MAX_PCT = 2.0
+
+
+def trace_context_overhead_gate(result, ceiling=None):
+    """HARD gate for the wire trace-context claim (mirrors
+    telemetry_overhead_gate; tier-1 gates the gate in
+    tests/test_bench_gate.py): the measured on/off overhead must stay
+    under TRACE_CONTEXT_OVERHEAD_MAX_PCT, and the ON side must have
+    produced complete, causally ordered journeys — a "free" stamp
+    nobody can join is not a feature. → list of failures;
+    BENCH_TRACE_CTX_GATE=warn downgrades main() to warn-only."""
+    ceiling = TRACE_CONTEXT_OVERHEAD_MAX_PCT if ceiling is None \
+        else ceiling
+    failures = []
+    value = result.get("overhead_pct")
+    if value is None:
+        failures.append("overhead_pct missing from trace_context_overhead")
+    elif value >= ceiling:
+        failures.append("trace_context_overhead_pct %.2f >= allowed %.2f"
+                        % (value, ceiling))
+    if not result.get("journey_complete"):
+        failures.append("trace-context ON side produced no complete "
+                        "journey records")
+    if result.get("causal_violations"):
+        failures.append("%d causally inconsistent journey record(s)"
+                        % result["causal_violations"])
+    return failures
+
+
+def pool25_journey():
+    """25-node traced journey pass: the critical-path breakdown at the
+    backlog config's scale — where does an ordered request's wall time
+    go across a 25-node pool (wire vs straggler-wait vs local stages)?
+    A bounded write-only pass (BENCH_P25J_REQS) with the flight
+    recorder + wire trace context on; reported next to pool25_backlog
+    (whose throughput numbers stay untraced and comparable across
+    rounds)."""
+    from plenum_tpu.crypto.signer import SimpleSigner
+    from plenum_tpu.observability.export import pool_tracers
+    from plenum_tpu.observability.journey import (
+        causal_violations, journeys_from_tracers)
+
+    n_nodes = int(os.environ.get("BENCH_P25J_NODES", "25"))
+    n = int(os.environ.get("BENCH_P25J_REQS", "1000"))
+    batch = int(os.environ.get("BENCH_P25J_BATCH", "250"))
+    names = ["N%02d" % i for i in range(n_nodes)]
+    nodes, timer = make_sim_pool(
+        names, "cpu", seed=26, batch=batch, tracing=True,
+        extra_conf={"TRACE_CONTEXT_ENABLED": True})
+    reqs = make_requests(n, SimpleSigner(seed=b"\x55" * 32))
+    chunks = [reqs[i:i + batch] for i in range(0, n, batch)]
+    t0 = time.perf_counter()
+    pipelined_intake(nodes, timer, chunks, client_id="p25j-client")
+    deadline = time.perf_counter() + 300
+    while time.perf_counter() < deadline:
+        for nd in nodes:
+            nd.service()
+        timer.run_for(0.01)
+        if all(nd.domain_ledger.size >= n for nd in nodes):
+            break
+    elapsed = time.perf_counter() - t0
+    ordered = min(nd.domain_ledger.size for nd in nodes)
+    report = journeys_from_tracers(pool_tracers(nodes))
+    return {
+        "nodes": n_nodes,
+        "reqs": n,
+        "ordered": ordered,
+        "req_per_s": round(ordered / elapsed, 1) if elapsed else None,
+        "journey_requests": len(report.get("requests") or {}),
+        "journey_complete": report.get("complete_requests", 0),
+        "causal_violations": len(causal_violations(report)),
+        # wire vs straggler-wait vs local stages as pct of ordered e2e,
+        # averaged over every batch's critical path
+        "critical_path": report.get("breakdown"),
+    }
 
 
 def micro_ed25519():
@@ -2502,6 +2643,8 @@ def main():
     wire_ab = wire_flat_ab()
     telemetry = telemetry_overhead()
     telemetry_gate_failures = telemetry_overhead_gate(telemetry)
+    trace_ctx = trace_context_overhead()
+    trace_ctx_gate_failures = trace_context_overhead_gate(trace_ctx)
     recovery = bench_recovery()
 
     (device_rate, device_rate_median, ed_single_shot, ed_single_shot_med,
@@ -2515,6 +2658,7 @@ def main():
     state_res = micro_state()
     exec_res = micro_executor()
     p25 = pool25_both()
+    p25_journey = pool25_journey()
     gw = gateway_open_loop()
     gw_gate_failures = gateway_gate(gw)
 
@@ -2562,11 +2706,13 @@ def main():
             "state": state_res,
             "executor": exec_res,
             "pool25_backlog": p25,
+            "pool25_journey": p25_journey,
             "gateway": gw,
             "tracing_overhead": tracing,
             "host_ms_regression": host_ms_regression,
             "wire_flat_ab": wire_ab,
             "telemetry_overhead": telemetry,
+            "trace_context_overhead": trace_ctx,
             "recovery": recovery,
         },
     }))
@@ -2665,6 +2811,22 @@ def main():
             "telemetry_overhead_pct": telemetry["overhead_pct"],
             "telemetry_gate_ok": not telemetry_gate_failures,
             "telemetry_gate_failures": telemetry_gate_failures or None,
+            # journey plane: wire-stamp A/B cost (hard-gated <2%) and
+            # the 25-node critical-path attribution — wire / straggler
+            # / local shares of ordered e2e (pool25_journey config)
+            "trace_context_overhead_pct": trace_ctx["overhead_pct"],
+            "trace_context_gate_ok": not trace_ctx_gate_failures,
+            "trace_context_gate_failures":
+                trace_ctx_gate_failures or None,
+            "critical_path_wire_pct": (p25_journey.get("critical_path")
+                                       or {}).get("wire_pct"),
+            "critical_path_straggler_pct": (
+                p25_journey.get("critical_path") or {}).get(
+                    "straggler_pct"),
+            "critical_path_local_pct": (p25_journey.get("critical_path")
+                                        or {}).get("local_pct"),
+            "critical_path_e2e_ms": (p25_journey.get("critical_path")
+                                     or {}).get("e2e_ms_mean"),
             "mesh_devices": mesh_res["devices"],
             "mesh_overhead_pct": mesh_res.get(
                 "single_device_overhead_pct"),
@@ -2686,6 +2848,11 @@ def main():
             and os.environ.get("BENCH_TELEMETRY_GATE") != "warn":
         print("TELEMETRY OVERHEAD GATE FAILED: "
               + "; ".join(telemetry_gate_failures), file=sys.stderr)
+        sys.exit(2)
+    if trace_ctx_gate_failures \
+            and os.environ.get("BENCH_TRACE_CTX_GATE") != "warn":
+        print("TRACE CONTEXT OVERHEAD GATE FAILED: "
+              + "; ".join(trace_ctx_gate_failures), file=sys.stderr)
         sys.exit(2)
     if gw_gate_failures and gate_enforced("BENCH_GATEWAY_GATE"):
         print("GATEWAY GATE FAILED: "

@@ -35,6 +35,8 @@ from plenum_tpu.analysis.rules.pt013_dispatch_collect import (
     DispatchWithoutCollectRule)
 from plenum_tpu.analysis.rules.pt014_compile_cardinality import (
     CompileCardinalityRule)
+from plenum_tpu.analysis.rules.pt015_trace_taint import (
+    TraceContextTaintRule)
 
 RULE_CLASSES = (
     BlockingCallRule,
@@ -51,6 +53,7 @@ RULE_CLASSES = (
     NondeterminismRule,
     DispatchWithoutCollectRule,
     CompileCardinalityRule,
+    TraceContextTaintRule,
 )
 
 

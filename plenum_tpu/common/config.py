@@ -356,6 +356,20 @@ class Config:
     TRACING_ENABLED = False
     TRACING_BUFFER_SPANS = 1 << 16   # ring slots per node; newest kept
 
+    # ---- journey plane (observability/journey.py): wire-carried trace
+    # context. When on, flat envelopes ride as version 2 with an
+    # advisory TRACE section (origin node, flush seq, perf+wall send
+    # timestamps; ≤89 payload bytes) and the typed THREE_PC_BATCH /
+    # PROPAGATE_BATCH fallback carries the same stamp in a nullable
+    # traceCtx field, so receivers can join per-node tracer buffers
+    # into per-request cross-node journeys. Purely advisory: stamps are
+    # decoded outside the consensus sections (plenum-lint PT015 pins
+    # unreachability), malformed stamps degrade to None without
+    # touching message handling, and bench.py trace_context_overhead
+    # hard-gates the on/off A/B under 2%. Follows TRACING_ENABLED —
+    # stamps without tracer buffers join nothing.
+    TRACE_CONTEXT_ENABLED = True
+
     # ---- telemetry plane (observability/telemetry.py): always-on
     # latency histograms (p50/p95/p99/p999 on the ordered money path),
     # device-efficiency lane accounting at every bucket-padding

@@ -103,6 +103,10 @@ class PropagateBatch(MessageBase):
         ("requests", IterableField(AnyMapField(), min_length=1)),
         # "" = submitting client unknown (relay hop)
         ("clients", IterableField(AnyField())),
+        # advisory causal stamp [origin, flush_seq, perf_ts, wall_ts]
+        # (flat_wire.TraceStamp.as_list) — observability-only; malformed
+        # content decodes to None and never affects request handling
+        ("traceCtx", AnyField(nullable=True, optional=True)),
     )
 
 
@@ -181,12 +185,19 @@ class ThreePCBatch(MessageBase):
     typename = "THREE_PC_BATCH"
     schema = (
         ("messages", IterableField(AnyField(), min_length=1)),
+        # advisory causal stamp [origin, flush_seq, perf_ts, wall_ts]
+        # (flat_wire.TraceStamp.as_list) — observability-only; malformed
+        # content decodes to None and never affects vote handling
+        ("traceCtx", AnyField(nullable=True, optional=True)),
     )
 
     def as_dict(self):
-        return {"messages": [
+        d = {"messages": [
             m.to_dict() if isinstance(m, MessageBase) else m
             for m in self.messages]}
+        if getattr(self, "traceCtx", None) is not None:
+            d["traceCtx"] = list(self.traceCtx)
+        return d
 
 
 class FlatBatch(MessageBase):

@@ -74,6 +74,23 @@ Section payloads:
         offsets  (2n+1) × u32      request msgpack blobs, client ids
         blob
 
+    TRACE (kind 5, version 2 only), advisory causal stamp:
+        name_len u8 | origin utf-8 (≤ 64 bytes)
+        flush_seq u64              sender's per-seam flush counter
+        perf_ts   f64              sender perf-counter at flush
+        wall_ts   f64              sender wall clock at flush
+
+The TRACE section is **advisory observability context**: it is decoded
+by :func:`decode_trace_stamp` into ``ParsedEnvelope.stamp`` and never
+enters ``ParsedEnvelope.sections`` — consensus consumers iterate
+sections and cannot see it. Any CONTENT problem inside the stamp
+(bad length, non-finite floats, undecodable name) yields ``stamp =
+None`` and the rest of the envelope parses normally; only the shared
+structural framing (payload bounds) can fail the envelope. Version 1
+envelopes reject kind 5 like any unknown kind, so the golden byte
+vectors for version 1 are unchanged. plenum-lint PT015 enforces that
+no consensus path can reach the stamp decode.
+
 A structurally invalid envelope (bad magic/version, truncated or
 over-length payload, non-monotonic offsets, counts that do not fit)
 raises :class:`FlatWireError` — the node handler converts that into a
@@ -85,6 +102,8 @@ exactly like a bad entry in a legacy THREE_PC_BATCH.
 from __future__ import annotations
 
 import logging
+import math
+import struct
 from typing import List, Optional, Tuple
 
 import msgpack
@@ -94,11 +113,21 @@ logger = logging.getLogger(__name__)
 
 MAGIC = b"PW"
 VERSION = 1
+# version 2 = version 1 + an optional advisory TRACE section; the
+# sender only bumps the byte when a stamp actually rides the envelope,
+# so version-1 peers (and the version-1 golden vectors) never see it
+VERSION_TRACE = 2
 
 KIND_PREPARE = 1
 KIND_COMMIT = 2
 KIND_PREPREPARE = 3
 KIND_PROPAGATE = 4
+KIND_TRACE = 5
+
+# advisory-stamp bounds: origin name capped (encode truncates, decode
+# rejects over-length into stamp=None) so the section can never exceed
+# 1 + 64 + 8 + 8 + 8 = 89 payload bytes
+TRACE_NAME_MAX = 64
 
 # PREPARE flag bits
 F_STATE = 1
@@ -162,6 +191,82 @@ def _ragged_table(columns: List[List[bytes]]) -> Tuple[bytes, bytes]:
             raise FlatWireUnencodable("string table exceeds u32 offsets")
         offs[1:] = total
     return offs.tobytes(), b"".join(pieces)
+
+
+class TraceStamp:
+    """Advisory causal stamp carried by a version-2 envelope (and by
+    the typed THREE_PC_BATCH / PROPAGATE fallback as a plain list).
+    Pure data — the timestamp VALUES are produced at the sender's
+    flush seam and passed in as arguments; nothing in this module
+    reads a clock."""
+
+    __slots__ = ("origin", "seq", "perf_ts", "wall_ts")
+
+    def __init__(self, origin: str, seq: int, perf_ts: float,
+                 wall_ts: float):
+        self.origin = origin
+        self.seq = seq
+        self.perf_ts = perf_ts
+        self.wall_ts = wall_ts
+
+    def as_list(self) -> list:
+        """Typed-fallback wire form (rides a nullable message field)."""
+        return [self.origin, self.seq, self.perf_ts, self.wall_ts]
+
+    @classmethod
+    def from_wire(cls, value) -> Optional["TraceStamp"]:
+        """Typed-fallback decode: ANY content problem → None (the
+        stamp is advisory; it can never fail the carrying message)."""
+        try:
+            origin, seq, perf_ts, wall_ts = value
+            origin = str(origin)
+            if len(origin.encode("utf-8")) > TRACE_NAME_MAX:
+                return None
+            seq = int(seq)
+            perf_ts = float(perf_ts)
+            wall_ts = float(wall_ts)
+            if seq < 0 or seq >> 64 \
+                    or not math.isfinite(perf_ts) \
+                    or not math.isfinite(wall_ts):
+                return None
+            return cls(origin, seq, perf_ts, wall_ts)
+        except Exception:
+            return None
+
+    def __repr__(self):
+        return ("TraceStamp(origin=%r, seq=%d, perf_ts=%r, wall_ts=%r)"
+                % (self.origin, self.seq, self.perf_ts, self.wall_ts))
+
+
+def encode_trace_stamp(origin: str, flush_seq: int, perf_ts: float,
+                       wall_ts: float) -> bytes:
+    """TRACE section payload. Deliberately total: the stamp is
+    advisory, so an odd origin name or counter is clamped rather than
+    failing the envelope it rides on."""
+    name = str(origin).encode("utf-8", "replace")[:TRACE_NAME_MAX]
+    return b"".join((
+        bytes((len(name),)), name,
+        (int(flush_seq) & ((1 << 64) - 1)).to_bytes(8, "little"),
+        struct.pack("<dd", float(perf_ts), float(wall_ts))))
+
+
+def decode_trace_stamp(payload: bytes) -> Optional[TraceStamp]:
+    """TRACE section payload → TraceStamp, or None on ANY content
+    problem — the stamp is advisory and must never fail the envelope."""
+    try:
+        if len(payload) < 1:
+            return None
+        nl = payload[0]
+        if nl > TRACE_NAME_MAX or len(payload) != 1 + nl + 24:
+            return None
+        origin = payload[1:1 + nl].decode("utf-8")
+        seq = int.from_bytes(payload[1 + nl:9 + nl], "little")
+        perf_ts, wall_ts = struct.unpack_from("<dd", payload, 9 + nl)
+        if not math.isfinite(perf_ts) or not math.isfinite(wall_ts):
+            return None
+        return TraceStamp(origin, seq, perf_ts, wall_ts)
+    except Exception:
+        return None
 
 
 # ================================================================ encode
@@ -278,20 +383,33 @@ def encode_propagates(raw_requests: List[bytes],
     return offs + blob
 
 
-def build_envelope(sections: List[Tuple[int, int, bytes]]) -> bytes:
-    """(kind, count, payload) sections → one flat envelope."""
-    out = [MAGIC, bytes((VERSION, len(sections)))]
-    if len(sections) > 255:
+def build_envelope(sections: List[Tuple[int, int, bytes]],
+                   trace: Optional[bytes] = None) -> bytes:
+    """(kind, count, payload) sections → one flat envelope. ``trace``
+    is an already-encoded TRACE payload (encode_trace_stamp) — when
+    present the envelope is version 2 and the stamp rides as a
+    trailing advisory section; when absent the bytes are version 1,
+    identical to the pre-trace wire (golden vectors pin this)."""
+    version = VERSION if trace is None else VERSION_TRACE
+    nsect = len(sections) + (0 if trace is None else 1)
+    if nsect > 255:
         raise FlatWireUnencodable("too many sections")
+    out = [MAGIC, bytes((version, nsect))]
     for kind, count, payload in sections:
         out.append(bytes((kind,)))
         out.append(int(count).to_bytes(4, "little"))
         out.append(len(payload).to_bytes(4, "little"))
         out.append(payload)
+    if trace is not None:
+        out.append(bytes((KIND_TRACE,)))
+        out.append((1).to_bytes(4, "little"))
+        out.append(len(trace).to_bytes(4, "little"))
+        out.append(trace)
     return b"".join(out)
 
 
-def encode_three_pc(pps, prepares, commits) -> bytes:
+def encode_three_pc(pps, prepares, commits,
+                    trace: Optional[bytes] = None) -> bytes:
     """One sender's tick of broadcast 3PC votes → one flat envelope.
     Raises FlatWireUnencodable when a field value cannot ride the flat
     layout (the caller falls back to the typed envelope)."""
@@ -305,14 +423,15 @@ def encode_three_pc(pps, prepares, commits) -> bytes:
     if commits:
         sections.append((KIND_COMMIT, len(commits),
                          encode_commits(commits)))
-    return build_envelope(sections)
+    return build_envelope(sections, trace=trace)
 
 
 def encode_propagate_envelope(raw_requests: List[bytes],
-                              clients: List[str]) -> bytes:
+                              clients: List[str],
+                              trace: Optional[bytes] = None) -> bytes:
     return build_envelope([
         (KIND_PROPAGATE, len(raw_requests),
-         encode_propagates(raw_requests, clients))])
+         encode_propagates(raw_requests, clients))], trace=trace)
 
 
 # ================================================================ parse
@@ -530,11 +649,15 @@ _SECTION_TYPES = {
 
 
 class ParsedEnvelope:
-    __slots__ = ("sections", "nbytes")
+    __slots__ = ("sections", "nbytes", "stamp")
 
-    def __init__(self, sections, nbytes):
+    def __init__(self, sections, nbytes, stamp=None):
         self.sections = sections
         self.nbytes = nbytes
+        # advisory TraceStamp (or None) — deliberately OUTSIDE
+        # ``sections`` so consensus consumers iterating sections can
+        # never observe it; only the observability receive hook reads it
+        self.stamp = stamp
 
 
 def parse_envelope(data, max_bytes: Optional[int] = None
@@ -557,11 +680,13 @@ def parse_envelope(data, max_bytes: Optional[int] = None
             % (len(data), max_bytes))
     if len(data) < 4 or data[:2] != MAGIC:
         raise FlatWireError("bad magic")
-    if data[2] != VERSION:
-        raise FlatWireError("unsupported version %d" % data[2])
+    version = data[2]
+    if version not in (VERSION, VERSION_TRACE):
+        raise FlatWireError("unsupported version %d" % version)
     nsect = data[3]
     pos = 4
     sections = []
+    stamp = None
     for _ in range(nsect):
         if pos + 9 > len(data):
             raise FlatWireError("section header truncated")
@@ -571,6 +696,14 @@ def parse_envelope(data, max_bytes: Optional[int] = None
         pos += 9
         if pos + payload_len > len(data):
             raise FlatWireError("section payload truncated")
+        if kind == KIND_TRACE and version >= VERSION_TRACE:
+            # advisory: content problems (decode → None) and duplicate
+            # stamps are silently tolerated; only the structural
+            # payload-bounds check above can fail the envelope
+            if stamp is None:
+                stamp = decode_trace_stamp(data[pos:pos + payload_len])
+            pos += payload_len
+            continue
         cls = _SECTION_TYPES.get(kind)
         if cls is None:
             raise FlatWireError("unknown section kind %d" % kind)
@@ -583,7 +716,7 @@ def parse_envelope(data, max_bytes: Optional[int] = None
         raise FlatWireError("trailing bytes after last section")
     if not sections:
         raise FlatWireError("empty envelope")
-    return ParsedEnvelope(sections, len(data))
+    return ParsedEnvelope(sections, len(data), stamp)
 
 
 def unwrap_for_tap(payload) -> Optional[list]:

@@ -179,6 +179,16 @@ class OrderingService:
         # the 3PC-stage latency histogram's start marks (popped at
         # order; cleared wholesale on view change / catchup)
         self._tm_3pc_t0: Dict[Tuple[int, int], float] = {}
+        # journey plane: per-key quorum-close perf marks and last-vote
+        # straggler margins for PREPARE/COMMIT — same lifecycle as
+        # _tm_3pc_t0 (popped at order, cleared on view change,
+        # truncated at catchup, GC'd at checkpoint stabilization)
+        self._tm_prep_close: Dict[Tuple[int, int], float] = {}
+        self._tm_com_close: Dict[Tuple[int, int], float] = {}
+        self._tm_prep_margin: Dict[Tuple[int, int], float] = {}
+        self._tm_com_margin: Dict[Tuple[int, int], float] = {}
+        self._tm_q_maps = (self._tm_prep_close, self._tm_com_close,
+                           self._tm_prep_margin, self._tm_com_margin)
         # a PRE-PREPARE carries ~72 wire bytes per request digest; a
         # batch big enough to push it past the transport frame limit
         # would be dropped by the stack and wedge ordering at the first
@@ -439,7 +449,8 @@ class OrderingService:
         with self.metrics.measure_time(MetricsName.PP_PROCESS_TIME), \
                 self.tracer.span("pp_process", CAT_3PC,
                                  key="%d:%d" % (pp.viewNo, pp.ppSeqNo),
-                                 batch_size=len(pp.reqIdr), frm=frm):
+                                 batch_size=len(pp.reqIdr), frm=frm,
+                                 digest=pp.digest):
             return self._process_preprepare(pp, frm)
 
     def _process_preprepare(self, pp: PrePrepare, frm: str):
@@ -590,8 +601,47 @@ class OrderingService:
         counter exact (the prepare quorum excludes the primary)."""
         self.prepares[key][frm] = prepare
         if frm != self._data.primary_name:
-            self._prepare_vote_count[key] = \
+            count = self._prepare_vote_count[key] = \
                 self._prepare_vote_count.get(key, 0) + 1
+            if self.tracer.enabled or self.telemetry.enabled:
+                self._note_vote("prepare", key, frm, count,
+                                self._data.quorums.prepare,
+                                self._tm_prep_close,
+                                self._tm_prep_margin)
+
+    def _note_vote(self, phase: str, key: Tuple[int, int], frm: str,
+                   count: int, quorum, close_t: dict,
+                   margin: dict) -> None:
+        """Journey plane: the vote from ``frm`` just moved this key's
+        counter to ``count`` — detect the quorum-close transition
+        (naming the closing voter) and account votes landing after the
+        close as per-peer straggler lateness. Purely advisory: nothing
+        here feeds back into the vote stores or quorum checks, and the
+        caller guards on tracer/telemetry being live so the default
+        Null objects keep the vote path free."""
+        if not quorum.is_reached(count):
+            return
+        if not quorum.is_reached(count - 1):
+            # this vote supplied the quorum-closing ballot on this node
+            if self.tracer.enabled:
+                self.tracer.instant(phase + "_quorum", CAT_3PC,
+                                    key="%d:%d" % key, closer=frm,
+                                    votes=count)
+            if self.telemetry.enabled and \
+                    len(close_t) <= self._config.LOG_SIZE * 2:
+                close_t[key] = self.telemetry.clock()
+            return
+        # straggler: the quorum was already closed when this vote landed
+        if self.tracer.enabled:
+            self.tracer.instant(phase + "_vote_late", CAT_3PC,
+                                key="%d:%d" % key, frm=frm)
+        if self.telemetry.enabled:
+            t0 = close_t.get(key)
+            if t0 is not None:
+                late_ms = (self.telemetry.clock() - t0) * 1e3
+                margin[key] = late_ms
+                self.telemetry.observe_labeled(
+                    TM.PEER_VOTE_LATENESS_MS, frm, late_ms)
 
     # ========================================================== PREPARE
 
@@ -929,8 +979,12 @@ class OrderingService:
     def _add_commit_vote(self, key: Tuple[int, int], frm: str,
                          commit: Commit):
         self.commits[key][frm] = commit
-        self._commit_vote_count[key] = \
+        count = self._commit_vote_count[key] = \
             self._commit_vote_count.get(key, 0) + 1
+        if self.tracer.enabled or self.telemetry.enabled:
+            self._note_vote("commit", key, frm, count,
+                            self._data.quorums.commit,
+                            self._tm_com_close, self._tm_com_margin)
 
     # =========================================================== COMMIT
 
@@ -1080,6 +1134,10 @@ class OrderingService:
                 self.tracer.span("order", CAT_3PC,
                                  key="%d:%d" % (pp.viewNo, pp.ppSeqNo),
                                  batch_size=len(pp.reqIdr),
+                                 # digest↔batch join key for the
+                                 # journey plane (advisory, read only
+                                 # by observability/journey.py)
+                                 digests=pp.reqIdr,
                                  commits=len(self.commits[
                                      (pp.viewNo, pp.ppSeqNo)])):
             return self._order_inner(pp)
@@ -1090,6 +1148,19 @@ class OrderingService:
         if t0 is not None:
             self.telemetry.observe(TM.STAGE_3PC_MS,
                                    (self.telemetry.clock() - t0) * 1e3)
+        # quorum-close margins: lateness of the last straggler vote
+        # observed before order (0 = every counted vote arrived by the
+        # close) — the aggregate view of the journey plane's per-batch
+        # straggler-wait attribution
+        prep_margin = self._tm_prep_margin.pop(key, None)
+        com_margin = self._tm_com_margin.pop(key, None)
+        closed = self._tm_prep_close.pop(key, None)
+        if closed is not None:
+            self.telemetry.observe(TM.QUORUM_CLOSE_MARGIN_MS,
+                                   prep_margin or 0.0)
+        if self._tm_com_close.pop(key, None) is not None:
+            self.telemetry.observe(TM.QUORUM_CLOSE_MARGIN_MS,
+                                   com_margin or 0.0)
         self.ordered.add(key)
         self._data.last_ordered_3pc = key
         self._consume_from_queue(pp)
@@ -1189,6 +1260,8 @@ class OrderingService:
         self.batches.clear()
         # stale 3PC-latency start marks die with the view's vote state
         self._tm_3pc_t0.clear()
+        for m in self._tm_q_maps:
+            m.clear()
 
     def process_new_view_checkpoints_applied(
             self, msg: NewViewCheckpointsApplied):
@@ -1359,7 +1432,7 @@ class OrderingService:
         for store in (self.sent_preprepares, self.prePrepares,
                       self.prepares, self.commits, self.batches,
                       self._prepare_vote_count, self._commit_vote_count,
-                      self._tm_3pc_t0):
+                      self._tm_3pc_t0) + self._tm_q_maps:
             for k in [k for k in store if k[1] > last]:
                 del store[k]
         # the dropped batches must not be advertised as prepared evidence
@@ -1380,7 +1453,7 @@ class OrderingService:
         for store in (self.sent_preprepares, self.prePrepares,
                       self.prepares, self.commits, self.batches,
                       self._prepare_vote_count, self._commit_vote_count,
-                      self._tm_3pc_t0):
+                      self._tm_3pc_t0) + self._tm_q_maps:
             for key in [k for k in store if k[1] <= stable_seq]:
                 del store[key]
         self.ordered = {k for k in self.ordered if k[1] > stable_seq}

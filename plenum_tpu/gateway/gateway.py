@@ -124,7 +124,8 @@ class Gateway:
     def __init__(self, forward_writes: Callable[[bytes], None],
                  serve_read: Callable[[dict, str], Optional[dict]] = None,
                  check_proof=None, verifier=None, verkey_provider=None,
-                 config=None, telemetry=None, pool_hubs=None):
+                 config=None, telemetry=None, pool_hubs=None,
+                 tracer=None):
         """``forward_writes(envelope_bytes)`` delivers a packed write
         envelope to the pool; ``serve_read(msg, client)`` performs one
         pool read and returns the proof-bearing result dict (None =
@@ -140,7 +141,7 @@ class Gateway:
         self.intake = GatewayIntake(
             verifier=verifier, verkey_provider=verkey_provider,
             senders=SenderRegistry(telemetry=self._tm),
-            telemetry=self._tm)
+            telemetry=self._tm, tracer=tracer)
         self.admission = AdmissionController(config)
         self.cache = SignedReadCache(check_proof, telemetry=self._tm) \
             if check_proof is not None else None

@@ -40,6 +40,7 @@ from plenum_tpu.common.serializers.serialization import (
     serialize_msg_for_signing)
 from plenum_tpu.crypto.signer import verkey_from_identifier
 from plenum_tpu.observability.telemetry import TM, NullTelemetryHub
+from plenum_tpu.observability.tracing import CAT_INTAKE, NullTracer
 
 logger = logging.getLogger(__name__)
 
@@ -93,7 +94,7 @@ class GatewayIntake:
 
     def __init__(self, verifier=None, verkey_provider=None,
                  senders: SenderRegistry = None, telemetry=None,
-                 max_envelope_bytes: int = None):
+                 max_envelope_bytes: int = None, tracer=None):
         from plenum_tpu.common.config import Config
         if verifier is None:
             from plenum_tpu.crypto.batch_verifier import (
@@ -103,6 +104,7 @@ class GatewayIntake:
         self._verkeys = verkey_provider
         self._tm = telemetry if telemetry is not None \
             else NullTelemetryHub()
+        self.tracer = tracer if tracer is not None else NullTracer()
         self.senders = senders if senders is not None \
             else SenderRegistry(telemetry=self._tm)
         self.max_envelope_bytes = int(Config.MSG_LEN_LIMIT
@@ -207,10 +209,20 @@ class GatewayIntake:
         msgs, slots, pending = handle
         results = pending.collect() if pending is not None else []
         out = []
+        traced = getattr(self.tracer, "enabled", False)
         for (msg, client), slot in zip(msgs, slots):
             if slot is not None and not results[slot]:
                 self._tm.count(TM.GATEWAY_SIG_REJECTS, 1)
                 continue
+            if traced:
+                # journey anchor: the same digest the pool keys
+                # ``request_accepted`` on, so a gateway-fronted pool's
+                # journeys start at the trust boundary, not the first
+                # replica. Hashing is paid only when tracing is on.
+                digest = _request_digest(msg)
+                if digest is not None:
+                    self.tracer.instant("gateway_admit", CAT_INTAKE,
+                                        key=digest)
             out.append((msg, client))
         return out
 
@@ -237,3 +249,20 @@ class GatewayIntake:
         if len(sig_raw) != 64 or len(vk) != 32:
             return None
         return (ser, sig_raw, vk)
+
+
+def _request_digest(msg) -> Optional[str]:
+    """The pool's request digest (Request.digest: sha256 over the
+    signed state) computed from the raw dict — the join key between a
+    gateway admit and the node-side journey. None when the dict cannot
+    produce one (unscreenable shapes pass through undigested)."""
+    if not isinstance(msg, dict):
+        return None
+    try:
+        from plenum_tpu.common.request import Request
+        return Request(**{k: msg[k] for k in (
+            "identifier", "reqId", "operation", "signature",
+            "signatures", "protocolVersion", "taaAcceptance",
+            "endorser") if k in msg}).digest
+    except (TypeError, ValueError, KeyError):
+        return None

@@ -12,7 +12,13 @@ chrome://tracing and https://ui.perfetto.dev load directly:
   counter events ("C") for queue depths and batch sizes,
 * every event's args carry its correlation key ("key": request digest
   or "viewNo:ppSeqNo"), so Perfetto's search/flow UI groups one batch's
-  whole lifecycle across all nodes.
+  whole lifecycle across all nodes,
+* flow events ("s"/"f") pairing each stamped envelope's ``wire_send``
+  with every ``wire_recv`` it produced — Perfetto draws the arrow from
+  the sender's flush to each receiver's parse, which is what makes a
+  cross-node journey READABLE on the timeline. The flow id is the
+  stamp identity "origin:flushSeq" (the receive instants' key), so
+  send and receives bind with no extra bookkeeping.
 
 Timestamps are the tracers' shared perf_counter clock in microseconds;
 within one process (the sim pool, the e2e harness) that makes the
@@ -72,6 +78,20 @@ def trace_events(tracers: Iterable, telemetry: Iterable = ()) -> List[dict]:
                 timeline.append({
                     "name": name, "cat": track, "ph": "i", "pid": pid,
                     "tid": tid, "ts": ts, "s": "t", "args": payload})
+                # journey flow arrows: one "s" per stamped envelope
+                # send, one "f" per receive; both share the stamp
+                # identity as the flow id (a broadcast send fans out
+                # to one arrow per receiver)
+                if name == "wire_send" and key is not None:
+                    timeline.append({
+                        "name": "wire", "cat": track, "ph": "s",
+                        "id": "%s:%s" % (pname, key), "pid": pid,
+                        "tid": tid, "ts": ts, "args": {}})
+                elif name == "wire_recv" and key is not None:
+                    timeline.append({
+                        "name": "wire", "cat": track, "ph": "f",
+                        "bp": "e", "id": key, "pid": pid,
+                        "tid": tid, "ts": ts, "args": {}})
             else:  # "C"
                 timeline.append({
                     "name": name, "ph": "C", "pid": pid, "tid": tid,
@@ -144,12 +164,14 @@ def pool_tracers(nodes: Iterable) -> List:
 def summarize(doc: dict) -> dict:
     """Compact summary of a trace document (the `trace_view` CLI's
     validation/reporting half): event counts per phase kind, span-name
-    histogram per node, wall span of the timeline."""
+    histogram per node, counter-track value ranges, wall span of the
+    timeline."""
     events = doc.get("traceEvents", [])
     pid_names = {e["pid"]: e["args"]["name"] for e in events
                  if e.get("ph") == "M" and e.get("name") == "process_name"}
     by_ph: dict = {}
     by_node: dict = {}
+    counters: dict = {}
     t_min: Optional[int] = None
     t_max: Optional[int] = None
     for e in events:
@@ -164,10 +186,26 @@ def summarize(doc: dict) -> dict:
         node = pid_names.get(e["pid"], str(e["pid"]))
         names = by_node.setdefault(node, {})
         names[e["name"]] = names.get(e["name"], 0) + 1
+        if ph == "C":
+            # counter tracks: keep the value envelope per series so the
+            # file-mode summary reports them instead of dropping them
+            for v in (e.get("args") or {}).values():
+                if not isinstance(v, (int, float)):
+                    continue
+                cur = counters.get(e["name"])
+                if cur is None:
+                    counters[e["name"]] = {
+                        "points": 1, "min": v, "max": v, "last": v}
+                else:
+                    cur["points"] += 1
+                    cur["min"] = min(cur["min"], v)
+                    cur["max"] = max(cur["max"], v)
+                    cur["last"] = v
     return {
         "events": len(events),
         "by_ph": by_ph,
         "nodes": sorted(by_node),
         "span_counts": by_node,
+        "counters": counters,
         "wall_us": (t_max - t_min) if t_min is not None else 0,
     }

@@ -116,6 +116,17 @@ class TM:
     GATEWAY_BACKLOG = "gateway_backlog"          # gauge: in-flight
     GATEWAY_LANES_PER_BATCH = "gateway_lanes_per_batch"  # hist
 
+    # ---- journey plane (observability/journey.py): quorum critical-
+    # path attribution on the money path. The margin histogram records,
+    # per ordered batch and phase, how late the LAST counted straggler
+    # vote landed after the quorum had already closed (0 = the closing
+    # vote was also the last); the lateness family is the same signal
+    # split per peer (labeled histogram — the label is a VALUE, the
+    # family name stays a registry constant, PT009-clean), naming which
+    # peers consistently trail the quorum.
+    QUORUM_CLOSE_MARGIN_MS = "quorum_close_margin_ms"
+    PEER_VOTE_LATENESS_MS = "peer_vote_lateness_ms"  # labeled by peer
+
     # ---- pool health
     BACKLOG_DEPTH = "backlog_depth"            # gauge: in-flight requests
     REQUEST_QUEUE_DEPTH = "request_queue_depth"  # gauge: finalised queue
@@ -376,6 +387,10 @@ class TelemetryHub:
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, Tuple[float, float]] = {}   # name→(t, v)
         self._hists: Dict[str, LogLinearHistogram] = {}
+        # family → label → histogram (observe_labeled); label count
+        # capped per family — overflow folds into "_other" so a
+        # hostile/huge label set can never grow the registry unbounded
+        self._labeled: Dict[str, Dict[str, LogLinearHistogram]] = {}
         self._seams: Dict[str, _SeamStats] = {}
         history = int(_cfg("TELEMETRY_FLUSH_HISTORY", 512))
         self._flush_history: deque = deque(maxlen=history)
@@ -400,6 +415,32 @@ class TelemetryHub:
     def timer(self, name: str) -> _TimerCtx:
         """Context manager observing the block's wall duration (ms)."""
         return _TimerCtx(self, name)
+
+    def observe_labeled(self, name: str, label: str,
+                        value_ms: float) -> None:
+        """Record into the labeled-histogram family ``name`` under
+        ``label`` (e.g. a peer node name). The FAMILY name must be a
+        TM registry constant (PT009: dynamic names at record sites are
+        unbounded cardinality); the label is a value, capped per family
+        at TELEMETRY_LABELS_MAX distinct entries — later labels fold
+        into "_other" instead of growing the registry."""
+        fam = self._labeled.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._labeled.setdefault(name, {})
+        h = fam.get(label)
+        if h is None:
+            with self._lock:
+                if label not in fam and \
+                        len(fam) >= int(_cfg("TELEMETRY_LABELS_MAX", 64)):
+                    label = "_other"
+                h = fam.setdefault(label, LogLinearHistogram())
+        h.record(value_ms)
+
+    def labeled(self, name: str) -> dict:
+        """The live label → histogram map for one family ({} if never
+        recorded). Read-only for callers, like ``histogram``."""
+        return self._labeled.get(name) or {}
 
     def count(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -470,6 +511,8 @@ class TelemetryHub:
             counters = dict(other._counters)
             gauges = dict(other._gauges)
             hists = list(other._hists.items())
+            labeled = [(name, list(fam.items()))
+                       for name, fam in other._labeled.items()]
             seams = list(other._seams.items())
         for name, n in counters.items():
             self.count(name, n)
@@ -480,6 +523,15 @@ class TelemetryHub:
                     self._gauges[name] = (t, v)
         for name, hist in hists:
             self._hist(name).merge(hist)
+        for name, fam in labeled:
+            with self._lock:
+                mine = self._labeled.setdefault(name, {})
+                # merge is aggregation-time: peers' label sets are
+                # already capped at their record sites, so no re-cap
+                for label, _h in fam:
+                    mine.setdefault(label, LogLinearHistogram())
+            for label, hist in fam:
+                mine[label].merge(hist)
         for seam, stats in seams:
             self._seam(seam).merge(stats)
         return self
@@ -508,6 +560,8 @@ class TelemetryHub:
             counters = dict(self._counters)
             gauges = {k: v for k, (_t, v) in self._gauges.items()}
             hists = sorted(self._hists.items())
+            labeled = sorted((name, sorted(fam.items()))
+                             for name, fam in self._labeled.items())
             seams = sorted(self._seams.items())
         return {
             "node": self.name,
@@ -517,6 +571,9 @@ class TelemetryHub:
             "gauges": gauges,
             "histograms": {name: h.snapshot(buckets=buckets)
                            for name, h in hists},
+            "labeled": {name: {label: h.snapshot(buckets=buckets)
+                               for label, h in fam}
+                        for name, fam in labeled},
             "seams": {seam: s.snapshot() for seam, s in seams},
         }
 
@@ -533,7 +590,14 @@ class TelemetryHub:
             for name, (_t, v) in self._gauges.items():
                 sample[name] = v
             hists = sorted(self._hists.items())
+            labeled = sorted((name, sorted(fam.items()))
+                             for name, fam in self._labeled.items())
             seams = sorted(self._seams.items())
+        for name, fam in labeled:
+            for label, h in fam:
+                p99 = h.quantile(0.99)
+                if p99 is not None:
+                    sample[name + "." + label + ".p99"] = round(p99, 4)
         for name, h in hists:
             p50, p99 = h.quantile(0.50), h.quantile(0.99)
             if p50 is not None:
@@ -581,6 +645,12 @@ class NullTelemetryHub:
 
     def observe(self, name, value_ms) -> None:
         pass
+
+    def observe_labeled(self, name, label, value_ms) -> None:
+        pass
+
+    def labeled(self, name) -> dict:
+        return {}
 
     def timer(self, name):
         return _NULL_TIMER
@@ -723,6 +793,18 @@ def prometheus_text(snapshot: dict) -> str:
             pn, ('node="%s",' % node) if node else "", h.get("count", 0)))
         lines.append("%s_sum%s %g" % (pn, label, h.get("sum") or 0.0))
         lines.append("%s_count%s %d" % (pn, label, h.get("count", 0)))
+    for name, fam in sorted((snapshot.get("labeled") or {}).items()):
+        pn = _prom_name(name)
+        lines.append("# TYPE %s summary" % pn)
+        for lab, h in sorted(fam.items()):
+            ll = ('{node="%s",label="%s"}' % (node, lab)) if node \
+                else '{label="%s"}' % lab
+            for q in ("p50", "p99"):
+                if h.get(q) is not None:
+                    lines.append('%s%s %g' % (
+                        pn + "_" + q, ll, h[q]))
+            lines.append("%s_sum%s %g" % (pn, ll, h.get("sum") or 0.0))
+            lines.append("%s_count%s %d" % (pn, ll, h.get("count", 0)))
     for seam, s in sorted((snapshot.get("seams") or {}).items()):
         sl = seam_label(seam)
         lines.append("plenum_lane_useful_rows_total%s %d"

@@ -23,6 +23,12 @@ Design constraints (why this is not just `logging` with timestamps):
   production uses `perf_counter`, which is shared by every tracer in a
   process — so a sim pool's per-node buffers merge into one coherent
   pool-wide timeline with no clock alignment step.
+* Dual clocks for cross-process alignment. `clock_pair()` samples the
+  perf-counter AND an injectable wall clock in one call; the exporter
+  records the pair as a `clock_sync` event at flush so FILE-mode
+  consumers (scripts/pool_journey over Chrome dumps from different
+  processes) can re-anchor each node's perf timeline onto shared wall
+  time. In-process merges never need it, and `NullTracer` stays free.
 
 Record shape (one tuple per event, fixed arity):
 
@@ -122,6 +128,9 @@ class NullTracer:
     def counter(self, name, value, cat="") -> None:
         pass
 
+    def clock_pair(self) -> Tuple[float, float]:
+        return (0.0, 0.0)
+
     def spans(self) -> List[Record]:
         return []
 
@@ -137,17 +146,18 @@ class Tracer:
     """Ring-buffer span recorder for one node (or one daemon)."""
 
     __slots__ = ("name", "_capacity", "_buf", "_idx", "_written",
-                 "_clock", "_lock")
+                 "_clock", "_wall_clock", "_lock")
     enabled = True
 
     def __init__(self, name: str = "", capacity: int = 1 << 16,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, wall_clock=time.time):
         self.name = name
         self._capacity = max(1, int(capacity))
         self._buf: List[Optional[Record]] = [None] * self._capacity
         self._idx = 0           # next slot to overwrite
         self._written = 0       # total records ever (>= buffered)
         self._clock = clock
+        self._wall_clock = wall_clock
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ record
@@ -174,6 +184,13 @@ class Tracer:
         Perfetto as a stacked counter track."""
         self._record(("C", name, cat, self._clock(), None, None,
                       {name: value}))
+
+    def clock_pair(self) -> Tuple[float, float]:
+        """(perf_counter, wall) sampled back to back — the anchor pair
+        wire stamps and flush-time `clock_sync` events carry so
+        cross-process consumers can align this tracer's perf timeline
+        onto wall time."""
+        return (self._clock(), self._wall_clock())
 
     # -------------------------------------------------------------- read
 

@@ -57,8 +57,8 @@ from plenum_tpu.storage.kv_memory import KeyValueStorageInMemory
 
 _fp = try_load_ext("fastpath")
 from plenum_tpu.observability.tracing import (
-    CAT_3PC, CAT_DEVICE, CAT_INTAKE, CAT_RECOVERY, CAT_REPLY, NullTracer,
-    Tracer)
+    CAT_3PC, CAT_DEVICE, CAT_INTAKE, CAT_PROPAGATE, CAT_RECOVERY,
+    CAT_REPLY, NullTracer, Tracer)
 from plenum_tpu.observability.telemetry import (
     TM, NullTelemetryHub, TelemetryHub, get_seam_hub)
 from plenum_tpu.utils.metrics import MetricsName, NullMetricsCollector
@@ -465,6 +465,17 @@ class Node:
                         getattr(self.replica, "view_changer", None)):
             if _traced is not None:
                 _traced.tracer = self.tracer
+        # journey plane: outgoing envelopes carry an advisory causal
+        # stamp only when this node is traced AND the config gate is on
+        # — an untraced node has no buffers for journeys to join, so
+        # stamping it would be pure wire bytes
+        _trace_ctx = bool(getattr(self.config, "TRACE_CONTEXT_ENABLED",
+                                  True)) \
+            and getattr(self.tracer, "enabled", False)
+        self.propagator.trace_context = _trace_ctx
+        if self._outbox_3pc is not None:
+            self._outbox_3pc.trace_context = _trace_ctx
+            self._outbox_3pc.origin = name
         # telemetry rides the same single-injection-point pattern: the
         # executor times the execute/fused-dispatch stages, the
         # ordering service the 3PC stage, the view changer counts
@@ -1296,6 +1307,10 @@ class Node:
         sender's envelope is FIFO, and no sender emits a vote before
         its own earlier-phase vote for the same key, so phase-major
         processing preserves per-sender causality)."""
+        ctx = getattr(msg, "traceCtx", None)
+        if ctx is not None:
+            self._note_wire_stamp(
+                flat_wire.TraceStamp.from_wire(ctx), frm, CAT_3PC)
         groups: Dict[int, Tuple[list, list, list]] = {}
         # the typed path's receive-side deserialization cost — one
         # factory reconstruction per inner vote — is the `parse` stage
@@ -1369,6 +1384,12 @@ class Node:
                 auto_blacklist=self.config.BLACKLIST_ON_SUSPICION)
             return
         hub.count(TM.WIRE_BYTES_RECV, env.nbytes)
+        if env.stamp is not None:
+            self._note_wire_stamp(
+                env.stamp, frm,
+                CAT_PROPAGATE if all(
+                    s.kind == flat_wire.KIND_PROPAGATE
+                    for s in env.sections) else CAT_3PC)
         # inst -> (pps, prepare column slices, commit column slices);
         # phase-major per instance preserves per-sender causality (a
         # sender's envelope is FIFO and no sender votes ahead of its
@@ -1411,6 +1432,23 @@ class Node:
                 ordering.process_commit_columns(cols, frm)
         for sec in propagate_secs:
             self.propagator.process_propagate_columns(sec, frm)
+
+    def _note_wire_stamp(self, stamp, frm: str, cat: str) -> None:
+        """Advisory receive-side journey anchor: one ``wire_recv``
+        instant joining this envelope to its sender's ``wire_send`` by
+        (origin, flush seq). The stamp is observability context only —
+        a missing/corrupt stamp decodes to None upstream and message
+        handling proceeds identically (plenum-lint PT015 pins that no
+        consensus path can reach stamp content)."""
+        if stamp is None or not self.tracer.enabled:
+            return
+        _, recv_wall = self.tracer.clock_pair()
+        self.tracer.instant(
+            "wire_recv", cat,
+            key="%s:%d" % (stamp.origin, stamp.seq),
+            origin=stamp.origin, seq=stamp.seq, frm=frm,
+            sent_perf=stamp.perf_ts, sent_wall=stamp.wall_ts,
+            recv_wall=recv_wall)
 
     @staticmethod
     def _split_columns_by_inst(sec, group, slot: int) -> None:

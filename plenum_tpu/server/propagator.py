@@ -206,6 +206,11 @@ class Propagator:
         self.requests = Requests()
         self.metrics = NullMetricsCollector()   # node injects the real one
         self.tracer = NullTracer()              # node injects the real one
+        # journey plane: node enables trace_context from config; stamps
+        # flow only while the tracer is live, so the default NullTracer
+        # keeps this seam free
+        self.trace_context = False
+        self._flush_seq = 0
         # queued outgoing propagates, flushed as PROPAGATE_BATCH once
         # per tick: at n validators every request is otherwise its own
         # message n-1 times per node — batching is what lets wide pools
@@ -214,6 +219,26 @@ class Propagator:
 
     def update_quorums(self, quorums: Quorums):
         self.quorums = quorums
+
+    def _next_stamp(self):
+        """Advisory causal stamp for ONE outgoing envelope, or None
+        when trace context is off. The clock pair is sampled HERE, at
+        the flush seam — flat_wire's encode half is a PT012 consensus
+        root and only ever sees the timestamps as plain arguments."""
+        if not (self.trace_context and self.tracer.enabled):
+            return None
+        self._flush_seq += 1
+        perf, wall = self.tracer.clock_pair()
+        return flat_wire.TraceStamp(self.name, self._flush_seq,
+                                    perf, wall)
+
+    def _note_send(self, stamp, n: int, nbytes: int) -> None:
+        """Send-side anchor for the journey joiner / Perfetto flow
+        arrows: one instant per stamped envelope, keyed by flush seq."""
+        if stamp is not None:
+            self.tracer.instant("wire_send", CAT_PROPAGATE,
+                                key=str(stamp.seq), seq=stamp.seq,
+                                n=n, nbytes=nbytes)
 
     # ----------------------------------------------------------- sending
 
@@ -272,12 +297,17 @@ class Propagator:
                     logger.debug("propagator: flat encode fell back "
                                  "(%s)", e)
             if len(chunk) == 1:
+                # bare single-request sends carry no stamp — the
+                # context is advisory and the batch forms carry it
                 self._network.send(Propagate(request=chunk[0][0],
                                              senderClient=chunk[0][1]))
             else:
+                stamp = self._next_stamp()
                 self._network.send(PropagateBatch(
                     requests=[r for r, _, _, _ in chunk],
-                    clients=[c or "" for _, c, _, _ in chunk]))
+                    clients=[c or "" for _, c, _, _ in chunk],
+                    traceCtx=stamp.as_list() if stamp else None))
+                self._note_send(stamp, len(chunk), 0)
 
         chunk, chunk_size = [], 0
         for entry in out:
@@ -296,10 +326,16 @@ class Propagator:
         """One flat envelope from the chunk's already-packed request
         blobs — the payload bytes computed for the size budget ARE the
         wire bytes; no second serialization happens."""
+        stamp = self._next_stamp()
+        trace = None
+        if stamp is not None:
+            trace = flat_wire.encode_trace_stamp(
+                stamp.origin, stamp.seq, stamp.perf_ts, stamp.wall_ts)
         with self.tracer.span("wire_pack", CAT_PROPAGATE, n=len(chunk)):
             payload = flat_wire.encode_propagate_envelope(
                 [raw for _, _, _, raw in chunk],
-                [c or "" for _, c, _, _ in chunk])
+                [c or "" for _, c, _, _ in chunk],
+                trace=trace)
         if len(payload) > self.BATCH_SIZE_BUDGET and len(chunk) > 1:
             # estimate lagged (same backstop as ThreePCOutbox): split
             # rather than build a frame the transport drops wholesale
@@ -310,6 +346,7 @@ class Propagator:
         hub = get_seam_hub()
         hub.count(TM.WIRE_BYTES_SENT, len(payload))
         hub.observe(TM.WIRE_ENV_BYTES_PROPAGATE, len(payload))
+        self._note_send(stamp, len(chunk), len(payload))
         self._network.send(FlatBatch(payload=payload))
 
     # ---------------------------------------------------------- receiving
@@ -319,8 +356,28 @@ class Propagator:
             self._process_one(msg.request, msg.senderClient, frm)
 
     def process_propagate_batch(self, msg: PropagateBatch, frm: str):
+        self.note_wire_stamp(getattr(msg, "traceCtx", None), frm)
         with self.metrics.measure_time(MetricsName.PROPAGATE_PROCESS_TIME):
             self._process_propagate_batch(msg, frm)
+
+    def note_wire_stamp(self, ctx, frm: str) -> None:
+        """Advisory typed-fallback stamp intake: decode the nullable
+        traceCtx field and record a receive-side anchor instant. Every
+        failure mode is swallowed into 'no journey hop' — the stamp can
+        never affect propagate handling (plenum-lint PT015 pins this
+        unreachability from consensus)."""
+        if ctx is None or not self.tracer.enabled:
+            return
+        stamp = flat_wire.TraceStamp.from_wire(ctx)
+        if stamp is None:
+            return
+        recv_perf, recv_wall = self.tracer.clock_pair()
+        self.tracer.instant(
+            "wire_recv", CAT_PROPAGATE,
+            key="%s:%d" % (stamp.origin, stamp.seq),
+            origin=stamp.origin, seq=stamp.seq, frm=frm,
+            sent_perf=stamp.perf_ts, sent_wall=stamp.wall_ts,
+            recv_wall=recv_wall)
 
     def _process_propagate_batch(self, msg: PropagateBatch, frm: str):
         clients = msg.clients or [""] * len(msg.requests)
@@ -405,6 +462,7 @@ class Propagator:
                 return
             state = self.requests.add(request)
         propagates = state.propagates
+        n0 = len(propagates)
         propagates.add(frm)
         # echo our own propagate if we haven't yet (so slow clients still
         # reach quorum via node-to-node gossip)
@@ -413,26 +471,36 @@ class Propagator:
             self._queue_out(payload, sender_client)
         if not state.forwarded and \
                 self.quorums.propagate.is_reached(len(propagates)):
-            self._finalise(state, finalise_sink)
+            closer = frm
+            if self.tracer.enabled and len(propagates) > n0 + 1 \
+                    and not self.quorums.propagate.is_reached(n0 + 1):
+                # both the relay's vote and our own echo landed in this
+                # call and the relay's alone did not reach f+1: our own
+                # echo supplied the closing vote
+                closer = self.name
+            self._finalise(state, finalise_sink, closer=closer)
 
     def _try_finalise(self, req_key: str):
         state = self.requests.get(req_key)
         if state is None or state.forwarded:
             return
         if self.quorums.propagate.is_reached(len(state.propagates)):
-            self._finalise(state)
+            self._finalise(state, closer=self.name)
 
-    def _finalise(self, state: ReqState, sink=None):
-        """Quorum reached: mark, record the lifecycle marker, forward
-        exactly once. The digest access is free here — forwarding hands
-        request.key to the ordering queues anyway. With a `sink` the
-        caller owns forwarding (batch path: one columnar forward per
-        inbound PROPAGATE_BATCH)."""
+    def _finalise(self, state: ReqState, sink=None, closer=None):
+        """Quorum reached: mark, record the lifecycle marker (naming
+        the relay whose vote supplied the f+1'th — the journey plane's
+        propagate-close attribution), forward exactly once. The digest
+        access is free here — forwarding hands request.key to the
+        ordering queues anyway. With a `sink` the caller owns
+        forwarding (batch path: one columnar forward per inbound
+        PROPAGATE_BATCH)."""
         state.finalised = True
         state.forwarded = True
         self.tracer.instant("propagate_quorum", CAT_PROPAGATE,
                             key=state.request.key,
-                            votes=len(state.propagates))
+                            votes=len(state.propagates),
+                            closer=closer or self.name)
         if sink is not None:
             sink.append(state)
         else:

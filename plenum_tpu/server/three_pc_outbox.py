@@ -120,8 +120,36 @@ class ThreePCOutbox:
         self._flat = flat_wire_enabled
         self.size_model = EnvelopeSizeModel()
         self.tracer = NullTracer()   # node injects the real one
+        # journey plane: node sets origin + trace_context from config;
+        # stamps flow only when the node's tracer is live, so the
+        # default NullTracer keeps this seam free
+        self.origin = ""
+        self.trace_context = False
+        self._flush_seq = 0
         self.flushed_batches = 0
         self.flushed_msgs = 0
+
+    def _next_stamp(self):
+        """Advisory causal stamp for ONE outgoing envelope, or None
+        when trace context is off. The clock pair is sampled HERE, at
+        the flush seam (called only from the node's service loop, never
+        from consensus logic) — flat_wire's encode half is a PT012
+        consensus root and only ever sees the timestamps as plain
+        arguments."""
+        if not (self.trace_context and self.tracer.enabled):
+            return None
+        self._flush_seq += 1
+        perf, wall = self.tracer.clock_pair()
+        return flat_wire.TraceStamp(self.origin, self._flush_seq,
+                                    perf, wall)
+
+    def _note_send(self, stamp, n: int, nbytes: int) -> None:
+        """Send-side anchor for the journey joiner / Perfetto flow
+        arrows: one instant per stamped envelope, keyed by flush seq."""
+        if stamp is not None:
+            self.tracer.instant("wire_send", CAT_3PC,
+                                key=str(stamp.seq), seq=stamp.seq,
+                                n=n, nbytes=nbytes)
 
     def queue(self, msg) -> None:
         """Collect one broadcast 3PC vote for the next flush."""
@@ -180,8 +208,9 @@ class ThreePCOutbox:
             yield chunk
 
     def _send_flat_chunk(self, chunk: List, send) -> None:
+        stamp = self._next_stamp()
         with self.tracer.span("wire_pack", CAT_3PC, n=len(chunk)):
-            payload, sections = self._encode_chunk(chunk)
+            payload, sections = self._encode_chunk(chunk, stamp)
         if len(payload) > self._size_budget and len(chunk) > 1:
             # an estimate lagged the measured sizes: split and re-pack
             # rather than building a frame the transport drops. The
@@ -196,10 +225,11 @@ class ThreePCOutbox:
         hub = get_seam_hub()
         hub.count(TM.WIRE_BYTES_SENT, len(payload))
         hub.observe(TM.WIRE_ENV_BYTES_3PC, len(payload))
+        self._note_send(stamp, len(chunk), len(payload))
         send(FlatBatch(payload=payload))
         self.flushed_batches += 1
 
-    def _encode_chunk(self, chunk: List):
+    def _encode_chunk(self, chunk: List, stamp=None):
         """→ (envelope bytes, [(kind, count, payload_len, digests)])
         — measurement is deferred to _note_sections so only SENT
         envelopes feed the size model."""
@@ -218,9 +248,14 @@ class ThreePCOutbox:
         if commits:
             sections.append((flat_wire.KIND_COMMIT, len(commits),
                              flat_wire.encode_commits(commits), 0))
+        trace = None
+        if stamp is not None:
+            trace = flat_wire.encode_trace_stamp(
+                stamp.origin, stamp.seq, stamp.perf_ts, stamp.wall_ts)
         return flat_wire.build_envelope(
             [(kind, count, payload)
-             for kind, count, payload, _ in sections]), sections
+             for kind, count, payload, _ in sections],
+            trace=trace), sections
 
     def _note_sections(self, sections) -> None:
         model = self.size_model
@@ -237,7 +272,13 @@ class ThreePCOutbox:
     def _flush_typed(self, out: List, send) -> None:
         for chunk in self._chunks(out):
             if len(chunk) == 1:
+                # bare single-vote sends carry no stamp — the context
+                # is advisory and the envelope kinds are its carriers
                 send(chunk[0])
             else:
-                send(ThreePCBatch(messages=chunk))
+                stamp = self._next_stamp()
+                send(ThreePCBatch(
+                    messages=chunk,
+                    traceCtx=stamp.as_list() if stamp else None))
+                self._note_send(stamp, len(chunk), 0)
                 self.flushed_batches += 1

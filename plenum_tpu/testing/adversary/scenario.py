@@ -5,7 +5,13 @@ liveness assertions for the recovery phase of a fault plan.
 When a safety invariant fails and the pool is traced (Config
 TRACING_ENABLED), the runner automatically dumps the merged pool
 flight-recorder timeline (observability/) next to the failure — the
-ring buffers hold exactly the window leading up to the violation.
+ring buffers hold exactly the window leading up to the violation —
+plus the joined JOURNEY report (observability/journey.py): per-request
+causal records, the per-link clock model, and the equivocation
+evidence chain (every (viewNo:ppSeqNo) slot where nodes processed
+conflicting PRE-PREPARE digests, with who observed which digest from
+whom, and when). A fork dump therefore names the culprit slot and
+sender without rerunning anything.
 Override the directory with PLENUM_TPU_TRACE_DIR.
 
 Soak mode (docs/robustness.md): `soak(rounds, fault, ...)` repeats
@@ -99,6 +105,16 @@ class Scenario:
                 if e.args and isinstance(e.args[0], str):
                     e.args = ("%s [flight recorder: %s]"
                               % (e.args[0], path),) + e.args[1:]
+            jpath, equivs = self.dump_journey()
+            if jpath:
+                logger.error("journey + equivocation evidence dumped "
+                             "to %s (%d equivocating slot(s))",
+                             jpath, equivs)
+                if e.args and isinstance(e.args[0], str):
+                    tag = " [journeys: %s" % jpath
+                    if equivs:
+                        tag += "; EQUIVOCATION in %d slot(s)" % equivs
+                    e.args = (e.args[0] + tag + "]",) + e.args[1:]
             raise
 
     def dump_trace(self, path: Optional[str] = None,
@@ -127,6 +143,44 @@ class Scenario:
             logger.warning("could not write flight-recorder trace to %s",
                            path, exc_info=True)
             return None
+
+    def dump_journey(self, path: Optional[str] = None,
+                     tag: str = "invariant_failure"
+                     ) -> tuple:
+        """Join every traced node's buffer into the journey report —
+        per-request causal records plus the equivocation evidence
+        chain — and write it next to the timeline dump. → (path,
+        equivocating_slot_count), or (None, 0) when nothing is traced
+        or the write fails. The report is the triage half of a fork
+        dump: the timeline shows WHERE time went, the evidence chain
+        shows WHO sent conflicting digests for WHICH slot, and WHEN
+        each honest node saw them."""
+        import json
+
+        from plenum_tpu.observability import journey
+        from plenum_tpu.observability.export import pool_tracers
+        tracers = [t for t in pool_tracers(self.nodes)
+                   if getattr(t, "enabled", False)]
+        if not tracers:
+            return None, 0
+        report = journey.journeys_from_tracers(tracers)
+        doc = journey.to_json(report)
+        doc["causal_violations"] = journey.causal_violations(report)
+        if path is None:
+            out_dir = os.environ.get("PLENUM_TPU_TRACE_DIR") \
+                or tempfile.gettempdir()
+            _dump_seq[0] += 1
+            path = os.path.join(
+                out_dir, "%s_journeys_%d_%d.json"
+                % (tag, os.getpid(), _dump_seq[0]))
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+        except (OSError, TypeError, ValueError):
+            logger.warning("could not write journey report to %s",
+                           path, exc_info=True)
+            return None, 0
+        return path, len(doc.get("equivocations") or ())
 
     # ------------------------------------------------- recovery SLOs
 

@@ -273,3 +273,73 @@ def test_bls_gate_floor_is_sane():
     containers."""
     bench = _gate()
     assert 1.0 <= bench.BLS_VERIFY_FLOOR <= 60.0
+
+
+# ------------------------------------------ trace-context overhead gate
+
+
+def _trace_ctx_ok():
+    return {"reqs": 200, "overhead_pct": 0.8, "journey_requests": 200,
+            "journey_complete": 200, "causal_violations": 0,
+            "critical_path": {"batches": 2, "e2e_ms_mean": 40.0,
+                              "wire_pct": 30.0, "straggler_pct": 25.0,
+                              "local_pct": 45.0}}
+
+
+def test_trace_ctx_gate_passes_under_ceiling():
+    bench = _gate()
+    assert bench.trace_context_overhead_gate(_trace_ctx_ok()) == []
+    # negative overhead (ON side faster — jitter) is fine
+    res = _trace_ctx_ok()
+    res["overhead_pct"] = -0.4
+    assert bench.trace_context_overhead_gate(res) == []
+
+
+def test_trace_ctx_gate_fails_at_or_above_ceiling():
+    bench = _gate()
+    res = _trace_ctx_ok()
+    res["overhead_pct"] = 2.0
+    failures = bench.trace_context_overhead_gate(res)
+    assert any("trace_context_overhead_pct 2.00 >= allowed 2.00" in f
+               for f in failures)
+    res["overhead_pct"] = 7.3
+    assert bench.trace_context_overhead_gate(res)
+
+
+def test_trace_ctx_gate_fails_on_missing_overhead():
+    bench = _gate()
+    res = _trace_ctx_ok()
+    del res["overhead_pct"]
+    assert any("overhead_pct missing" in f
+               for f in bench.trace_context_overhead_gate(res))
+
+
+def test_trace_ctx_gate_requires_complete_journeys():
+    """A cheap stamp nobody can join is not a feature: the ON side
+    must have produced at least one complete journey record."""
+    bench = _gate()
+    res = _trace_ctx_ok()
+    res["journey_complete"] = 0
+    assert any("no complete journey" in f
+               for f in bench.trace_context_overhead_gate(res))
+
+
+def test_trace_ctx_gate_fails_on_causal_violations():
+    bench = _gate()
+    res = _trace_ctx_ok()
+    res["causal_violations"] = 3
+    assert any("3 causally inconsistent" in f
+               for f in bench.trace_context_overhead_gate(res))
+
+
+def test_trace_ctx_gate_ceiling_matches_telemetry_bar():
+    bench = _gate()
+    assert bench.TRACE_CONTEXT_OVERHEAD_MAX_PCT == 2.0
+
+
+def test_trace_ctx_gate_warn_override_honored(monkeypatch):
+    bench = _gate()
+    monkeypatch.delenv("BENCH_TRACE_CTX_GATE", raising=False)
+    assert bench.gate_enforced("BENCH_TRACE_CTX_GATE")
+    monkeypatch.setenv("BENCH_TRACE_CTX_GATE", "warn")
+    assert not bench.gate_enforced("BENCH_TRACE_CTX_GATE")

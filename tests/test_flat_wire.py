@@ -297,7 +297,8 @@ def test_over_length_and_version_skew_rejected():
     with pytest.raises(fw.FlatWireError):
         fw.parse_envelope(env + b"\x00")
     with pytest.raises(fw.FlatWireError):
-        fw.parse_envelope(env[:2] + bytes([fw.VERSION + 1]) + env[3:])
+        fw.parse_envelope(
+            env[:2] + bytes([fw.VERSION_TRACE + 1]) + env[3:])
     with pytest.raises(fw.FlatWireError):
         fw.parse_envelope(b"XX" + env[2:])
     with pytest.raises(fw.FlatWireError):
@@ -471,7 +472,9 @@ def test_mixed_version_stream_keeps_valid_envelopes():
         commits = [m for m in msgs if isinstance(m, Commit)]
         env = fw.encode_three_pc(pps, prepares, commits)
         # interleave an alien-version copy before every real envelope
-        alien = env[:2] + bytes([fw.VERSION + 1]) + env[3:]
+        # (VERSION_TRACE + 1: version 2 is merely v1 + a trailing
+        # trace section, so it parses — the first UNKNOWN version is 3)
+        alien = env[:2] + bytes([fw.VERSION_TRACE + 1]) + env[3:]
         with pytest.raises(fw.FlatWireError):
             fw.parse_envelope(alien)
         alien_seen += 1
@@ -717,3 +720,123 @@ def test_flat_and_typed_wire_order_identically_e2e():
     assert flat[0] == typed[0]          # domain ledger root, byte-equal
     assert flat[1] == typed[1]          # audit ledger root
     assert flat[2] == typed[2]          # committed state root
+
+
+# ===================================================== trace context (v2)
+
+
+def _stamp(origin="Alpha", seq=7, perf=1.5, wall=2.5):
+    return fw.encode_trace_stamp(origin, seq, perf, wall)
+
+
+def _prop_envelope(trace=None):
+    import msgpack
+    return fw.encode_propagate_envelope(
+        [msgpack.packb({"reqId": 1}, use_bin_type=True)], ["c1"],
+        trace=trace)
+
+
+def test_trace_stamp_roundtrip():
+    st = fw.decode_trace_stamp(_stamp())
+    assert (st.origin, st.seq, st.perf_ts, st.wall_ts) \
+        == ("Alpha", 7, 1.5, 2.5)
+
+
+def test_trace_stamp_encode_is_total():
+    """encode_trace_stamp clamps instead of raising: the stamp is
+    advisory and must never fail the envelope it rides on."""
+    payload = fw.encode_trace_stamp("x" * 200, -1, 0.25, 0.5)
+    st = fw.decode_trace_stamp(payload)
+    assert len(st.origin.encode()) == fw.TRACE_NAME_MAX
+    assert st.seq == (1 << 64) - 1          # -1 wrapped into u64
+
+
+def test_trace_stamp_decode_rejects_content_garbage():
+    import struct
+    good = _stamp()
+    assert fw.decode_trace_stamp(b"") is None
+    assert fw.decode_trace_stamp(good + b"x") is None       # bad length
+    assert fw.decode_trace_stamp(good[:-1]) is None
+    assert fw.decode_trace_stamp(bytes([255]) + good[1:]) is None
+    for bad in (float("nan"), float("inf")):
+        assert fw.decode_trace_stamp(
+            good[:-8] + struct.pack("<d", bad)) is None
+    assert fw.decode_trace_stamp(
+        bytes([3]) + b"\xff\xfe\xfd" + good[6:]) is None    # bad utf-8
+
+
+def test_envelope_version_bumps_only_with_stamp():
+    plain = _prop_envelope()
+    stamped = _prop_envelope(trace=_stamp())
+    assert plain[2] == fw.VERSION
+    assert stamped[2] == fw.VERSION_TRACE
+    env = fw.parse_envelope(stamped)
+    assert env.stamp is not None
+    assert (env.stamp.origin, env.stamp.seq) == ("Alpha", 7)
+    # the stamp never enters sections — consensus consumers cannot
+    # see it by iterating
+    assert len(env.sections) == 1
+    assert env.sections[0].n == 1
+    assert fw.parse_envelope(plain).stamp is None
+
+
+def test_v1_envelope_rejects_trace_kind():
+    """A version-1 envelope carrying a kind-5 section is structural
+    garbage — the golden version-1 wire has no trace vocabulary."""
+    raw = bytearray(_prop_envelope(trace=_stamp()))
+    raw[2] = fw.VERSION
+    with pytest.raises(fw.FlatWireError, match="unknown section kind 5"):
+        fw.parse_envelope(bytes(raw))
+
+
+def test_corrupt_stamp_yields_none_but_envelope_parses():
+    import struct
+    corrupt = _stamp()[:-8] + struct.pack("<d", float("inf"))
+    env = fw.parse_envelope(_prop_envelope(trace=corrupt))
+    assert env.stamp is None
+    assert len(env.sections) == 1
+    assert env.sections[0].request(0) == {"reqId": 1}
+
+
+def test_duplicate_trace_sections_first_wins():
+    s2 = _stamp("Beta", 9, 3.0, 4.0)
+    raw = bytearray(_prop_envelope(trace=_stamp()))
+    raw[3] += 1                                  # nsect
+    raw += bytes((fw.KIND_TRACE,)) + (1).to_bytes(4, "little") \
+        + len(s2).to_bytes(4, "little") + s2
+    env = fw.parse_envelope(bytes(raw))
+    assert env.stamp.origin == "Alpha"           # first stamp kept
+    assert len(env.sections) == 1
+
+
+def test_trace_section_payload_truncation_is_structural():
+    """Cutting the envelope short INSIDE the trace section is a framing
+    violation like any other truncation — attributable, rejected."""
+    stamped = _prop_envelope(trace=_stamp())
+    with pytest.raises(fw.FlatWireError):
+        fw.parse_envelope(stamped[:-5])
+
+
+def test_typed_fallback_stamp_from_wire():
+    st = fw.TraceStamp("Gamma", 3, 1.25, 9.5)
+    back = fw.TraceStamp.from_wire(st.as_list())
+    assert (back.origin, back.seq, back.perf_ts, back.wall_ts) \
+        == ("Gamma", 3, 1.25, 9.5)
+    for junk in (None, "junk", [], ["a", 1, 2.0], ["a", 1, 2.0, 3.0, 4],
+                 ["x" * 100, 1, 0.0, 0.0], ["a", -1, 0.0, 0.0],
+                 ["a", 1 << 64, 0.0, 0.0],
+                 ["a", 1, float("nan"), 0.0],
+                 ["a", 1, 0.0, float("inf")],
+                 ["a", "not-a-seq", 0.0, 0.0]):
+        assert fw.TraceStamp.from_wire(junk) is None, junk
+
+
+def test_three_pc_envelope_carries_stamp_alongside_votes():
+    pp, p, c = golden_messages()
+    data = fw.encode_three_pc([pp], [p], [c],
+                              trace=_stamp("Delta", 42, 0.5, 1.5))
+    assert data[2] == fw.VERSION_TRACE
+    env = fw.parse_envelope(data)
+    assert env.stamp.origin == "Delta" and env.stamp.seq == 42
+    kinds = {type(s).__name__ for s in env.sections}
+    assert "PrepareColumns" in kinds and "CommitColumns" in kinds

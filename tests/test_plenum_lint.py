@@ -1431,3 +1431,112 @@ def test_pt012_to_pt014_report_through_baseline(tmp_path):
     base = Baseline.from_findings(findings, justification="pinned")
     new, old = base.match(findings)
     assert new == [] and len(old) == 1
+
+
+# PT015 — the trace-stamp advisory boundary. A stamp is peer-
+# controlled wire bytes: parsing it anywhere a consensus root can
+# reach hands a byzantine peer a steering wheel into ordering.
+PT015_ROOT_PARSES = """
+    from plenum_tpu.network.flat_wire import decode_trace_stamp
+
+    class OrderingService:
+        def _order(self, batch, raw):
+            stamp = decode_trace_stamp(raw)
+            if stamp is not None:
+                batch = sorted(batch, key=lambda d: stamp[1])
+            return batch
+"""
+
+PT015_PARSE_DEF = """
+    def decode_trace_stamp(raw):
+        return None
+
+    class TraceStamp:
+        @classmethod
+        def from_wire(cls, raw):
+            return None
+"""
+
+# the shipped shape: parsing confined to an observability seam no
+# consensus root reaches — stamps feed the tracer and nothing else
+PT015_SEAM_PARSES = """
+    from plenum_tpu.network.flat_wire import decode_trace_stamp
+
+    def record_wire_recv(tracer, raw):
+        stamp = decode_trace_stamp(raw)
+        if stamp is not None:
+            tracer.instant("wire_recv", args={"origin": stamp[0]})
+"""
+
+
+def test_pt015_fires_on_parse_inside_consensus_closure(tmp_path):
+    findings = check_program("PT015", {
+        "plenum_tpu/consensus/ordering_service.py": PT015_ROOT_PARSES,
+        "plenum_tpu/network/flat_wire.py": PT015_PARSE_DEF,
+    }, tmp_path)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == "plenum_tpu/consensus/ordering_service.py"
+    assert f.symbol == "OrderingService._order"
+    assert "advisory" in f.message and "decode_trace_stamp" in f.message
+
+
+def test_pt015_fires_on_helper_reached_from_root(tmp_path):
+    """The parse doesn't have to sit IN the root — any function the
+    consensus closure reaches is inside the boundary."""
+    helper = """
+        from plenum_tpu.network.flat_wire import TraceStamp
+
+        class BatchTagger:
+            def tag(self, raw):
+                return TraceStamp.from_wire(raw)
+    """
+    root = """
+        from plenum_tpu.server.batch_tagger import BatchTagger
+
+        class OrderingService:
+            def _order(self, batch, raw):
+                tag = BatchTagger().tag(raw)
+                return (batch, tag)
+    """
+    findings = check_program("PT015", {
+        "plenum_tpu/consensus/ordering_service.py": root,
+        "plenum_tpu/server/batch_tagger.py": helper,
+        "plenum_tpu/network/flat_wire.py": PT015_PARSE_DEF,
+    }, tmp_path)
+    assert len(findings) == 1
+    assert findings[0].symbol == "BatchTagger.tag"
+    assert findings[0].path == "plenum_tpu/server/batch_tagger.py"
+
+
+def test_pt015_silent_on_observability_seam(tmp_path):
+    findings = check_program("PT015", {
+        "plenum_tpu/observability/wire_recv.py": PT015_SEAM_PARSES,
+        "plenum_tpu/network/flat_wire.py": PT015_PARSE_DEF,
+    }, tmp_path)
+    assert findings == []
+
+
+def test_pt015_fires_when_parse_surface_calls_consensus(tmp_path):
+    """Direction 2: the decode helper itself triggering consensus work
+    is the same taint flowing the other way."""
+    decode_calls_root = """
+        from plenum_tpu.consensus.ordering_service import OrderingService
+
+        def decode_trace_stamp(raw):
+            OrderingService()._order(raw)
+            return None
+    """
+    root = """
+        class OrderingService:
+            def _order(self, batch):
+                return batch
+    """
+    findings = check_program("PT015", {
+        "plenum_tpu/network/flat_wire.py": decode_calls_root,
+        "plenum_tpu/consensus/ordering_service.py": root,
+    }, tmp_path)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.symbol == "decode_trace_stamp"
+    assert "_order" in f.message and "advisory" in f.message

@@ -511,3 +511,69 @@ def test_sim_pool_prom_files_written(mock_timer, tdir, seam_hub):
         text = f.read()
     assert "plenum_ordered_requests_total" in text
     assert 'node="Alpha"' in text
+
+
+# ------------------------------------------------- labeled histograms
+
+
+def test_labeled_histogram_records_per_label():
+    hub = TelemetryHub("alpha")
+    hub.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "Beta", 1.0)
+    hub.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "Beta", 3.0)
+    hub.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "Gamma", 9.0)
+    fam = hub.labeled(TM.PEER_VOTE_LATENESS_MS)
+    assert sorted(fam) == ["Beta", "Gamma"]
+    assert fam["Beta"].count == 2 and fam["Gamma"].count == 1
+    assert hub.labeled("never_recorded") == {}
+
+
+def test_labeled_histogram_caps_labels_into_other(monkeypatch):
+    monkeypatch.setattr(Config, "TELEMETRY_LABELS_MAX", 3, raising=False)
+    hub = TelemetryHub("alpha")
+    for i in range(10):
+        hub.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "peer%d" % i, 1.0)
+    fam = hub.labeled(TM.PEER_VOTE_LATENESS_MS)
+    assert len(fam) == 4                       # 3 real labels + _other
+    assert "_other" in fam
+    assert fam["_other"].count == 7
+    # an ALREADY-ADMITTED label keeps recording under its own name
+    hub.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "peer0", 2.0)
+    assert hub.labeled(TM.PEER_VOTE_LATENESS_MS)["peer0"].count == 2
+
+
+def test_labeled_histograms_merge_across_hubs():
+    a = TelemetryHub("a")
+    b = TelemetryHub("b")
+    a.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "Beta", 1.0)
+    b.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "Beta", 2.0)
+    b.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "Delta", 5.0)
+    pool = TelemetryHub("pool").merge(a).merge(b)
+    fam = pool.labeled(TM.PEER_VOTE_LATENESS_MS)
+    assert fam["Beta"].count == 2
+    assert fam["Delta"].count == 1
+    # source hubs untouched
+    assert a.labeled(TM.PEER_VOTE_LATENESS_MS)["Beta"].count == 1
+
+
+def test_labeled_snapshot_flush_and_prometheus():
+    hub = TelemetryHub("alpha")
+    for v in (1.0, 2.0, 4.0):
+        hub.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "Beta", v)
+    snap = hub.snapshot(buckets=True)
+    lab = snap["labeled"][TM.PEER_VOTE_LATENESS_MS]["Beta"]
+    assert lab["count"] == 3 and lab["p99"] is not None
+    sample = hub.flush()
+    key = TM.PEER_VOTE_LATENESS_MS + ".Beta.p99"
+    assert key in sample and sample[key] > 0
+    text = prometheus_text(snap)
+    assert "# TYPE plenum_peer_vote_lateness_ms summary" in text
+    assert 'label="Beta"' in text
+    assert re.search(
+        r'plenum_peer_vote_lateness_ms_count\{node="alpha",'
+        r'label="Beta"\} 3', text)
+
+
+def test_null_hub_labeled_is_noop():
+    hub = NullTelemetryHub("x")
+    hub.observe_labeled(TM.PEER_VOTE_LATENESS_MS, "Beta", 1.0)
+    assert hub.labeled(TM.PEER_VOTE_LATENESS_MS) == {}

@@ -372,3 +372,29 @@ def test_trace_budget_cli(tdir):
          "--json"], capture_output=True, text=True)
     assert miss.returncode == 0
     assert "error" in json.loads(miss.stdout)
+
+
+# ------------------------------------------------------- dual clocks
+
+
+def test_clock_pair_samples_both_injected_clocks():
+    perf = [10.0]
+    wall = [1600000000.0]
+    tracer = Tracer("n1", clock=lambda: perf[0],
+                    wall_clock=lambda: wall[0])
+    assert tracer.clock_pair() == (10.0, 1600000000.0)
+    perf[0], wall[0] = 11.5, 1600000001.5
+    p, w = tracer.clock_pair()
+    assert (p, w) == (11.5, 1600000001.5)
+    assert isinstance(p, float) and isinstance(w, float)
+
+
+def test_clock_pair_defaults_to_perf_and_wall_time():
+    p, w = Tracer("n1").clock_pair()
+    # perf_counter is process-relative, wall is epoch-scale — the pair
+    # is exactly what lets file-mode consumers re-anchor timelines
+    assert w > 1e9 > p >= 0.0
+
+
+def test_null_tracer_clock_pair_is_free_and_zero():
+    assert NullTracer().clock_pair() == (0.0, 0.0)
