@@ -1707,6 +1707,160 @@ def wire_flat_ab():
     return out
 
 
+PIPELINE_SPEEDUP_FLOOR = 1.5
+
+
+def _pipeline_parity_roots(pipeline: bool):
+    """One 4-node fixed-latency pool drained to completion with
+    PIPELINE_ENABLED pinned — the tier-1 determinism harness shape
+    (tests/test_pipeline.py), re-run inside the bench so the timing
+    claim below is only ever made about a pipeline that just proved
+    byte-equal roots on THIS box."""
+    from plenum_tpu.common.config import Config
+    from plenum_tpu.crypto.signer import SimpleSigner
+    from plenum_tpu.runtime.sim_random import DefaultSimRandom
+    from plenum_tpu.server.node import Node
+    from plenum_tpu.testing.mock_timer import MockTimer
+    from plenum_tpu.testing.sim_network import SimNetwork
+
+    names = ["Alpha", "Beta", "Gamma", "Delta"]
+    timer = MockTimer()
+    timer.set_time(SIM_EPOCH)
+    # fixed latency: network timing must be mode-independent so any
+    # root drift is a real pipeline bug, not a draw-stream artifact
+    net = SimNetwork(timer, DefaultSimRandom(77),
+                     min_latency=0.003, max_latency=0.003)
+    conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2,
+                  FLAT_WIRE=True, PIPELINE_ENABLED=pipeline)
+    nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
+             for name in names]
+    n_reqs = 12
+    for req in make_requests(n_reqs, SimpleSigner(seed=b"\x71" * 32)):
+        for nd in nodes:
+            nd.process_client_request(dict(req), "parity-client")
+    for _ in range(400):
+        for nd in nodes:
+            nd.service()
+        timer.run_for(0.01)
+        if all(nd.domain_ledger.size >= n_reqs for nd in nodes):
+            break
+    if not all(nd.domain_ledger.size == n_reqs for nd in nodes):
+        return None
+    from plenum_tpu.common.constants import NYM
+    node = nodes[0]
+    state = node.write_manager.request_handlers[NYM].state
+    return (node.domain_ledger.root_hash, node.audit_ledger.root_hash,
+            bytes(state.committedHeadHash).hex())
+
+
+def pipeline_ab():
+    """Clean-box 25-node pump A/B for the pipeline-parallel node
+    runtime (ROADMAP item: break the one-thread ceiling): the IDENTICAL
+    deterministic pool + ordering workload with PIPELINE_ENABLED on vs
+    off. Parity comes FIRST — a 4-node full-drain A/B must produce
+    byte-equal ledger roots before a single timing number is recorded;
+    a fast wrong pipeline must never produce a headline. The timing
+    side keeps the real OpenSSL verifier (signature work is one of the
+    stages the worker thread absorbs) and pins the device seams to
+    their host paths, same reasoning as wire_flat_ab."""
+    out = {"nodes": int(os.environ.get("BENCH_PIPE_NODES", "25")),
+           "reqs": int(os.environ.get("BENCH_PIPE_REQS", "800")),
+           "cores": os.cpu_count() or 1}
+
+    roots_on = _pipeline_parity_roots(pipeline=True)
+    roots_off = _pipeline_parity_roots(pipeline=False)
+    out["parity_ok"] = (roots_on is not None
+                        and roots_on == roots_off)
+    out["parity_roots"] = {"on": roots_on, "off": roots_off}
+    if not out["parity_ok"]:
+        # no timing claim about a divergent pipeline
+        return out
+
+    n_nodes = out["nodes"]
+    n = out["reqs"]
+    wall_budget = float(os.environ.get("BENCH_PIPE_WALL", "150"))
+    batch = int(os.environ.get("BENCH_PIPE_BATCH", "200"))
+    names = ["P%02d" % i for i in range(n_nodes)]
+    from plenum_tpu.crypto.signer import SimpleSigner
+    reqs = make_requests(n, SimpleSigner(seed=b"\x72" * 32))
+    chunks = [reqs[i:i + batch] for i in range(0, n, batch)]
+
+    def run_one(pipe: bool) -> dict:
+        # clean box: device seams pinned to host paths (identical on
+        # both sides; their dispatch jitter would swamp the deltas
+        # under test) — what remains is the serial host money path the
+        # pipeline attacks: parse, verify, count, execute
+        nodes, timer = make_sim_pool(
+            names, "cpu", seed=13, batch=batch,
+            extra_conf=dict(SHA256_BACKEND="scalar",
+                            FUSED_BATCH_DISPATCH=False,
+                            STATE_DEVICE_ENGINE=False,
+                            MESH_ENABLED=False,
+                            PIPELINE_ENABLED=pipe))
+        t0 = time.perf_counter()
+        deadline = t0 + wall_budget
+        pipelined_intake(nodes, timer, chunks, client_id="pipe",
+                         deadline=deadline)
+        while time.perf_counter() < deadline:
+            for nd in nodes:
+                nd.service()
+            timer.run_for(0.01)
+            if all(nd.domain_ledger.size >= n for nd in nodes):
+                break
+        elapsed = time.perf_counter() - t0
+        ordered = min(nd.domain_ledger.size for nd in nodes)
+        return {
+            "req_per_s": round(ordered / max(1e-9, elapsed), 1),
+            "ordered": ordered,
+            "drained": ordered >= n,
+        }
+
+    # INTERLEAVED best-of-N, the wire_flat_ab methodology: alternating
+    # runs expose both modes to the same box-load profile
+    rounds = int(os.environ.get("BENCH_PIPE_ROUNDS", "2"))
+    for _ in range(rounds):
+        for label, pipe in (("on", True), ("off", False)):
+            run = run_one(pipe)
+            best = out.get(label)
+            if best is None or run["req_per_s"] > best["req_per_s"]:
+                out[label] = run
+    if out["off"]["req_per_s"]:
+        out["pipeline_speedup"] = round(
+            out["on"]["req_per_s"] / out["off"]["req_per_s"], 2)
+    return out
+
+
+def pipeline_regression_gate(pab, cores=None, env=None):
+    """Hard gate for the pipeline A/B. PARITY IS HARD ALWAYS — even
+    under the BENCH_PIPELINE_GATE=warn override, divergent roots fail
+    the run: a fast wrong pipeline must never ship. The ≥1.5x speedup
+    floor is hard only on hosts with more than 2 cores (below that
+    there is no headroom for a worker thread to win — the serial
+    fallback IS the right configuration), and it alone is downgraded
+    by BENCH_PIPELINE_GATE=warn for known-noisy shared boxes."""
+    if not isinstance(pab, dict):
+        return ["pipeline_ab produced no result dict"]
+    failures = []
+    if pab.get("parity_ok") is not True:
+        failures.append(
+            "pipeline parity_ok %r — pipelined pool roots must be "
+            "byte-equal to the serial pool's before any timing claim"
+            % (pab.get("parity_ok"),))
+    cores = (os.cpu_count() or 1) if cores is None else cores
+    env = os.environ if env is None else env
+    enforce_speed = cores > 2 and env.get("BENCH_PIPELINE_GATE") != "warn"
+    speed = pab.get("pipeline_speedup")
+    if speed is None:
+        if enforce_speed and pab.get("parity_ok") is True:
+            failures.append("pipeline_speedup missing from pipeline_ab")
+    elif speed < PIPELINE_SPEEDUP_FLOOR and enforce_speed:
+        failures.append(
+            "pipeline_speedup %.2f < required %.2fx (%d cores; "
+            "BENCH_PIPELINE_GATE=warn downgrades this check only)"
+            % (speed, PIPELINE_SPEEDUP_FLOOR, cores))
+    return failures
+
+
 def host_ms_regression_flags(current_total, current_execute=None):
     """Best-prior warn-tripwire for host_ms_per_ordered_req.total AND
     its execute stage (same convention as merkle_regression: warn-only
@@ -2641,6 +2795,8 @@ def main():
         (tracing.get("host_ms_per_ordered_req") or {}).get("total"),
         (tracing.get("host_ms_per_ordered_req") or {}).get("execute"))
     wire_ab = wire_flat_ab()
+    pipe_ab = pipeline_ab()
+    pipe_gate_failures = pipeline_regression_gate(pipe_ab)
     telemetry = telemetry_overhead()
     telemetry_gate_failures = telemetry_overhead_gate(telemetry)
     trace_ctx = trace_context_overhead()
@@ -2711,6 +2867,7 @@ def main():
             "tracing_overhead": tracing,
             "host_ms_regression": host_ms_regression,
             "wire_flat_ab": wire_ab,
+            "pipeline_ab": pipe_ab,
             "telemetry_overhead": telemetry,
             "trace_context_overhead": trace_ctx,
             "recovery": recovery,
@@ -2786,6 +2943,19 @@ def main():
                 "host_ms_incl_codec"),
             "wire_typed_host_ms": (wire_ab.get("typed") or {}).get(
                 "host_ms_incl_codec"),
+            # pipeline-parallel node runtime A/B (25-node clean-box
+            # pump): parity asserted byte-equal BEFORE timing, then
+            # PIPELINE_ENABLED on over off — the one-thread-ceiling
+            # claim (pipeline_regression_gate keeps parity hard even
+            # under the warn override)
+            "pipeline_speedup": pipe_ab.get("pipeline_speedup"),
+            "pipeline_on_req_per_s": (pipe_ab.get("on") or {}).get(
+                "req_per_s"),
+            "pipeline_off_req_per_s": (pipe_ab.get("off") or {}).get(
+                "req_per_s"),
+            "pipeline_parity_ok": pipe_ab.get("parity_ok"),
+            "pipeline_gate_ok": not pipe_gate_failures,
+            "pipeline_gate_failures": pipe_gate_failures or None,
             # serving-tier tail + device-efficiency trajectory (PR 10):
             # p50/p99 from the 25-node backlog config's merged hubs,
             # compact per-seam occupancy, and the always-on plane's
@@ -2861,6 +3031,12 @@ def main():
     if bls_gate_failures and gate_enforced("BENCH_BLS_GATE"):
         print("BLS REGRESSION GATE FAILED: "
               + "; ".join(bls_gate_failures), file=sys.stderr)
+        sys.exit(2)
+    # pipeline_regression_gate applies its own cores/override logic
+    # internally — parity failures come back hard regardless of env
+    if pipe_gate_failures:
+        print("PIPELINE GATE FAILED: "
+              + "; ".join(pipe_gate_failures), file=sys.stderr)
         sys.exit(2)
 
 

@@ -16,6 +16,20 @@ both by the worker side and by other methods (``__init__`` excluded:
 construction happens before the thread exists), where either write is
 outside a ``with <something lock-ish>`` block, is flagged. Subscript
 stores (the fixed-slot pattern) are not attribute writes and pass.
+
+Pipeline boundaries (runtime/pipeline.py) add two more shapes:
+
+* **Queue-crossing values must be immutable** — bytes, numpy views,
+  frozen job records. A ``put``/``put_nowait`` whose argument is a
+  freshly built MUTABLE container (dict/list/set literal or
+  comprehension) hands the other thread state the producer can still
+  reach; flagged wherever it appears.
+* **Consensus state is prod-thread-owned** — a worker-side unlocked
+  write to a consensus-named attribute (prepares/commits/propagates/
+  stashes/suspicions/view_no/last_ordered/ledger/state roots/request
+  queues) is flagged even with NO loop-side co-writer: the pipeline
+  ownership contract says workers parse, the prod thread counts, so
+  the write itself is the defect, not just the race.
 """
 from __future__ import annotations
 
@@ -26,6 +40,23 @@ from plenum_tpu.analysis.core import (
     Finding, ModuleContext, Rule, attr_parts, dotted)
 
 LOCKISH = ("lock", "mutex", "cond", "sem")
+
+# attribute-name fragments that mean "consensus state" at the pipeline
+# boundary: prod-thread-owned, never worker-writable (the
+# OrderingService/Propagator vocabulary)
+CONSENSUS_ATTRS = ("prepare", "commit", "propagat", "stash", "suspic",
+                   "view_no", "last_ordered", "ledger", "state_root",
+                   "requestqueue", "request_queue")
+
+# ast nodes that build a fresh MUTABLE container — the shapes that must
+# not cross a thread queue (immutable bytes/views/frozen records do)
+_MUTABLE_BUILDS = (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                   ast.SetComp, ast.DictComp)
+
+
+def _consensus_attr(attr: str) -> bool:
+    low = attr.lower()
+    return any(frag in low for frag in CONSENSUS_ATTRS)
 
 
 def _lockish_expr(expr: ast.AST) -> bool:
@@ -85,9 +116,37 @@ class CrossThreadSharedStateRule(Rule):
 
     def check(self, ctx: ModuleContext) -> List[Finding]:
         out: List[Finding] = []
+        out.extend(self._check_queue_puts(ctx))
         for cls in ast.walk(ctx.tree):
             if isinstance(cls, ast.ClassDef):
                 out.extend(self._check_class(ctx, cls))
+        return out
+
+    def _check_queue_puts(self, ctx: ModuleContext) -> List[Finding]:
+        """Queue-crossing immutability: a put/put_nowait whose argument
+        is a freshly built mutable container (dict/list/set literal or
+        comprehension) hands the consuming thread state the producer
+        can still reach. Queue payloads must be immutable — bytes,
+        numpy views, frozen/slotted job records."""
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            parts = attr_parts(node.func)
+            if not parts or parts[0] not in ("put", "put_nowait"):
+                continue
+            for arg in node.args:
+                if isinstance(arg, _MUTABLE_BUILDS):
+                    out.append(ctx.finding(
+                        self, arg,
+                        "a freshly built mutable %s crosses a thread "
+                        "queue via %s() — queue payloads must be "
+                        "immutable (bytes, numpy views, frozen "
+                        "records): the consumer would share state the "
+                        "producer can still mutate" % (
+                            type(arg).__name__.lower(), parts[0]),
+                        symbol=dotted(node.func) or parts[0]))
+                    break
         return out
 
     def _check_class(self, ctx: ModuleContext,
@@ -153,7 +212,28 @@ class CrossThreadSharedStateRule(Rule):
                 bucket.setdefault(attr, []).append((name, site, locked))
 
         out: List[Finding] = []
-        for attr in sorted(set(worker_writes) & set(loop_writes)):
+        # pipeline ownership contract: consensus-named attributes are
+        # prod-thread-owned — an unlocked worker-side write is the
+        # defect itself, no loop-side co-writer needed
+        dual = set(worker_writes) & set(loop_writes)
+        for attr in sorted(set(worker_writes) - dual):
+            if not _consensus_attr(attr):
+                continue
+            unlocked = [s for s in worker_writes[attr] if not s[2]]
+            if not unlocked:
+                continue
+            name, site, _ = unlocked[0]
+            out.append(ctx.finding(
+                self, site,
+                "self.%s (consensus state) is written from the "
+                "worker-thread path (%s) — consensus state is owned "
+                "by the prod thread; workers may only parse and hand "
+                "immutable results back over the queue" % (
+                    attr,
+                    "/".join(sorted({s[0] for s in worker_writes[attr]
+                                     }))),
+                symbol="%s.%s" % (cls.name, name)))
+        for attr in sorted(dual):
             w_sites = worker_writes[attr]
             l_sites = loop_writes[attr]
             unlocked = [s for s in w_sites + l_sites if not s[2]]

@@ -231,6 +231,22 @@ class Config:
     GATEWAY_SENDER_STRIKES = 3
     GATEWAY_SENDER_REGISTRY_MAX = 16384
 
+    # ---- pipeline runtime (plenum_tpu/runtime/pipeline.py): wire
+    # parse + ed25519 pre-screen on worker threads feeding the prod
+    # thread via bounded SPSC queues; execution fan-out across the same
+    # pool. The prod thread keeps sole ownership of all consensus
+    # state; the serial path stays the validated fallback (step-down
+    # philosophy). PIPELINE_WORKERS is the SINGLE sizing knob (PT005):
+    # None = auto (cores−1, capped at 4) for the node pipeline, while
+    # the verify daemon resolves the same knob with a fallback of 1 —
+    # its serialize-by-one coalescing floor — unless set explicitly.
+    # PIPELINE_QUEUE_DEPTH bounds the parse queue; a full queue blocks
+    # intake (backpressure that folds into the BACKLOG_DEPTH gauge the
+    # gateway admission ladder sheds on).
+    PIPELINE_ENABLED = False
+    PIPELINE_WORKERS = None
+    PIPELINE_QUEUE_DEPTH = 256
+
     # ---- quotas per prod tick (reference stp_core/config.py:29+,
     # plenum/server/quota_control.py)
     NODE_TO_NODE_STACK_QUOTA = 1024

@@ -172,6 +172,10 @@ class OrderingService:
         self._network = network
         self._executor = executor
         self._config = config or Config()
+        # pipeline ownership contract: when bound (pipelined node),
+        # 3PC intake off the prod thread is a programming error, not
+        # a race to debug later — fail loud at the seam
+        self._owner_thread: Optional[int] = None
         self.metrics = NullMetricsCollector()  # node injects the real one
         self.tracer = NullTracer()             # node injects the real one
         self.telemetry = NullTelemetryHub()    # node injects the real one
@@ -670,6 +674,26 @@ class OrderingService:
             self._try_prepared(pp)
         return None
 
+    # ------------------------------------- pipeline ownership contract
+
+    def bind_owner_thread(self, ident: int) -> None:
+        """Pin 3PC intake to the prod thread (pipelined node). Every
+        ``process_*_batch`` / ``process_*_columns`` call off that
+        thread raises — the pipeline's ownership contract (workers
+        parse, the prod thread counts votes) enforced at the seam
+        instead of trusted by convention."""
+        self._owner_thread = int(ident)
+
+    def _assert_owner(self) -> None:
+        if self._owner_thread is None:
+            return
+        import threading
+        if threading.get_ident() != self._owner_thread:
+            raise RuntimeError(
+                "3PC intake off the prod thread: consensus state is "
+                "owned by thread %d, called from %d" % (
+                    self._owner_thread, threading.get_ident()))
+
     def process_prepare_batch(self, prepares: List[Prepare], frm: str):
         """Columnar PREPARE intake: one sender's wire batch processed in
         one pass — shared checks hoisted out of the per-item path, the
@@ -677,6 +701,7 @@ class OrderingService:
         vectorized comparison, quorum counters bumped per item, and
         _try_prepared run once per touched batch instead of once per
         message."""
+        self._assert_owner()
         with self.metrics.measure_time(MetricsName.PREPARE_PROCESS_TIME), \
                 self.tracer.span("prepare_batch", CAT_3PC, frm=frm,
                                  n=len(prepares)):
@@ -777,6 +802,7 @@ class OrderingService:
         the incremental quorum counters directly; a typed Prepare is
         materialized ONLY for the votes that enter the vote store, a
         stash bucket or a suspicion report."""
+        self._assert_owner()
         with self.metrics.measure_time(MetricsName.PREPARE_PROCESS_TIME), \
                 self.tracer.span("prepare_batch", CAT_3PC, frm=frm,
                                  n=cols.n):
@@ -833,6 +859,7 @@ class OrderingService:
         per touched key. BLS share validation stays per item — each
         COMMIT carries its own share (inside the materialized vote the
         store needs anyway)."""
+        self._assert_owner()
         with self.metrics.measure_time(MetricsName.COMMIT_PROCESS_TIME), \
                 self.tracer.span("commit_batch", CAT_3PC, frm=frm,
                                  n=cols.n):
@@ -1056,6 +1083,7 @@ class OrderingService:
         (hoisted checks, counter bumps, one _try_order per touched
         key). BLS share validation stays per item — each COMMIT carries
         its own share."""
+        self._assert_owner()
         with self.metrics.measure_time(MetricsName.COMMIT_PROCESS_TIME), \
                 self.tracer.span("commit_batch", CAT_3PC, frm=frm,
                                  n=len(commits)):
@@ -1094,6 +1122,7 @@ class OrderingService:
         """PRE-PREPAREs from one wire batch: low-volume (one per
         instance per tick) but they must flow through the SAME stash/
         verdict machinery as singles — route each through the stasher."""
+        self._assert_owner()
         route = self._stasher.route
         for pp in pps:
             route(pp, frm)

@@ -10,6 +10,8 @@ stage:
 
 * ``intake``    — client batch auth dispatch/conclude + read batches
 * ``propagate`` — PROPAGATE flush + quorum bookkeeping
+* ``queue_wait`` — pipeline handoff: prod-thread time blocked on a
+                  parse worker at the drain (runtime/pipeline.py)
 * ``3pc``       — PRE-PREPARE build/process, columnar prepare/commit
                   intake, ordering, the per-tick vote flush
 * ``dispatch_wait`` — device seams (fused per-batch window, verifier
@@ -34,8 +36,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from plenum_tpu.observability.telemetry import TM as _TM
 
 # stage order is the money-path order; reports preserve it
-STAGES = ("intake", "propagate", "serialize", "parse", "3pc",
-          "dispatch_wait", "execute", "reply")
+STAGES = ("intake", "propagate", "serialize", "parse", "queue_wait",
+          "3pc", "dispatch_wait", "execute", "reply")
 
 # named sub-stages of the execute budget line (conflict-lane executor,
 # server/executor.py): plan+prefetch / per-request validate-apply /
@@ -57,6 +59,11 @@ _INTAKE_NAMES = frozenset({"auth_dispatch", "auth_conclude",
 _NAME_TO_STAGE = {
     "wire_pack": "serialize",
     "wire_parse": "parse",
+    # pipeline handoff: prod-thread time spent blocked on a parse
+    # worker (runtime/pipeline.py drain). Its own stage so handoff
+    # latency is attributable instead of smearing into the consuming
+    # 3PC stage — a mis-sized queue shows up as THIS row moving.
+    "queue_wait": "queue_wait",
 }
 _CAT_TO_STAGE = {
     "intake": "intake",
@@ -207,6 +214,7 @@ def _report(per_node: List[Dict[str, float]], ordered: List[int],
 # stage can be cheap on average and still own the latency SLO miss
 _STAGE_TELEMETRY = {
     "propagate": _TM.STAGE_PROPAGATE_MS,
+    "queue_wait": _TM.PIPELINE_QUEUE_WAIT_MS,
     "3pc": _TM.STAGE_3PC_MS,
     "dispatch_wait": _TM.STAGE_DISPATCH_MS,
     "execute": _TM.STAGE_EXECUTE_MS,

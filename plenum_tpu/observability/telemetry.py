@@ -136,6 +136,18 @@ class TM:
     ORDERED_REQUESTS = "ordered_requests"      # counter
     E2E_DROPPED = "e2e_dropped"                # counter: intake-ts map full
 
+    # ---- pipeline runtime (runtime/pipeline.py). Stage histograms are
+    # wall-clock per job on the worker side; queue_wait is the
+    # enqueue→prod-delivery handoff latency (the budget's `queue_wait`
+    # stage — handoff cost stays attributable instead of smearing into
+    # 3PC); depth gauges are the backpressure signals the admission
+    # ladder folds into BACKLOG_DEPTH.
+    PIPELINE_QUEUE_DEPTH = "pipeline_queue_depth"        # gauge: jobs
+    PIPELINE_EXEC_QUEUE_DEPTH = "pipeline_exec_queue_depth"  # gauge
+    PIPELINE_PARSE_MS = "pipeline_parse_ms"              # histogram
+    PIPELINE_PRESCREEN_MS = "pipeline_prescreen_ms"      # histogram
+    PIPELINE_QUEUE_WAIT_MS = "pipeline_queue_wait_ms"    # histogram
+
 
 # ---- device seams (lane accounting). One constant per bucket-padding
 # dispatch half; the seam string becomes the `seam` label in snapshots

@@ -41,12 +41,26 @@ class ClientAuthNr(ABC):
 
 
 class CoreAuthNr(ClientAuthNr):
-    def __init__(self, verkey_provider=None, verifier=None):
+    def __init__(self, verkey_provider=None, verifier=None,
+                 prescreen=None):
         """verkey_provider(identifier) → verkey str or None (state-backed
         in the node; local dict fallback for tests)."""
         self._verkey_provider = verkey_provider
         self._local: Dict[str, str] = {}
         self._verifier = verifier or create_verifier("adaptive")
+        self._prescreen = prescreen
+
+    def set_prescreen(self, cache) -> None:
+        """Install an advisory verdict cache (the pipeline's
+        PrescreenCache): ``cache.check((ser, sig64, vk32))`` is True
+        ONLY for a signature already verified somewhere — a hit skips
+        the scalar verify for that item; a miss — or no pre-screen —
+        takes the full verifier path, so outcomes are byte-identical
+        either way (positive-only filter, never an authority). The
+        authenticator also WARMS the cache on every successful verify,
+        so the 8 propagate copies of a request the pool relays cost
+        one verification, not eight."""
+        self._prescreen = cache
 
     # ------------------------------------------------------------- keys
 
@@ -80,8 +94,27 @@ class CoreAuthNr(ClientAuthNr):
 
     def authenticate(self, req: Request) -> List[str]:
         items, idrs = self._verify_items(req)
-        results = self._verifier.verify_batch(items)
+        results = self._verify_batch_prescreened(items)
         return self._conclude(req, idrs, results)
+
+    def _verify_batch_prescreened(self, items) -> List[bool]:
+        """verify_batch with cached-positive short-circuit: items the
+        pre-screen already verified (exact (ser, sig, vk) triple) skip
+        the scalar verify; everything else verifies normally."""
+        pre = self._prescreen
+        if pre is None:
+            return self._verifier.verify_batch(items)
+        misses = [i for i, it in enumerate(items) if not pre.check(it)]
+        if not misses:
+            return [True] * len(items)
+        verified = self._verifier.verify_batch(
+            [items[i] for i in misses])
+        results = [True] * len(items)
+        for i, ok in zip(misses, verified):
+            results[i] = bool(ok)
+            if ok:
+                pre.add(*items[i])
+        return results
 
     # ------------------------------------------------------------ batch
 
@@ -110,7 +143,8 @@ class CoreAuthNr(ClientAuthNr):
             idrs_per_req.append(idrs)
             all_items.extend(items)
         pending = self._verifier.dispatch(all_items) if all_items else None
-        return (list(reqs), spans, idrs_per_req, prep_errors, pending)
+        return (list(reqs), spans, idrs_per_req, prep_errors, pending,
+                all_items)
 
     def flush(self) -> None:
         """Start any coalesced device launch now (CoalescingVerifierHub);
@@ -134,8 +168,14 @@ class CoreAuthNr(ClientAuthNr):
 
     def conclude_batch(self, handle) -> List[Optional[List[str]]]:
         """Phase 2 (blocking): harvest the device results."""
-        reqs, spans, idrs_per_req, prep_errors, pending = handle
+        reqs, spans, idrs_per_req, prep_errors, pending, all_items = handle
         results = pending.collect() if pending is not None else []
+        if self._prescreen is not None:
+            # warm the verdict cache from the intake verifies: the
+            # propagate copies of these requests then pre-screen clean
+            for item, ok in zip(all_items, results):
+                if ok:
+                    self._prescreen.add(*item)
         out: List[Optional[List[str]]] = []
         for req, (start, count), idrs, err in zip(reqs, spans, idrs_per_req,
                                                   prep_errors):

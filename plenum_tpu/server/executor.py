@@ -43,7 +43,7 @@ from plenum_tpu.consensus.ordering_service import BatchExecutor
 from plenum_tpu.observability.tracing import (
     CAT_DEVICE, CAT_EXECUTE, NullTracer)
 from plenum_tpu.observability.telemetry import TM, NullTelemetryHub
-from plenum_tpu.server.execution_lanes import plan_lanes
+from plenum_tpu.server.execution_lanes import exec_fanout, plan_lanes
 from plenum_tpu.server.three_pc_batch import ThreePcBatch
 from plenum_tpu.server.write_request_manager import WriteRequestManager
 from plenum_tpu.state.pruning_state import flush_states_merged
@@ -87,6 +87,8 @@ class NodeBatchExecutor(BatchExecutor):
         self._on_batch_committed = on_batch_committed
         self._on_request_rejected = on_request_rejected or \
             (lambda d, r, s: None)
+        # pipeline execution fan-out (set_exec_map); None = serial
+        self._exec_map = None
         # fused per-3PC-batch device dispatch (Config.FUSED_BATCH_
         # DISPATCH): the batch's ledger leaf-hash launch, a verifier-hub
         # kick, and the MPT pending-apply share ONE overlapped device
@@ -276,13 +278,24 @@ class NodeBatchExecutor(BatchExecutor):
             state_root = self._resolve_states(staged, state, ledger)
         return state_root
 
+    def set_exec_map(self, fn) -> None:
+        """Install the pipeline's execution fan-out: an
+        order-preserving parallel map the merged state flush uses to
+        run independent per-state structural merges concurrently
+        (runtime/pipeline.py exec_map). None/unset = serial, the
+        validated fallback."""
+        self._exec_map = fn
+
     def _resolve_states(self, staged: Dict[int, List[dict]], state,
                         ledger) -> str:
         """Merge every written state's hash resolution (lanes and
         ledgers share the level-wise dispatches); the batch ledger's
         head read afterwards is a no-op flush."""
         if self._lanes and staged:
-            flush_states_merged([self.db.get_state(lid) for lid in staged])
+            lanes_fan = exec_fanout(len(staged))
+            flush_states_merged(
+                [self.db.get_state(lid) for lid in staged],
+                exec_map=self._exec_map if lanes_fan > 1 else None)
         return ledger.hashToStr(state.headHash) if state else ""
 
     # ------------------------------------------------------------- revert

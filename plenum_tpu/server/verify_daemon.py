@@ -70,9 +70,15 @@ class VerifyDaemon:
         self._window = Config.VERIFY_DAEMON_WINDOW \
             if window is None else window
         self._queue: asyncio.Queue = asyncio.Queue()
-        # one worker thread: device launches must serialize anyway, and a
-        # busy worker is exactly what lets the NEXT batch coalesce deeper
-        self._pool = ThreadPoolExecutor(max_workers=1)
+        # worker sizing through the single pipeline knob (PT005: one
+        # knob, every consumer). The daemon's FALLBACK is 1, not the
+        # node pipeline's cores−1 auto: device launches must serialize
+        # anyway, and a busy worker is exactly what lets the NEXT
+        # batch coalesce deeper — only an explicit PIPELINE_WORKERS
+        # raises it (multi-backend / cpu-path deployments).
+        from plenum_tpu.runtime.pipeline import resolve_workers
+        self._pool = ThreadPoolExecutor(max_workers=resolve_workers(
+            getattr(Config, "PIPELINE_WORKERS", None), fallback=1))
         self._server = None
         self._writers = set()
         self.served = 0

@@ -275,6 +275,75 @@ def test_bls_gate_floor_is_sane():
     assert 1.0 <= bench.BLS_VERIFY_FLOOR <= 60.0
 
 
+# --------------------------------------------- pipeline regression gate
+# (ISSUE 19: the pipeline-parallel runtime's A/B — parity is hard
+# ALWAYS, the ≥1.5x speedup floor is hard only on >2-core hosts and is
+# the only check BENCH_PIPELINE_GATE=warn downgrades)
+
+
+def _pipe_ok(**over):
+    base = {"parity_ok": True, "pipeline_speedup": 1.9,
+            "on": {"req_per_s": 95.0}, "off": {"req_per_s": 50.0}}
+    base.update(over)
+    return base
+
+
+def test_pipeline_gate_passes_on_healthy_run():
+    bench = _gate()
+    assert bench.pipeline_regression_gate(_pipe_ok(), cores=8,
+                                          env={}) == []
+
+
+def test_pipeline_gate_parity_is_hard_even_under_warn_override():
+    """A fast wrong pipeline must never pass: divergent roots fail the
+    run regardless of BENCH_PIPELINE_GATE and core count."""
+    bench = _gate()
+    for cores in (1, 2, 8):
+        for env in ({}, {"BENCH_PIPELINE_GATE": "warn"}):
+            failures = bench.pipeline_regression_gate(
+                _pipe_ok(parity_ok=False), cores=cores, env=env)
+            assert any("parity_ok" in f for f in failures), (cores, env)
+    assert bench.pipeline_regression_gate(None) != []
+
+
+def test_pipeline_gate_speedup_floor_only_on_multicore():
+    bench = _gate()
+    slow = _pipe_ok(pipeline_speedup=1.1)
+    failures = bench.pipeline_regression_gate(slow, cores=8, env={})
+    assert any("pipeline_speedup 1.10 < required 1.50" in f
+               for f in failures)
+    # ≤2 cores: no headroom for a worker to win — serial fallback is
+    # the right configuration, the floor does not apply
+    assert bench.pipeline_regression_gate(slow, cores=2, env={}) == []
+    assert bench.pipeline_regression_gate(slow, cores=1, env={}) == []
+
+
+def test_pipeline_gate_warn_override_downgrades_speedup_only():
+    bench = _gate()
+    slow = _pipe_ok(pipeline_speedup=1.1)
+    assert bench.pipeline_regression_gate(
+        slow, cores=8, env={"BENCH_PIPELINE_GATE": "warn"}) == []
+    # any other value keeps it enforcing
+    assert bench.pipeline_regression_gate(
+        slow, cores=8, env={"BENCH_PIPELINE_GATE": "1"}) != []
+
+
+def test_pipeline_gate_fails_on_missing_speedup_multicore():
+    """Dropping the headline field must fail loudly on a host where
+    the floor applies, not silently skip the check."""
+    bench = _gate()
+    res = _pipe_ok()
+    del res["pipeline_speedup"]
+    failures = bench.pipeline_regression_gate(res, cores=8, env={})
+    assert any("pipeline_speedup missing" in f for f in failures)
+    assert bench.pipeline_regression_gate(res, cores=2, env={}) == []
+
+
+def test_pipeline_gate_floor_is_the_issue_acceptance():
+    bench = _gate()
+    assert bench.PIPELINE_SPEEDUP_FLOOR == 1.5
+
+
 # ------------------------------------------ trace-context overhead gate
 
 

@@ -236,11 +236,14 @@ def test_columnar_batch_with_only_garbage_is_noop():
 
 # ------------------------------------------------------------------- e2e
 
-def _run_pool(batch_wire: bool, n_reqs: int = 24, flat_wire: bool = None):
+def _run_pool(batch_wire: bool, n_reqs: int = 24, flat_wire: bool = None,
+              pipeline: bool = None):
     """One deterministic 4-node sim pool ordering n_reqs NYMs;
     → (domain_root, audit_root, state_root, ordered txn sequence).
     flat_wire pins Config.FLAT_WIRE (None = the class default) — the
-    flat-codec A/B in tests/test_flat_wire.py reuses this harness."""
+    flat-codec A/B in tests/test_flat_wire.py reuses this harness;
+    pipeline pins Config.PIPELINE_ENABLED the same way (the pipeline
+    on/off determinism A/B in tests/test_pipeline.py)."""
     from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
     from plenum_tpu.common.txn_util import get_payload_data
     from plenum_tpu.crypto.signer import SimpleSigner
@@ -265,6 +268,8 @@ def _run_pool(batch_wire: bool, n_reqs: int = 24, flat_wire: bool = None):
                      THREE_PC_BATCH_WIRE=batch_wire)
     if flat_wire is not None:
         overrides["FLAT_WIRE"] = flat_wire
+    if pipeline is not None:
+        overrides["PIPELINE_ENABLED"] = pipeline
     conf = Config(**overrides)
     nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
              for name in names]

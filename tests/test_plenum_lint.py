@@ -297,6 +297,62 @@ def test_pt004_clean_on_locked_and_fixed_slot_writes():
                          "plenum_tpu/server/daemon.py") == []
 
 
+# PT004 pipeline boundaries (PR 19): queue-crossing values must be
+# immutable, and consensus state is prod-thread-owned — a worker-side
+# write flags with no loop-side co-writer at all.
+
+PT004_PIPELINE_BAD = """
+    import threading
+
+    class Stage:
+        def start(self):
+            self._t = threading.Thread(target=self._work)
+            self._t.start()
+
+        def feed(self, env, frm):
+            self._queue.put({"env": env, "frm": frm})
+
+        def _work(self):
+            self.prepares = {}
+"""
+
+PT004_PIPELINE_GOOD = """
+    import threading
+
+    class Stage:
+        def start(self):
+            self._t = threading.Thread(target=self._work)
+            self._t.start()
+
+        def feed(self, job):
+            self._queue.put(job)        # frozen record crosses whole
+
+        def _work(self):
+            parsed = {}                 # worker-local is fine
+            self._buf[0] = parsed       # fixed-slot handoff
+"""
+
+
+def test_pt004_flags_mutable_container_crossing_queue():
+    findings = check_snippet(rule_by_code("PT004"), PT004_PIPELINE_BAD,
+                             "plenum_tpu/runtime/stage.py")
+    assert any("mutable dict crosses a thread queue" in f.message
+               for f in findings)
+
+
+def test_pt004_flags_worker_side_consensus_state_write():
+    findings = check_snippet(rule_by_code("PT004"), PT004_PIPELINE_BAD,
+                             "plenum_tpu/runtime/stage.py")
+    assert any("self.prepares" in f.message
+               and "owned by the prod thread" in f.message
+               for f in findings)
+
+
+def test_pt004_clean_on_frozen_records_and_local_state():
+    assert check_snippet(rule_by_code("PT004"), PT004_PIPELINE_GOOD,
+                         "plenum_tpu/runtime/stage.py") == []
+
+
 # --------------------------------------------------------------- PT005
 
 PT005_BAD = """
