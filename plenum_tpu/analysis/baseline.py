@@ -23,6 +23,33 @@ VERSION = 1
 
 Key = Tuple[str, str, str, str]
 
+# PT004 (per-module thread heuristic) was subsumed by the whole-program
+# region rules: the same finding now fires under PT016 (cross-region
+# mutable state) or PT017 (handoff discipline) with a byte-identical
+# message. Re-key grandfathered entries at load so justifications
+# survive the rule split; message fragments discriminate which rule a
+# given entry migrated to. Entries whose message matches neither
+# fragment stay PT004 — with the engine active PT004 is held out, so
+# such entries surface through stale() instead of being dropped
+# silently.
+_RULE_MIGRATIONS: Tuple[Tuple[str, str, str], ...] = (
+    ("PT004", "worker-thread path", "PT016"),
+    ("PT004", "crosses a thread queue", "PT017"),
+)
+
+
+def migrate_entries(entries: List[dict]) -> Tuple[List[dict], int]:
+    """→ (entries with superseded rule ids re-keyed, migration count)."""
+    out, n = [], 0
+    for e in entries:
+        for old_rule, fragment, new_rule in _RULE_MIGRATIONS:
+            if e.get("rule") == old_rule and fragment in e.get("message", ""):
+                e = dict(e, rule=new_rule)
+                n += 1
+                break
+        out.append(e)
+    return out, n
+
 
 def _key(f: Finding) -> Key:
     return (f.rule, f.path, f.symbol, f.message)
@@ -45,7 +72,8 @@ class Baseline:
             raise ValueError(
                 "unsupported lint baseline version %r in %s"
                 % (data.get("version"), path))
-        return cls(data.get("entries", []))
+        entries, _ = migrate_entries(data.get("entries", []))
+        return cls(entries)
 
     def save(self, path: str) -> None:
         data = {"version": VERSION, "entries": self.entries}

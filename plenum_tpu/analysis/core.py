@@ -173,6 +173,10 @@ class Rule:
     code = "PT000"
     name = "abstract"
     severity = "error"
+    # code of a ProgramRule that supersedes this one: when that rule is
+    # active AND the engine builds, this rule is held out of the run (it
+    # becomes the engine-unavailable fallback). None = always runs.
+    subsumed_by: Optional[str] = None
 
     def applies(self, rel_path: str) -> bool:
         return True
@@ -215,9 +219,18 @@ class Analyzer:
                       if not isinstance(r, ProgramRule)]
         self.program_rules = [r for r in rules
                               if isinstance(r, ProgramRule)]
+        # subsumed heuristics: held out while their superseding
+        # ProgramRule is active — they re-enter the per-module pass
+        # only when the engine fails to build (the fallback path)
+        program_codes = {r.code for r in self.program_rules}
+        self.held_rules = [r for r in self.rules
+                           if r.subsumed_by in program_codes]
+        self.rules = [r for r in self.rules
+                      if r not in self.held_rules]
         self.root = os.path.abspath(root)
         self.use_engine_cache = use_engine_cache
         self.engine = None  # built lazily by run_files
+        self.engine_error: Optional[str] = None
 
     # --------------------------------------------------------- file walk
 
@@ -252,10 +265,19 @@ class Analyzer:
 
     def run_files(self, files: Sequence[str]) -> List[Finding]:
         findings: List[Finding] = []
-        for path in files:
-            findings.extend(self.run_one(path))
+        module_rules = list(self.rules)
         if self.program_rules:
-            findings.extend(self._run_program_rules(files))
+            try:
+                findings.extend(self._run_program_rules(files))
+            except Exception as exc:
+                # engine unavailable: the subsumed heuristics are the
+                # fallback — coverage degrades to per-module precision
+                # instead of disappearing
+                self.engine_error = "%s: %s" % (
+                    type(exc).__name__, exc)
+                module_rules = module_rules + self.held_rules
+        for path in files:
+            findings.extend(self.run_one(path, module_rules))
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
         return findings
 
@@ -289,7 +311,8 @@ class Analyzer:
                     out.append(f)
         return out
 
-    def run_one(self, path: str) -> List[Finding]:
+    def run_one(self, path: str,
+                rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
         rel = self._rel(path)
         try:
             with open(path, "r", encoding="utf-8") as f:
@@ -302,7 +325,7 @@ class Analyzer:
                 message="cannot parse file: %s" % exc, symbol="")]
         ctx = ModuleContext(rel, source, tree)
         out: List[Finding] = []
-        for rule in self.rules:
+        for rule in (self.rules if rules is None else rules):
             if not rule.applies(rel):
                 continue
             for finding in rule.check(ctx):

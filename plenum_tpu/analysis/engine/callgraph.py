@@ -129,8 +129,8 @@ class CallGraph:
                     stack.append(resolved)
         return None
 
-    def resolve_call(self, caller_sym: str, chain: List[str]
-                     ) -> Optional[str]:
+    def resolve_call(self, caller_sym: str, chain: List[str],
+                     fallback: bool = True) -> Optional[str]:
         facts = self.files[self.fn_path[caller_sym]]
         mod = facts["module"]
         fn = self.functions[caller_sym]
@@ -167,6 +167,8 @@ class CallGraph:
                 if hit:
                     return hit
         # unique-name fallback for attribute calls on unknown receivers
+        if not fallback:
+            return None
         term = chain[-1]
         cands = self._by_name.get(term, [])
         if len(cands) == 1:

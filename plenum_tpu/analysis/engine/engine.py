@@ -14,7 +14,7 @@ from typing import Dict, List, Optional, Sequence, Set
 from plenum_tpu.analysis.engine.cache import FactsCache, content_hash
 from plenum_tpu.analysis.engine.callgraph import CallGraph
 from plenum_tpu.analysis.engine.summaries import (
-    FunctionSummary, compute_summaries)
+    FunctionSummary, compute_regions, compute_summaries)
 from plenum_tpu.analysis.engine.symtab import extract_file_facts
 
 
@@ -28,6 +28,13 @@ class Engine:
         self.graph = CallGraph(files)
         self.summaries: Dict[str, FunctionSummary] = \
             compute_summaries(self.graph)
+        # executing-region sets (prod/worker/daemon) per symbol, and
+        # mirrored onto the summaries for --callgraph triage
+        self.regions: Dict[str, Set[str]] = compute_regions(self.graph)
+        for sym, regs in self.regions.items():
+            s = self.summaries.get(sym)
+            if s is not None:
+                s.regions = regs
 
     # ------------------------------------------------------------ build
 

@@ -59,7 +59,7 @@ def closer_closes(closer: str, family: str) -> bool:
 class FunctionSummary:
     __slots__ = ("sym", "nondet", "pure", "returns_open", "closes",
                  "routes_bucket", "opens_local",
-                 "launches_param_shapes")
+                 "launches_param_shapes", "regions")
 
     def __init__(self, sym: str):
         self.sym = sym
@@ -76,6 +76,10 @@ class FunctionSummary:
         # work whose operand shapes come in verbatim through its own
         # parameters — callers carry the bucket obligation
         self.launches_param_shapes = False
+        # thread regions this function can execute in (PT016/PT017):
+        # subset of {"prod", "worker", "daemon"} — filled in by
+        # compute_regions after the callee-first fixpoint
+        self.regions: Set[str] = set()
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return ("FunctionSummary(%s nondet=%r pure=%r returns_open=%r "
@@ -189,6 +193,105 @@ def compute_summaries(graph: CallGraph) -> Dict[str, FunctionSummary]:
                     for sym in comp]:
                 break
     return summaries
+
+
+THREAD_REGION_LABELS = ("worker", "daemon")
+
+# terminal names that shadow builtin-container methods: the callgraph's
+# unique-name fallback may bind ``some_list.extend(...)`` to the one
+# project symbol named ``extend``, and region labels spread through the
+# transitive closure — one bad edge mislabels a whole subsystem as
+# worker-side. Region propagation therefore refuses fallback-resolved
+# edges for these names; precisely resolved edges still traverse.
+_CONTAINER_SHADOWS = frozenset({
+    "append", "appendleft", "extend", "add", "update", "pop", "popleft",
+    "get", "put", "put_nowait", "clear", "remove", "discard", "insert",
+    "sort", "copy", "keys", "values", "items", "setdefault", "run",
+    "send", "close", "submit", "start", "stop", "reset", "extend_hashes",
+})
+
+
+def _region_callees(graph: CallGraph, sym: str) -> Set[str]:
+    """Callees for region propagation: precise resolution always,
+    unique-name fallback only for terminals that cannot be builtin
+    container/handle methods."""
+    out: Set[str] = set()
+    for call in graph.functions[sym].get("calls", ()):
+        chain = call["chain"]
+        if not chain:
+            continue
+        callee = graph.resolve_call(sym, chain, fallback=False)
+        if callee is None and chain[-1] not in _CONTAINER_SHADOWS:
+            callee = graph.resolve_call(sym, chain)
+        if callee is not None:
+            out.add(callee)
+    return out
+
+
+def _region_reach(graph: CallGraph, seeds: List[str]) -> Set[str]:
+    seen: Set[str] = set()
+    frontier = [s for s in seeds if s in graph.functions]
+    while frontier:
+        sym = frontier.pop()
+        if sym in seen:
+            continue
+        seen.add(sym)
+        frontier.extend(c for c in _region_callees(graph, sym)
+                        if c not in seen)
+    return seen
+
+
+def spawn_roots(graph: CallGraph) -> Dict[str, str]:
+    """Resolved spawn-target symbols → thread-region label.
+
+    A function handed to ``Thread(target=...)``, ``pool.submit(...)``
+    or ``loop.run_in_executor(...)`` seeds a non-prod region. The
+    label is ``daemon`` when either end of the spawn lives in a
+    *daemon* module/class (the verify daemon's device worker),
+    ``worker`` otherwise (pipeline parse stage, exec pool)."""
+    roots: Dict[str, str] = {}
+    for sym, fn in graph.functions.items():
+        for spawn in fn.get("spawns", ()):
+            for chain in spawn.get("targets", ()):
+                callee = graph.resolve_call(sym, chain, fallback=False)
+                if callee is None \
+                        and chain[-1] not in _CONTAINER_SHADOWS:
+                    callee = graph.resolve_call(sym, chain)
+                if callee is None:
+                    continue
+                label = "daemon" if (
+                    "daemon" in sym.lower()
+                    or "daemon" in callee.lower()) else "worker"
+                # daemon is the more specific label — keep it if any
+                # spawn site says so
+                if roots.get(callee) != "daemon":
+                    roots[callee] = label
+    return roots
+
+
+def compute_regions(graph: CallGraph) -> Dict[str, Set[str]]:
+    """Executing-region sets for every function symbol.
+
+    Forward closure from the spawn roots labels the worker/daemon
+    side; everything NOT reachable from a spawn root seeds ``prod``,
+    and prod's own forward closure then re-adds ``prod`` to shared
+    helpers — a function called from both sides ends up
+    ``{"prod", "worker"}``, which is exactly the multi-region evidence
+    PT016 keys on. Functions only ever entered from a spawned thread
+    (worker loops, their private callees) stay single-region."""
+    regions: Dict[str, Set[str]] = {
+        sym: set() for sym in graph.functions}
+    roots = spawn_roots(graph)
+    for label in THREAD_REGION_LABELS:
+        seeds = [s for s, r in roots.items() if r == label]
+        if not seeds:
+            continue
+        for sym in _region_reach(graph, seeds):
+            regions[sym].add(label)
+    prod_seeds = [sym for sym, regs in regions.items() if not regs]
+    for sym in _region_reach(graph, prod_seeds):
+        regions[sym].add("prod")
+    return regions
 
 
 def _summarize(graph: CallGraph,

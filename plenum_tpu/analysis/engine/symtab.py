@@ -31,7 +31,7 @@ from plenum_tpu.analysis.core import attr_parts, dotted, iter_pragmas
 
 # bump when the extraction output changes shape or meaning — stale
 # cache entries from an older extractor must never feed the linker
-FACTS_VERSION = 1
+FACTS_VERSION = 2
 
 # sanctioned bounded-shape helpers: a device launch routes through a
 # bucket iff one of these is called on the way to the shape (PR 9's
@@ -61,6 +61,41 @@ TIME_FNS = frozenset({
 _STR_BUILDERS = frozenset({"str", "repr", "format", "hex", "chr"})
 _STR_METHODS = frozenset({"format", "encode", "decode", "join", "hex",
                           "lower", "upper", "strip"})
+
+# ---- thread-region facts (PT016/PT017) --------------------------------
+
+# names that mean "this context manager is a lock" — shared vocabulary
+# with the PT004 heuristic so the engine-backed rules agree with the
+# fallback on what counts as locked
+LOCKISH = ("lock", "mutex", "cond", "sem")
+
+# ast nodes that build a fresh MUTABLE container — the shapes that must
+# not cross a thread queue (immutable bytes/views/frozen records do)
+_MUTABLE_BUILDS = (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                   ast.SetComp, ast.DictComp)
+_MUTABLE_CTORS = frozenset({"dict", "list", "set", "bytearray",
+                            "defaultdict", "deque"})
+
+# method names that mutate their receiver in place — used to detect a
+# payload mutated AFTER it was handed over a queue. Deliberately a
+# whitelist: matching any later line would false-positive on
+# else-branches that merely mention the name (job.run() after put)
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "update", "extend", "insert",
+    "remove", "discard", "pop", "popleft", "clear", "setdefault",
+})
+
+
+def _lockish_name(name: str) -> bool:
+    low = name.lower()
+    return any(frag in low for frag in LOCKISH)
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Base Name of an attribute/subscript chain, or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
 
 
 def is_bucket_helper(name: str) -> bool:
@@ -182,6 +217,9 @@ class _FunctionExtractor:
         self.parents: Dict[int, ast.AST] = {}
         self.calls: List[dict] = []
         self.nondet: List[dict] = []
+        self.attr_writes: List[dict] = []
+        self.spawns: List[dict] = []
+        self.handoffs: List[dict] = []
         self.name_flows: Dict[str, dict] = {}
         self.mutates = False
         self.buckets = False
@@ -214,6 +252,7 @@ class _FunctionExtractor:
         self._extract_calls()
         self._extract_name_flows()
         self._extract_nondet()
+        self._extract_threading()
         decorators = [_decorator_record(d)
                       for d in getattr(fn, "decorator_list", ())]
         return {
@@ -231,6 +270,9 @@ class _FunctionExtractor:
             "name_flows": self.name_flows,
             "mutates": self.mutates,
             "buckets": self.buckets,
+            "attr_writes": self.attr_writes,
+            "spawns": self.spawns,
+            "handoffs": self.handoffs,
         }
 
     def _walk_own(self, fn: ast.AST):
@@ -660,6 +702,153 @@ class _FunctionExtractor:
             self._note(it, "set-iter",
                        "iteration over a set (hash order)")
 
+    # ---------------------------------------- thread regions (PT016/17)
+
+    def _under_lock(self, node: ast.AST) -> bool:
+        """Enclosed by a ``with <something lock-ish>`` block."""
+        cur = self._parent(node)
+        while cur is not None and cur is not self.fn:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    for sub in ast.walk(item.context_expr):
+                        name = sub.attr if isinstance(
+                            sub, ast.Attribute) else (
+                            sub.id if isinstance(sub, ast.Name)
+                            else None)
+                        if name and _lockish_name(name):
+                            return True
+            cur = self._parent(cur)
+        return False
+
+    def _spawn_payload(self, expr: ast.AST):
+        """(target chains, captured self-attrs) of a callable handed to
+        another thread. A lambda target contributes every call chain in
+        its body (they all run on the spawned thread) plus the self
+        attributes it closes over — the closure-capture evidence
+        PT017's escape check reads."""
+        if isinstance(expr, ast.Lambda):
+            targets: List[List[str]] = []
+            captured: Set[str] = set()
+            for n in ast.walk(expr.body):
+                if isinstance(n, ast.Call):
+                    ch = _chain(n.func)
+                    if ch:
+                        targets.append(ch)
+                elif isinstance(n, ast.Attribute) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "self" \
+                        and isinstance(n.ctx, ast.Load):
+                    parent = self._parent(n)
+                    invoked = isinstance(parent, ast.Call) \
+                        and parent.func is n
+                    if not invoked:
+                        captured.add(n.attr)
+            return targets, sorted(captured)
+        ch = _chain(expr)
+        return ([ch] if ch else []), []
+
+    def _extract_threading(self) -> None:
+        """Thread-creation, queue-handoff, and self-attr write facts —
+        the raw material the region propagation (summaries.py) and the
+        PT016/PT017 ownership rules consume."""
+        # self-attribute rebinds (subscript stores excluded: the
+        # sanctioned Tracer fixed-slot pattern writes into preallocated
+        # ring slots, which is not an attribute rebind). A ``*_locked``
+        # function name is the repo's caller-holds-the-lock convention
+        # (ops/mesh.py) — its writes count as locked.
+        fn_locked = self.fn.name.endswith("_locked")
+        for node in self._walk_own(self.fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self":
+                        self.attr_writes.append({
+                            "attr": tgt.attr,
+                            "line": node.lineno,
+                            "col": node.col_offset,
+                            "locked": fn_locked
+                            or self._under_lock(node),
+                        })
+        # in-place name mutations, for the mutated-after-handoff check
+        mutations: List[Tuple[int, str]] = []
+        for node in self._walk_own(self.fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                        root = _root_name(tgt)
+                        if root:
+                            mutations.append((node.lineno, root))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATOR_METHODS:
+                root = _root_name(node.func.value)
+                if root:
+                    mutations.append((node.lineno, root))
+        # spawns and handoffs
+        for node in self._walk_own(self.fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _chain(node.func)
+            if not chain:
+                continue
+            terminal = chain[-1]
+            target_expr = None
+            kind = None
+            if terminal == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target_expr = kw.value
+                        kind = "thread"
+            elif terminal == "submit" and len(chain) >= 2 and node.args:
+                target_expr = node.args[0]
+                kind = "submit"
+            elif terminal == "run_in_executor" and len(node.args) >= 2:
+                target_expr = node.args[1]
+                kind = "run_in_executor"
+            if kind is not None and target_expr is not None:
+                targets, captured = self._spawn_payload(target_expr)
+                if targets or captured:
+                    self.spawns.append({
+                        "kind": kind,
+                        "targets": targets,
+                        "captured_attrs": captured,
+                        "line": node.lineno,
+                        "col": node.col_offset,
+                    })
+                continue
+            if terminal in ("put", "put_nowait") and len(chain) >= 2 \
+                    and node.args:
+                arg0 = node.args[0]
+                mutable = isinstance(arg0, _MUTABLE_BUILDS) or (
+                    isinstance(arg0, ast.Call)
+                    and isinstance(arg0.func, ast.Name)
+                    and arg0.func.id in _MUTABLE_CTORS)
+                arg_names = sorted({a.id for a in node.args
+                                    if isinstance(a, ast.Name)})
+                mutated_after = sorted({
+                    nm for ln, nm in mutations
+                    if nm in arg_names and ln > node.lineno})
+                self.handoffs.append({
+                    "op": terminal,
+                    "recv": ".".join(chain[:-1]),
+                    "line": node.lineno,
+                    "col": node.col_offset,
+                    "arg_mutable": mutable,
+                    "mutable_kind": (type(arg0).__name__.lower()
+                                     if isinstance(arg0, _MUTABLE_BUILDS)
+                                     else (arg0.func.id if mutable
+                                           else "")),
+                    "arg_names": arg_names,
+                    "mutated_after": mutated_after,
+                })
+
 
 def _scan_pragmas(source: str) -> dict:
     """The engine's JSON-able view of core.iter_pragmas (one shared
@@ -696,8 +885,23 @@ def extract_file_facts(rel_path: str, source: str) -> dict:
         # jit assignments are picked up by visit_scope below (it walks
         # module scope too — one detector, class-level included)
 
-    def visit_scope(body, qprefix: str, cls: Optional[str]) -> None:
+    def _block_stmts(body):
+        """Statements of a scope INCLUDING control-flow blocks — a def
+        nested inside ``if config.PIPELINE_ENABLED:`` (the node's
+        pipeline wiring) is still a symbol. Function/class bodies stay
+        out: they are their own scopes."""
         for node in body:
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                yield from _block_stmts(getattr(node, field, None) or [])
+            for h in getattr(node, "handlers", None) or []:
+                yield from _block_stmts(h.body)
+
+    def visit_scope(body, qprefix: str, cls: Optional[str]) -> None:
+        for node in _block_stmts(body):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 qname = (qprefix + "." + node.name) if qprefix \
                     else node.name

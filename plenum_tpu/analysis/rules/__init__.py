@@ -37,6 +37,10 @@ from plenum_tpu.analysis.rules.pt014_compile_cardinality import (
     CompileCardinalityRule)
 from plenum_tpu.analysis.rules.pt015_trace_taint import (
     TraceContextTaintRule)
+from plenum_tpu.analysis.rules.pt016_region_state import (
+    CrossRegionMutableStateRule)
+from plenum_tpu.analysis.rules.pt017_handoff import (
+    HandoffDisciplineRule)
 
 RULE_CLASSES = (
     BlockingCallRule,
@@ -54,6 +58,8 @@ RULE_CLASSES = (
     DispatchWithoutCollectRule,
     CompileCardinalityRule,
     TraceContextTaintRule,
+    CrossRegionMutableStateRule,
+    HandoffDisciplineRule,
 )
 
 

@@ -110,6 +110,11 @@ def _entry_points(cls: ast.ClassDef) -> Set[str]:
 class CrossThreadSharedStateRule(Rule):
     code = "PT004"
     name = "cross-thread-shared-state"
+    # the engine-backed region analysis (PT016/PT017) supersedes this
+    # same-class heuristic: whole-program spawn-target resolution sees
+    # cross-class/cross-module worker reach this rule cannot. PT004
+    # runs only as the fallback when the engine fails to build.
+    subsumed_by = "PT016"
 
     def applies(self, rel_path: str) -> bool:
         return rel_path.startswith("plenum_tpu/")

@@ -247,6 +247,16 @@ class Config:
     PIPELINE_WORKERS = None
     PIPELINE_QUEUE_DEPTH = 256
 
+    # runtime ownership sanitizer (runtime/sanitizer.py): region pins
+    # on consensus-critical objects + handoff tokens at the pipeline
+    # queues — the runtime twin of plenum-lint PT016/PT017. Tri-state:
+    # True/False win outright; None defers to PLENUM_TPU_SANITIZE=1
+    # (the sim-pool test fixtures' suite-wide switch). Default off in
+    # production: the checks are cheap (a dict lookup per guarded
+    # seam, gated <2% by the sanitizer_overhead bench) but the point
+    # is debugging, not defense in depth.
+    SANITIZER_ENABLED = None
+
     # ---- quotas per prod tick (reference stp_core/config.py:29+,
     # plenum/server/quota_control.py)
     NODE_TO_NODE_STACK_QUOTA = 1024

@@ -34,6 +34,7 @@ from plenum_tpu.common.messages.node_messages import (
     Ordered, PrePrepare, Prepare)
 from plenum_tpu.consensus.batch_id import BatchID, batch_id_from
 from plenum_tpu.consensus.consensus_shared_data import ConsensusSharedData
+from plenum_tpu.runtime.sanitizer import OwnershipSanitizer
 from plenum_tpu.runtime.stashing_router import (
     DISCARD, PROCESS, StashingRouter)
 from plenum_tpu.runtime.timer import TimerService
@@ -174,8 +175,11 @@ class OrderingService:
         self._config = config or Config()
         # pipeline ownership contract: when bound (pipelined node),
         # 3PC intake off the prod thread is a programming error, not
-        # a race to debug later — fail loud at the seam
-        self._owner_thread: Optional[int] = None
+        # a race to debug later — fail loud at the seam. The guard is
+        # the runtime sanitizer's region-pin API (one implementation
+        # shared with the node-wide pins); until bind_owner_thread or
+        # attach_sanitizer runs, every check is a no-op.
+        self._sanitizer = OwnershipSanitizer(name=self.name)
         self.metrics = NullMetricsCollector()  # node injects the real one
         self.tracer = NullTracer()             # node injects the real one
         self.telemetry = NullTelemetryHub()    # node injects the real one
@@ -603,6 +607,7 @@ class OrderingService:
                           prepare: Prepare):
         """Record one PREPARE vote, keeping the incremental quorum
         counter exact (the prepare quorum excludes the primary)."""
+        self._sanitizer.check("vote stores")
         self.prepares[key][frm] = prepare
         if frm != self._data.primary_name:
             count = self._prepare_vote_count[key] = \
@@ -676,23 +681,26 @@ class OrderingService:
 
     # ------------------------------------- pipeline ownership contract
 
+    def attach_sanitizer(self, sanitizer: OwnershipSanitizer) -> None:
+        """Share the node-wide sanitizer (its region bindings and the
+        vote-store/stash pins) instead of the service-local default.
+        Call before bind_owner_thread so the prod binding lands on the
+        shared instance."""
+        self._sanitizer = sanitizer
+
     def bind_owner_thread(self, ident: int) -> None:
         """Pin 3PC intake to the prod thread (pipelined node). Every
         ``process_*_batch`` / ``process_*_columns`` call off that
         thread raises — the pipeline's ownership contract (workers
         parse, the prod thread counts votes) enforced at the seam
-        instead of trusted by convention."""
-        self._owner_thread = int(ident)
+        instead of trusted by convention. Implemented as a sanitizer
+        region pin: identical RuntimeError contract, one guard
+        implementation for the whole node."""
+        self._sanitizer.bind_region("prod", int(ident))
+        self._sanitizer.pin("3PC intake", "prod")
 
     def _assert_owner(self) -> None:
-        if self._owner_thread is None:
-            return
-        import threading
-        if threading.get_ident() != self._owner_thread:
-            raise RuntimeError(
-                "3PC intake off the prod thread: consensus state is "
-                "owned by thread %d, called from %d" % (
-                    self._owner_thread, threading.get_ident()))
+        self._sanitizer.check("3PC intake")
 
     def process_prepare_batch(self, prepares: List[Prepare], frm: str):
         """Columnar PREPARE intake: one sender's wire batch processed in
@@ -1005,6 +1013,7 @@ class OrderingService:
 
     def _add_commit_vote(self, key: Tuple[int, int], frm: str,
                          commit: Commit):
+        self._sanitizer.check("vote stores")
         self.commits[key][frm] = commit
         count = self._commit_vote_count[key] = \
             self._commit_vote_count.get(key, 0) + 1

@@ -57,6 +57,7 @@ from typing import Callable, List, Optional
 
 from plenum_tpu.observability.telemetry import TM, NullTelemetryHub
 from plenum_tpu.observability.tracing import CAT_3PC, NullTracer
+from plenum_tpu.runtime.sanitizer import HandoffToken
 
 logger = logging.getLogger(__name__)
 
@@ -135,7 +136,7 @@ class PipelineJob:
     — the handoff is the Event, never shared mutation."""
 
     __slots__ = ("work", "msg", "frm", "result", "error", "done",
-                 "enq_perf")
+                 "enq_perf", "token")
 
     def __init__(self, work: Optional[Callable], msg, frm):
         self.work = work
@@ -145,6 +146,10 @@ class PipelineJob:
         self.error = None
         self.done = threading.Event()
         self.enq_perf = time.perf_counter()
+        # sanitizer handoff token (None when the sanitizer is off):
+        # released/acquired at each queue crossing so an out-of-turn
+        # touch raises instead of racing
+        self.token = None
         if work is None:
             self.done.set()
 
@@ -153,6 +158,10 @@ class PipelineJob:
             self.result = self.work()
         except Exception as e:           # delivered to the prod thread
             self.error = e
+        # hand the payload back BEFORE done is observable, so the prod
+        # thread can never win the race against its own re-acquire
+        if self.token is not None:
+            self.token.release("prod")
         self.done.set()
 
 
@@ -204,9 +213,10 @@ class NodePipeline:
     effect. The worker side only ever executes ``job.work()``."""
 
     def __init__(self, deliver: Callable, config=None, telemetry=None,
-                 tracer=None, name: str = ""):
+                 tracer=None, name: str = "", sanitizer=None):
         self.name = name
         self._deliver = deliver
+        self.sanitizer = sanitizer
         self._tm = telemetry if telemetry is not None \
             else NullTelemetryHub()
         self.tracer = tracer if tracer is not None else NullTracer()
@@ -243,6 +253,10 @@ class NodePipeline:
         self._jobs.append(job)
         if work is not None:
             if self._worker.is_alive():
+                if self.sanitizer is not None:
+                    job.token = HandoffToken(self.sanitizer,
+                                             "pipeline parse job")
+                    job.token.release("worker")
                 self._in.put(job)
             else:
                 # dead-worker step-down: parse inline on the submitter
@@ -275,9 +289,15 @@ class NodePipeline:
                     with self.tracer.span("queue_wait", CAT_3PC):
                         while not job.done.wait(0.1):
                             if not self._worker.is_alive():
-                                job.run()   # serial step-down
+                                # serial step-down: ownership collapses
+                                # back to the single surviving thread —
+                                # no handoff left to discipline
+                                job.token = None
+                                job.run()
                                 break
                 self._jobs.popleft()
+                if job.token is not None:
+                    job.token.acquire("prod")
                 self._tm.observe(
                     TM.PIPELINE_QUEUE_WAIT_MS,
                     (time.perf_counter() - job.enq_perf) * 1e3)
@@ -314,11 +334,16 @@ class NodePipeline:
     # ----------------------------------------------------- worker side
 
     def _worker_loop(self) -> None:
+        if self.sanitizer is not None:
+            # this thread IS the worker region for the node's pins
+            self.sanitizer.bind_region("worker")
         while True:
             job = self._in.get()
             if job is None or job is _STOP:
                 return
+            if job.token is not None:
+                job.token.acquire("worker")
             t0 = time.perf_counter()
-            job.run()
+            job.run()               # releases the token back to prod
             self._tm.observe(TM.PIPELINE_PARSE_MS,
                              (time.perf_counter() - t0) * 1e3)

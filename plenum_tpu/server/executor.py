@@ -103,6 +103,13 @@ class NodeBatchExecutor(BatchExecutor):
             if lane_min is None else lane_min
         # staged batches by apply order (mirrors write manager staging)
         self._staged: List[ThreePcBatch] = []
+        # runtime ownership sanitizer (node-injected): lane planning
+        # and batch commit are prod-thread seams — exec_map fans ITEMS
+        # to pool threads, but the plan/commit decisions stay owned
+        self._sanitizer = None
+
+    def set_sanitizer(self, sanitizer) -> None:
+        self._sanitizer = sanitizer
 
     @property
     def db(self):
@@ -138,6 +145,8 @@ class NodeBatchExecutor(BatchExecutor):
         as they open, so the caller's finally closes every window even
         when a later prefetch raises mid-way. → the lane plan."""
         touched = self.write_manager.touched_keys
+        if self._sanitizer is not None:
+            self._sanitizer.check("lane planner")
         with self.tracer.span("exec_validate", CAT_EXECUTE, key=key,
                               batch_size=len(requests)) as sp:
             plan = plan_lanes([touched(r) for r in requests])
@@ -326,6 +335,8 @@ class NodeBatchExecutor(BatchExecutor):
             return self._commit_batch(ordered)
 
     def _commit_batch(self, ordered: Ordered):
+        if self._sanitizer is not None:
+            self._sanitizer.check("state pending buffers")
         if not self._staged:
             logger.warning("commit with no staged batch at %s",
                            (ordered.viewNo, ordered.ppSeqNo))

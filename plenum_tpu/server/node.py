@@ -641,6 +641,27 @@ class Node:
             return result
         network.process_incoming = filtering_incoming
 
+        # ---- runtime ownership sanitizer (runtime/sanitizer.py): the
+        # runtime twin of plenum-lint PT016/PT017 — region pins on
+        # consensus-critical objects, shared by the ordering services'
+        # 3PC-intake guard, the executor's commit/lane seams and the
+        # pipeline's handoff tokens. The construction thread IS the
+        # prod thread (nodes are built and serviced on one thread; the
+        # pipelined path re-binds below with its own ident). Opt-in:
+        # Config.SANITIZER_ENABLED / PLENUM_TPU_SANITIZE=1.
+        from plenum_tpu.runtime.sanitizer import (
+            CONSENSUS_PINS, OwnershipSanitizer, sanitizer_enabled)
+        self.sanitizer = None
+        if sanitizer_enabled(self.config):
+            self.sanitizer = OwnershipSanitizer(
+                name=name, tracer=self.tracer)
+            self.sanitizer.bind_region("prod")
+            for label in CONSENSUS_PINS:
+                self.sanitizer.pin(label, "prod")
+            for replica in self.replicas:
+                replica.ordering.attach_sanitizer(self.sanitizer)
+            self.executor.set_sanitizer(self.sanitizer)
+
         # ---- pipeline runtime (runtime/pipeline.py): wire parse +
         # ed25519 pre-screen move to a worker thread feeding the prod
         # thread through a bounded queue; execution fan-out shares the
@@ -668,7 +689,7 @@ class Node:
             self._pipeline = NodePipeline(
                 self._pipeline_deliver, config=self.config,
                 telemetry=self.telemetry, tracer=self.tracer,
-                name=name)
+                name=name, sanitizer=self.sanitizer)
             self.executor.set_exec_map(self._pipeline.exec_map)
             prod_ident = threading.get_ident()
             for replica in self.replicas:

@@ -80,6 +80,7 @@ class VerifyDaemon:
         self._pool = ThreadPoolExecutor(max_workers=resolve_workers(
             getattr(Config, "PIPELINE_WORKERS", None), fallback=1))
         self._server = None
+        self._batcher_task = None
         self._writers = set()
         self.served = 0
         self.launches = 0
@@ -93,17 +94,33 @@ class VerifyDaemon:
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
-        asyncio.get_event_loop().create_task(self._batcher())
+        self._batcher_task = asyncio.get_event_loop().create_task(
+            self._batcher())
         logger.info("verify daemon listening on %s:%d", self.host, self.port)
 
     async def stop(self):
+        # cancel the batcher FIRST: left running past shutdown it would
+        # keep consuming frames that buffered before the connections die
+        # below, answering them all-False through the shut-down pool —
+        # and a restarted daemon on the same port never sees them
+        if self._batcher_task is not None:
+            self._batcher_task.cancel()
+            try:
+                await self._batcher_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._batcher_task = None
         if self._server is not None:
             self._server.close()
-            # close live node connections first: 3.12's wait_closed()
-            # waits for EVERY client connection, not just the listener
+            # abort (RST), don't close (FIN-after-flush), live node
+            # connections: a graceful close can deliver a final reply
+            # ahead of the FIN, so the client keeps dispatching into the
+            # dead link instead of re-dialing the restarted daemon.
+            # Also required for 3.12's wait_closed(), which waits for
+            # EVERY client connection, not just the listener.
             for w in list(self._writers):
                 try:
-                    w.close()
+                    w.transport.abort()
                 except Exception:
                     pass
             await self._server.wait_closed()

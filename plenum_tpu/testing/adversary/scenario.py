@@ -26,6 +26,7 @@ import os
 import tempfile
 from typing import Callable, List, Optional
 
+from plenum_tpu.runtime.sanitizer import RegionViolation
 from plenum_tpu.testing.adversary.invariants import InvariantChecker
 
 logger = logging.getLogger(__name__)
@@ -91,12 +92,15 @@ class Scenario:
         return self
 
     def _tick(self) -> None:
-        for node in self.nodes:
-            node.service()
-        self.timer.run_for(self.step)
         try:
+            # service inside the try: an ownership-sanitizer violation
+            # raised mid-service gets the same pool-wide dump treatment
+            # as a failed safety invariant
+            for node in self.nodes:
+                node.service()
+            self.timer.run_for(self.step)
             self.checker.check()
-        except AssertionError as e:
+        except (AssertionError, RegionViolation) as e:
             path = self.dump_trace()
             if path:
                 logger.error("safety invariant failed — flight-recorder "

@@ -17,6 +17,12 @@ os.environ.setdefault("PLENUM_TPU_MESH_CPU_SHARD", "1")
 # kernel compile mid-test. The dedicated tests (test_bls381_pairing.py)
 # force-enable the family through the mesh step-down registry.
 os.environ.setdefault("PLENUM_TPU_BLS_TOWER", "native")
+# ownership sanitizer ON for the whole suite: every sim-pool fixture runs
+# with region pins + pipeline handoff tokens armed, so a consensus-state
+# touch from the wrong thread fails the test that caused it instead of
+# racing silently. Tests that need the unsanitized baseline (bench A/B,
+# overhead parity) pass Config.SANITIZER_ENABLED=False explicitly.
+os.environ.setdefault("PLENUM_TPU_SANITIZE", "1")
 
 import pytest  # noqa: E402
 

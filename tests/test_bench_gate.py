@@ -412,3 +412,72 @@ def test_trace_ctx_gate_warn_override_honored(monkeypatch):
     assert bench.gate_enforced("BENCH_TRACE_CTX_GATE")
     monkeypatch.setenv("BENCH_TRACE_CTX_GATE", "warn")
     assert not bench.gate_enforced("BENCH_TRACE_CTX_GATE")
+
+
+# ------------------------------------------- sanitizer overhead gate
+
+
+def _san_ok(**over):
+    res = {"nodes": 25, "reqs": 800, "parity_ok": True,
+           "parity_roots": {"on": ["r", "a", "s"],
+                            "off": ["r", "a", "s"]},
+           "on": {"req_per_s": 990.0, "ordered": 800, "drained": True},
+           "off": {"req_per_s": 1000.0, "ordered": 800,
+                   "drained": True},
+           "overhead_pct": 1.0}
+    res.update(over)
+    return res
+
+
+def test_sanitizer_gate_passes_under_ceiling():
+    bench = _gate()
+    assert bench.sanitizer_overhead_gate(_san_ok(), env={}) == []
+    # negative overhead (ON side faster — jitter) is fine
+    assert bench.sanitizer_overhead_gate(
+        _san_ok(overhead_pct=-0.4), env={}) == []
+
+
+def test_sanitizer_gate_fails_at_or_above_ceiling():
+    bench = _gate()
+    failures = bench.sanitizer_overhead_gate(
+        _san_ok(overhead_pct=2.0), env={})
+    assert any("sanitizer_overhead_pct 2.00 >= allowed 2.00" in f
+               for f in failures)
+    assert bench.sanitizer_overhead_gate(
+        _san_ok(overhead_pct=7.3), env={})
+
+
+def test_sanitizer_gate_fails_on_missing_overhead():
+    """Dropping the headline field must fail loudly, not silently skip
+    the check."""
+    bench = _gate()
+    res = _san_ok()
+    del res["overhead_pct"]
+    failures = bench.sanitizer_overhead_gate(res, env={})
+    assert any("overhead_pct missing" in f for f in failures)
+    assert bench.sanitizer_overhead_gate(None) != []
+
+
+def test_sanitizer_gate_parity_is_hard_even_under_warn_override():
+    """A guard that changes what the pool orders is a bug, not
+    overhead: divergent roots fail regardless of the env override."""
+    bench = _gate()
+    for env in ({}, {"BENCH_SANITIZER_GATE": "warn"}):
+        failures = bench.sanitizer_overhead_gate(
+            _san_ok(parity_ok=False), env=env)
+        assert any("parity_ok" in f for f in failures), env
+
+
+def test_sanitizer_gate_warn_override_downgrades_overhead_only():
+    bench = _gate()
+    slow = _san_ok(overhead_pct=9.9)
+    assert bench.sanitizer_overhead_gate(
+        slow, env={"BENCH_SANITIZER_GATE": "warn"}) == []
+    # any other value keeps it enforcing
+    assert bench.sanitizer_overhead_gate(
+        slow, env={"BENCH_SANITIZER_GATE": "1"}) != []
+
+
+def test_sanitizer_gate_ceiling_matches_telemetry_bar():
+    bench = _gate()
+    assert bench.SANITIZER_OVERHEAD_MAX_PCT == 2.0

@@ -1,8 +1,9 @@
 """Tier-1 lint gate — the tree must be clean against the baseline.
 
-Runs the full PT001–PT014 registry over ``plenum_tpu/`` in-process
-(pure stdlib ast: no JAX init, no subprocess, fast — the PT012–PT014
-whole-program engine rides the content-hash summary cache) and fails
+Runs the full PT001–PT017 registry over ``plenum_tpu/`` in-process
+(pure stdlib ast: no JAX init, no subprocess, fast — the PT012–PT017
+whole-program engine, thread-region pass included, rides the
+content-hash summary cache) and fails
 on ANY non-baselined finding. This is what makes every rule a standing
 invariant: re-introducing the PR 1 unauthenticated-propagate hole, an
 eager device probe, or a fresh broad except on a device path fails the
@@ -81,6 +82,31 @@ def test_gateway_tier_is_covered_by_path_scoped_rules():
         "PT012 must treat the gateway lane planner as a determinism "
         "root — it must compute the identical partition as the "
         "node-side planner")
+
+
+def test_pipeline_runtime_is_covered_by_region_rules():
+    """The pipelined node's thread seams must sit inside PT016/PT017's
+    blast radius: the worker runtime, the node that spawns it, the
+    consensus code the regions propagate into, and the executor's lane
+    planner — so a new cross-region write or a mutable queue payload
+    fails THIS gate, not a code review. The sanitizer is the runtime
+    twin; its pin vocabulary agreement lives in test_sanitizer.py."""
+    from plenum_tpu.analysis.rules.pt016_region_state import (
+        CrossRegionMutableStateRule)
+    from plenum_tpu.analysis.rules.pt017_handoff import (
+        HandoffDisciplineRule)
+    for probe in ("plenum_tpu/runtime/pipeline.py",
+                  "plenum_tpu/server/node.py",
+                  "plenum_tpu/consensus/ordering_service.py",
+                  "plenum_tpu/server/executor.py"):
+        assert CrossRegionMutableStateRule().applies(probe), probe
+        assert HandoffDisciplineRule().applies(probe), probe
+    # the fallback contract is registered, not ad hoc: PT004 names its
+    # subsuming rule so the Analyzer holds it out exactly when PT016 is
+    # active and the engine built
+    from plenum_tpu.analysis.rules.pt004_threads import (
+        CrossThreadSharedStateRule)
+    assert CrossThreadSharedStateRule.subsumed_by == "PT016"
 
 
 def test_baseline_entries_are_justified():

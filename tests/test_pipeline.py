@@ -275,10 +275,12 @@ def _adversarial_payloads(rng):
     return payloads
 
 
-def _run_adversarial_pool(pipeline, seed, n_reqs=10):
+def _run_adversarial_pool(pipeline, seed, n_reqs=10, sanitizer=None):
     """A 4-node flat-wire pool ordering n_reqs NYMs while every node is
     fed a seeded adversarial FlatBatch stream mid-run. → (roots, seq,
-    per-node snapshots)."""
+    per-node snapshots). `sanitizer` pins Config.SANITIZER_ENABLED so
+    test_sanitizer.py can A/B the ownership sanitizer on the identical
+    adversarial workload."""
     from plenum_tpu.common.constants import NYM, TARGET_NYM, VERKEY
     from plenum_tpu.common.txn_util import get_payload_data
     from plenum_tpu.crypto.signer import SimpleSigner
@@ -295,7 +297,8 @@ def _run_adversarial_pool(pipeline, seed, n_reqs=10):
     net = SimNetwork(timer, DefaultSimRandom(77),
                      min_latency=0.003, max_latency=0.003)
     conf = Config(Max3PCBatchSize=5, Max3PCBatchWait=0.2,
-                  FLAT_WIRE=True, PIPELINE_ENABLED=pipeline)
+                  FLAT_WIRE=True, PIPELINE_ENABLED=pipeline,
+                  SANITIZER_ENABLED=sanitizer)
     nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
              for name in names]
     sus = {n.name: [] for n in nodes}

@@ -1596,3 +1596,359 @@ def test_pt015_fires_when_parse_surface_calls_consensus(tmp_path):
     f = findings[0]
     assert f.symbol == "decode_trace_stamp"
     assert "_order" in f.message and "advisory" in f.message
+
+
+# ------------------------------------- PT016 (thread-region ownership)
+
+# The pipeline ownership contract, statically: server/node.py hands a
+# closure across a queue into a runtime worker loop, and the worker's
+# call closure — crossing back into consensus code in ANOTHER module —
+# rebinds consensus-named state. PT004 (one-class heuristic) cannot
+# see this; the engine's region propagation can.
+PT016_PIPELINE_MOD = """
+    import threading
+
+    class NodePipeline:
+        def start(self):
+            self._t = threading.Thread(target=self._worker_loop)
+            self._t.start()
+
+        def _worker_loop(self):
+            job = self._in.get()
+            self._ordering.count_vote(job)
+"""
+
+PT016_ORDERING_BAD = """
+    class Ordering:
+        def count_vote(self, vote):
+            self.prepare_count = vote.n
+"""
+
+# the sanctioned shape: the worker only parses and hands an IMMUTABLE
+# result back over the queue — no consensus write, nothing mutable in
+# flight
+PT016_PIPELINE_GOOD = """
+    import threading
+
+    class NodePipeline:
+        def start(self):
+            self._t = threading.Thread(target=self._worker_loop)
+            self._t.start()
+
+        def _worker_loop(self):
+            raw = self._in.get()
+            parsed = bytes(raw)
+            self._out.put(parsed)
+"""
+
+
+def test_pt016_fires_on_cross_module_worker_consensus_write(tmp_path):
+    findings = check_program("PT016", {
+        "plenum_tpu/runtime/pipeline.py": PT016_PIPELINE_MOD,
+        "plenum_tpu/consensus/ordering.py": PT016_ORDERING_BAD,
+    }, tmp_path)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == "plenum_tpu/consensus/ordering.py"
+    assert f.symbol == "Ordering.count_vote"
+    assert "self.prepare_count (consensus state)" in f.message
+    assert "owned by the prod thread" in f.message
+
+
+def test_pt016_clean_on_immutable_queue_handoff(tmp_path):
+    assert check_program("PT016", {
+        "plenum_tpu/runtime/pipeline.py": PT016_PIPELINE_GOOD,
+    }, tmp_path) == []
+
+
+def test_pt016_dual_region_write_needs_lock(tmp_path):
+    dual = """
+        import threading
+
+        class Stage:
+            def start(self):
+                self._t = threading.Thread(target=self._work)
+                self._t.start()
+
+            def _work(self):
+                self.cursor = 1
+
+            def advance(self):
+                self.cursor = 2
+    """
+    findings = check_program("PT016", {
+        "plenum_tpu/runtime/stage.py": dual}, tmp_path)
+    assert len(findings) == 1
+    assert "self.cursor is written from both" in findings[0].message
+    locked = """
+        import threading
+
+        class Stage:
+            def start(self):
+                self._t = threading.Thread(target=self._work)
+                self._t.start()
+
+            def _work(self):
+                with self._lock:
+                    self.cursor = 1
+
+            def advance(self):
+                with self._lock:
+                    self.cursor = 2
+    """
+    assert check_program("PT016", {
+        "plenum_tpu/runtime/stage.py": locked}, tmp_path) == []
+
+
+def test_pt016_init_writes_never_flag(tmp_path):
+    """Construction happens before any thread exists — __init__ writes
+    are region-free by definition."""
+    src = """
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self.prepares = {}
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                return self.prepares
+    """
+    assert check_program("PT016", {
+        "plenum_tpu/runtime/stage.py": src}, tmp_path) == []
+
+
+# ------------------------------------------ PT017 (handoff discipline)
+
+
+def test_pt017_fires_on_fresh_mutable_queue_payload(tmp_path):
+    src = """
+        class Stage:
+            def feed(self, env, frm):
+                self._queue.put({"env": env, "frm": frm})
+    """
+    findings = check_program("PT017", {
+        "plenum_tpu/runtime/stage.py": src}, tmp_path)
+    assert len(findings) == 1
+    assert "freshly built mutable dict crosses a thread queue" \
+        in findings[0].message
+
+
+def test_pt017_fires_on_mutate_after_put(tmp_path):
+    src = """
+        class Stage:
+            def submit(self, items):
+                batch = list(items)
+                self._queue.put(batch)
+                batch.append(None)
+    """
+    findings = check_program("PT017", {
+        "plenum_tpu/runtime/stage.py": src}, tmp_path)
+    assert len(findings) == 1
+    assert "mutated after put()" in findings[0].message
+    assert "batch" in findings[0].message
+
+
+def test_pt017_kv_store_put_is_not_a_handoff(tmp_path):
+    """A KV-store put persists a snapshot — mutating the value after
+    is not sharing it with another thread."""
+    src = """
+        class Store:
+            def save(self, key, items):
+                batch = list(items)
+                self._store.put(key, batch)
+                batch.append(None)
+    """
+    assert check_program("PT017", {
+        "plenum_tpu/storage/kv.py": src}, tmp_path) == []
+
+
+def test_pt017_fires_on_consensus_capture_into_closure(tmp_path):
+    src = """
+        import threading
+
+        class Node:
+            def start(self):
+                t = threading.Thread(
+                    target=lambda: self._drain(self.prepares))
+                t.start()
+
+            def _drain(self, votes):
+                return votes
+    """
+    findings = check_program("PT017", {
+        "plenum_tpu/server/node.py": src}, tmp_path)
+    assert len(findings) == 1
+    assert "consensus-owned state (self.prepares) is captured" \
+        in findings[0].message
+
+
+def test_pt017_method_spawn_target_is_not_a_capture(tmp_path):
+    """Reading a method off self to CALL it is how every spawn works —
+    only consensus state read as data counts."""
+    src = """
+        import threading
+
+        class Node:
+            def start(self):
+                t = threading.Thread(target=self._worker_loop)
+                t.start()
+
+            def _worker_loop(self):
+                return None
+    """
+    assert check_program("PT017", {
+        "plenum_tpu/server/node.py": src}, tmp_path) == []
+
+
+# ------------------------- PT004 subsumption + engine-fallback contract
+
+
+def test_pt004_held_out_when_engine_active(tmp_path):
+    """With PT016 in the run and the engine healthy, the per-module
+    heuristic stays silent — its findings arrive under PT016/PT017
+    (byte-identical messages, migratable keys)."""
+    p = tmp_path / "plenum_tpu" / "runtime" / "stage.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent(PT004_PIPELINE_BAD))
+    rules = [rule_by_code("PT004"), rule_by_code("PT016"),
+             rule_by_code("PT017")]
+    analyzer = Analyzer(rules, str(tmp_path), use_engine_cache=False)
+    findings = analyzer.run_files(
+        analyzer.collect_files([str(tmp_path)]))
+    assert analyzer.engine_error is None
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert "PT004" not in by_rule
+    # the same two defects, now whole-program findings
+    assert any("self.prepares (consensus state)" in f.message
+               for f in by_rule.get("PT016", []))
+    assert any("mutable dict crosses a thread queue" in f.message
+               for f in by_rule.get("PT017", []))
+
+
+def test_pt004_fallback_when_engine_unavailable(tmp_path, monkeypatch):
+    """Engine build failure must degrade to the heuristic, not to
+    silence: PT004 re-enters the per-module pass and engine_error is
+    surfaced."""
+    from plenum_tpu.analysis.engine import Engine
+
+    def boom(cls, *a, **kw):
+        raise RuntimeError("engine exploded")
+
+    monkeypatch.setattr(Engine, "build", classmethod(boom))
+    p = tmp_path / "plenum_tpu" / "runtime" / "stage.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent(PT004_PIPELINE_BAD))
+    rules = [rule_by_code("PT004"), rule_by_code("PT016"),
+             rule_by_code("PT017")]
+    analyzer = Analyzer(rules, str(tmp_path), use_engine_cache=False)
+    findings = analyzer.run_files(
+        analyzer.collect_files([str(tmp_path)]))
+    assert analyzer.engine_error is not None
+    assert "engine exploded" in analyzer.engine_error
+    by_rule = {f.rule for f in findings}
+    assert "PT004" in by_rule
+    assert "PT016" not in by_rule and "PT017" not in by_rule
+
+
+def test_pt004_runs_normally_without_superseding_rule(tmp_path):
+    """PT004 alone (no PT016 registered in the run) keeps its original
+    behavior — the subsumption is a property of the RUN, not the rule."""
+    findings = check_snippet(rule_by_code("PT004"), PT004_PIPELINE_BAD,
+                             "plenum_tpu/runtime/stage.py")
+    assert any("self.prepares" in f.message for f in findings)
+
+
+# -------------------------------- baseline migration (PT004 → PT016/17)
+
+
+def test_baseline_migrates_pt004_keys_on_load(tmp_path):
+    """Grandfathered PT004 entries re-key to the subsuming rule by
+    message shape — justifications survive the rule split with zero
+    hand-edits."""
+    from plenum_tpu.analysis.baseline import migrate_entries
+
+    entries = [
+        {"rule": "PT004", "path": "plenum_tpu/runtime/stage.py",
+         "symbol": "Stage._work",
+         "message": "self.prepares (consensus state) is written from "
+                    "the worker-thread path (_work) — consensus state "
+                    "is owned by the prod thread; workers may only "
+                    "parse and hand immutable results back over the "
+                    "queue",
+         "justification": "pinned"},
+        {"rule": "PT004", "path": "plenum_tpu/runtime/stage.py",
+         "symbol": "Stage.feed",
+         "message": "a freshly built mutable dict crosses a thread "
+                    "queue via put() — queue payloads must be "
+                    "immutable (bytes, numpy views, frozen records): "
+                    "the consumer would share state the producer can "
+                    "still mutate",
+         "justification": "pinned"},
+        {"rule": "PT006", "path": "plenum_tpu/x.py", "symbol": "f",
+         "message": "broad except", "justification": "pinned"},
+    ]
+    migrated, n = migrate_entries(entries)
+    assert n == 2
+    assert [e["rule"] for e in migrated] == ["PT016", "PT017", "PT006"]
+    # justifications ride along untouched
+    assert all(e["justification"] == "pinned" for e in migrated)
+    # and Baseline.load applies the same migration
+    path = tmp_path / "lint_baseline.json"
+    path.write_text(json.dumps({"version": 1, "entries": entries}))
+    loaded = Baseline.load(str(path))
+    assert [e["rule"] for e in loaded.entries] == \
+        ["PT016", "PT017", "PT006"]
+
+
+def test_baseline_unmigratable_pt004_surfaces_as_stale(tmp_path):
+    """A PT004 entry whose message matches no migration fragment stays
+    PT004 — and with the engine active PT004 never fires, so match()
+    leaves it unconsumed and stale() reports it. Zero silent drops."""
+    from plenum_tpu.analysis.baseline import migrate_entries
+
+    entries = [{"rule": "PT004", "path": "plenum_tpu/runtime/x.py",
+                "symbol": "X.f",
+                "message": "self.count is written from both the "
+                           "daemon thread (_loop) and loop code "
+                           "(service) without a lock — use a lock or "
+                           "the Tracer fixed-slot pattern",
+                "justification": "pinned"}]
+    migrated, n = migrate_entries(list(entries))
+    assert n == 0 and migrated[0]["rule"] == "PT004"
+    b = Baseline(migrated)
+    new, old = b.match([])
+    assert new == [] and old == []
+    assert b.stale() == [("PT004", "plenum_tpu/runtime/x.py", "X.f",
+                          entries[0]["message"])]
+
+
+def test_pt016_message_is_byte_identical_to_pt004(tmp_path):
+    """The migration contract: for the same defect the engine rule
+    emits PT004's exact message, so re-keying the rule id alone is a
+    complete migration."""
+    p = tmp_path / "plenum_tpu" / "runtime" / "stage.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent(PT004_PIPELINE_BAD))
+    heuristic = check_snippet(rule_by_code("PT004"), PT004_PIPELINE_BAD,
+                              "plenum_tpu/runtime/stage.py")
+    engine_findings = check_program("PT016", {
+        "plenum_tpu/runtime/stage.py": PT004_PIPELINE_BAD}, tmp_path)
+    engine_findings += check_program("PT017", {
+        "plenum_tpu/runtime/stage.py": PT004_PIPELINE_BAD}, tmp_path)
+    assert {f.message for f in heuristic} == \
+        {f.message for f in engine_findings}
+
+
+# ----------------------------------------------- SARIF: the new rules
+
+
+def test_sarif_descriptors_cover_region_rules():
+    from plenum_tpu.analysis.sarif import DOCS_URI, _rule_descriptor
+    for code in ("PT016", "PT017"):
+        desc = _rule_descriptor(rule_by_code(code))
+        assert desc["id"] == code
+        assert desc["helpUri"] == DOCS_URI
+        assert desc["name"]

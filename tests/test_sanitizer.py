@@ -1,0 +1,279 @@
+"""Runtime ownership sanitizer (runtime/sanitizer.py).
+
+The contract under test, rung by rung:
+
+* unit — region pins (unpinned/unbound checks are no-ops, wrong-thread
+  checks raise naming the owning region and both thread ids), handoff
+  tokens (release → acquire across a queue boundary, out-of-turn
+  acquire raises), and the tri-state opt-in rule (explicit Config wins,
+  None defers to PLENUM_TPU_SANITIZE);
+* e2e determinism — the sanitizer is a GUARD, never a semantics fork:
+  a pipelined 4-node pool with pins + tokens armed drains the
+  IDENTICAL adversarial workload to byte-equal roots, ordered
+  sequence, and per-node snapshots as the unsanitized pool (3 seeds);
+* detection — a seeded injected violation (worker-side vote-store
+  write, the exact race PT016 reports statically) is caught at the
+  seam and named: label, owning region, both thread ids;
+* static/runtime agreement — every sanitizer pin names state inside
+  the static analysis's consensus-owned vocabulary (PT016 and the pin
+  table cannot drift apart), and a live node's pins are exactly the
+  canonical table, all prod-owned.
+"""
+import threading
+
+import pytest
+
+from plenum_tpu.common.config import Config
+from plenum_tpu.runtime.sanitizer import (
+    CONSENSUS_PINS, HandoffToken, OwnershipSanitizer, RegionViolation,
+    sanitizer_enabled)
+
+
+# ------------------------------------------------------------------ unit
+
+
+def test_unpinned_label_check_is_noop():
+    san = OwnershipSanitizer(name="N")
+    san.bind_region("prod")
+    san.check("vote stores")            # no pin → never raises
+
+
+def test_unbound_region_check_is_noop():
+    san = OwnershipSanitizer(name="N")
+    san.pin("vote stores", "prod")      # pin but no thread bound yet
+    san.check("vote stores")
+
+
+def test_owner_thread_check_passes():
+    san = OwnershipSanitizer(name="N")
+    san.bind_region("prod")
+    san.pin("vote stores", "prod")
+    san.check("vote stores")            # on the owning thread: fine
+
+
+def test_wrong_thread_check_names_region_and_threads():
+    san = OwnershipSanitizer(name="N")
+    owner_ident = threading.get_ident()
+    san.bind_region("prod", owner_ident)
+    san.pin("vote stores", "prod")
+    errs = []
+
+    def off_thread():
+        try:
+            san.check("vote stores")
+        except RegionViolation as e:
+            errs.append((e, threading.get_ident()))
+
+    t = threading.Thread(target=off_thread)
+    t.start()
+    t.join()
+    assert len(errs) == 1
+    e, violator = errs[0]
+    msg = str(e)
+    assert "vote stores off the prod thread" in msg
+    assert "owned by thread %d" % owner_ident in msg
+    assert "called from %d" % violator in msg
+    # the original bind_owner_thread contract: a RuntimeError subclass
+    assert isinstance(e, RuntimeError)
+
+
+def test_handoff_token_round_trip():
+    san = OwnershipSanitizer(name="N")
+    tok = HandoffToken(san, "parse job", holder="prod")
+    tok.release("worker")
+    tok.acquire("worker")               # consumer side, in turn
+    tok.release("prod")
+    tok.acquire("prod")                 # back on the producer side
+    assert tok.state == "prod"
+
+
+def test_handoff_token_out_of_turn_acquire_raises():
+    san = OwnershipSanitizer(name="N")
+    san.bind_region("prod")
+    tok = HandoffToken(san, "parse job", holder="prod")
+    # never released: prod still holds it, a worker-side acquire is a
+    # payload touched out of turn
+    with pytest.raises(RegionViolation) as ei:
+        tok.acquire("worker")
+    assert "handoff token 'parse job'" in str(ei.value)
+
+
+def test_handoff_token_wrong_direction_raises():
+    san = OwnershipSanitizer(name="N")
+    tok = HandoffToken(san, "parse job", holder="prod")
+    tok.release("worker")
+    with pytest.raises(RegionViolation):
+        tok.acquire("prod")             # released toward the worker
+
+
+def test_opt_in_explicit_config_wins(monkeypatch):
+    monkeypatch.delenv("PLENUM_TPU_SANITIZE", raising=False)
+    assert sanitizer_enabled(Config(SANITIZER_ENABLED=True))
+    monkeypatch.setenv("PLENUM_TPU_SANITIZE", "1")
+    assert not sanitizer_enabled(Config(SANITIZER_ENABLED=False))
+
+
+def test_opt_in_none_defers_to_env(monkeypatch):
+    conf = Config()                     # SANITIZER_ENABLED defaults None
+    monkeypatch.delenv("PLENUM_TPU_SANITIZE", raising=False)
+    assert not sanitizer_enabled(conf)
+    assert not sanitizer_enabled(None)
+    for off in ("", "0", "false"):
+        monkeypatch.setenv("PLENUM_TPU_SANITIZE", off)
+        assert not sanitizer_enabled(conf)
+    monkeypatch.setenv("PLENUM_TPU_SANITIZE", "1")
+    assert sanitizer_enabled(conf)
+    assert sanitizer_enabled(None)
+
+
+# ---------------------------------------------- e2e: determinism A/B
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sanitizer_on_off_equal_under_adversarial_stream(seed):
+    """The guard-not-fork contract: byte-equal roots, ordered sequence
+    AND per-node suspicion / stash / vote-store snapshots, sanitizer
+    on vs off, on the pipelined pool under the randomized adversarial
+    injection stream."""
+    from tests.test_pipeline import _run_adversarial_pool
+    on = _run_adversarial_pool(pipeline=True, seed=seed, sanitizer=True)
+    off = _run_adversarial_pool(pipeline=True, seed=seed,
+                                sanitizer=False)
+    assert on[0] == off[0] and on[1] == off[1] and on[2] == off[2]
+    assert on[3] == off[3]                       # ordered sequence
+    assert on[4] == off[4]                       # per-node snapshots
+    # the stream actually raised suspicions somewhere (vacuity guard)
+    assert any(s["suspicion_counts"] for s in on[4].values())
+
+
+# ------------------------------------------------- e2e: detection
+
+
+def _make_sanitized_pool():
+    from plenum_tpu.runtime.sim_random import DefaultSimRandom
+    from plenum_tpu.server.node import Node
+    from plenum_tpu.testing.mock_timer import MockTimer
+    from plenum_tpu.testing.sim_network import SimNetwork
+
+    names = ["Alpha", "Beta", "Gamma", "Delta"]
+    timer = MockTimer()
+    timer.set_time(1600000000)
+    net = SimNetwork(timer, DefaultSimRandom(7))
+    conf = Config(PIPELINE_ENABLED=True, SANITIZER_ENABLED=True)
+    nodes = [Node(name, names, timer, net.create_peer(name), config=conf)
+             for name in names]
+    return nodes, timer
+
+
+def test_injected_worker_side_vote_write_is_caught_and_named():
+    """The seeded violation: the exact race PT016 reports statically —
+    a vote-store write off the prod thread — executed for real. The
+    sanitizer must catch it AT THE SEAM and name the pinned label, the
+    owning region, and both thread identities."""
+    from plenum_tpu.common.messages.node_messages import Prepare
+    from plenum_tpu.common.serializers.base58 import b58encode
+
+    nodes, _timer = _make_sanitized_pool()
+    node = nodes[0]
+    assert node.sanitizer is not None
+    ordering = node.replica.ordering
+    root = b58encode(b"\x11" * 32)
+    prep = Prepare(instId=0, viewNo=0, ppSeqNo=1, ppTime=1600000000,
+                   digest="d" * 8, stateRootHash=root, txnRootHash=root)
+    prod_ident = threading.get_ident()
+    # prod-side write: the owning thread may always count votes
+    ordering._add_prepare_vote((0, 1), "Gamma", prep)
+    errs = []
+
+    def rogue_worker():
+        try:
+            ordering._add_prepare_vote((0, 2), "Delta", prep)
+        except RegionViolation as e:
+            errs.append((e, threading.get_ident()))
+
+    t = threading.Thread(target=rogue_worker, name="rogue")
+    t.start()
+    t.join()
+    assert len(errs) == 1
+    e, violator = errs[0]
+    msg = str(e)
+    assert "vote stores off the prod thread" in msg
+    assert "owned by thread %d" % prod_ident in msg
+    assert "called from %d" % violator in msg
+    # the rogue write must NOT have landed
+    assert (0, 2) not in ordering.prepares
+
+
+def test_scenario_tick_dumps_on_region_violation(tmp_path, monkeypatch):
+    """The Scenario runner treats a RegionViolation like a failed
+    safety invariant: caught by _tick's dump path, annotated, and
+    re-raised — a violation mid-service produces the same triageable
+    artifact trail as a fork."""
+    from plenum_tpu.testing.adversary.scenario import Scenario
+
+    class _BoomNode:
+        name = "Alpha"
+
+        def service(self):
+            raise RegionViolation(
+                "vote stores off the prod thread: consensus state is "
+                "owned by thread 1, called from 2")
+
+    class _Timer:
+        def get_current_time(self):
+            return 0.0
+
+        def run_for(self, _s):
+            pass
+
+    sc = Scenario(_Timer(), [_BoomNode()], honest=["Alpha"],
+                  checker=type("C", (), {"check": lambda self: None})())
+    with pytest.raises(RegionViolation) as ei:
+        sc.run(1.0)
+    assert "vote stores off the prod thread" in str(ei.value)
+
+
+# ------------------------------------- static/runtime agreement
+
+
+def test_every_pin_is_in_the_static_consensus_vocabulary():
+    """Every fragment the runtime pins MUST be consensus-owned in the
+    static analysis's vocabulary — otherwise the two halves of the
+    ownership story drift: the sanitizer would guard state PT016 does
+    not report, or vice versa."""
+    from plenum_tpu.analysis.rules.pt004_threads import (
+        CONSENSUS_ATTRS, _consensus_attr)
+    for label, fragments in CONSENSUS_PINS.items():
+        assert fragments, label
+        for frag in fragments:
+            assert frag in CONSENSUS_ATTRS, (label, frag)
+            # and the matcher agrees an attribute carrying the fragment
+            # is consensus-owned
+            assert _consensus_attr("x_%s_y" % frag), (label, frag)
+
+
+def test_live_node_pins_are_exactly_the_canonical_table():
+    """A PT016-clean seam needs no pin; every pinned site is in the
+    analysis's consensus-owned set. Concretely: a sanitized node pins
+    exactly the CONSENSUS_PINS labels, all owned by prod."""
+    nodes, _timer = _make_sanitized_pool()
+    for node in nodes:
+        assert node.sanitizer is not None
+        pins = node.sanitizer.pins
+        assert set(pins) == set(CONSENSUS_PINS)
+        assert set(pins.values()) == {"prod"}
+
+
+def test_disabled_node_has_no_sanitizer():
+    from plenum_tpu.runtime.sim_random import DefaultSimRandom
+    from plenum_tpu.server.node import Node
+    from plenum_tpu.testing.mock_timer import MockTimer
+    from plenum_tpu.testing.sim_network import SimNetwork
+
+    timer = MockTimer()
+    timer.set_time(1600000000)
+    net = SimNetwork(timer, DefaultSimRandom(7))
+    conf = Config(SANITIZER_ENABLED=False)
+    node = Node("Alpha", ["Alpha"], timer, net.create_peer("Alpha"),
+                config=conf)
+    assert node.sanitizer is None
